@@ -1,0 +1,64 @@
+"""append_backward — gradient construction.
+
+Parity: python/paddle/fluid/backward.py. The reference builds one grad-op per
+forward op (C++ GradOpMaker) and inserts them in reverse order. paddle_tpu
+plants a single ``backward_marker`` op carrying (loss, params, grad names);
+at lowering (core/lowering.py) the forward ops are replayed inside
+``jax.value_and_grad(..., has_aux=True)`` so XLA sees one fused
+forward+backward program. The public contract is identical: grad Variables
+named ``<param>@GRAD`` exist in the block, ``(param, grad)`` pairs are
+returned, and downstream passes (regularizer, clip, optimizer) append ops
+that read/write those names.
+"""
+from . import framework
+from .framework import Parameter, Variable, grad_var_name
+
+__all__ = ['append_backward']
+
+
+def _create_grad_var(block, ref_var, name=None):
+    return block.create_var(
+        name=name or grad_var_name(ref_var.name), shape=ref_var.shape,
+        dtype=ref_var.dtype, lod_level=ref_var.lod_level)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    assert isinstance(loss, Variable), "loss must be a Variable"
+    program = loss.block.program
+    block = program.global_block()
+
+    if parameter_list is not None:
+        parameters = []
+        for p in parameter_list:
+            name = p.name if isinstance(p, Variable) else p
+            parameters.append(block.var(name))
+    else:
+        parameters = [p for p in block.all_parameters() if p.trainable]
+
+    no_grad = set()
+    if no_grad_set:
+        for item in no_grad_set:
+            no_grad.add(item.name if isinstance(item, Variable) else item)
+    parameters = [p for p in parameters if p.name not in no_grad]
+
+    params_and_grads = []
+    grad_names = []
+    for p in parameters:
+        g = _create_grad_var(block, p)
+        params_and_grads.append((p, g))
+        grad_names.append(g.name)
+
+    block.append_op(
+        type='backward_marker',
+        inputs={'Loss': [loss]},
+        outputs={},
+        attrs={'params': [p.name for p in parameters],
+               'grads': grad_names})
+
+    if callbacks is not None:
+        for cb in callbacks:
+            for p, g in params_and_grads:
+                cb(block=block, context={'param': p, 'grad': g})
+
+    return params_and_grads

@@ -1,0 +1,2 @@
+from .places import TPUPlace, CPUPlace, CUDAPlace, CUDAPinnedPlace  # noqa
+from .registry import register_kernel, get_kernel, has_kernel  # noqa

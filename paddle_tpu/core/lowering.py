@@ -1,0 +1,187 @@
+"""Lowering: Program block -> single jitted XLA computation.
+
+Parity: replaces the reference's per-op interpreter
+(paddle/fluid/framework/executor.cc: for each op -> OperatorWithKernel::Run on
+a DeviceContext) with a whole-block trace. One ``exe.run`` on a training
+program compiles to ONE XLA executable computing forward + backward +
+optimizer update, with persistable state donated across steps.
+
+Gradient construction (parity with python/paddle/fluid/backward.py):
+``append_backward`` plants a ``backward_marker`` op. At lowering time the ops
+before the marker are replayed inside ``jax.value_and_grad(..., has_aux=True)``
+so the forward is traced exactly once; gradients bind to the reference's
+``<param>@GRAD`` names and downstream ops (grad clip, regularizers, optimizer
+update ops) consume them as ordinary environment values.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import get_kernel
+from ..framework import convert_np_dtype
+
+RNG_KEY = '__rng__'
+
+# JAX default (x64 disabled) canonicalizes these anyway; do it explicitly so
+# cache keys and feeds are stable. TPU has no fast f64/i64 path.
+_RUNTIME_DTYPE = {'int64': 'int32', 'float64': 'float32', 'uint64': 'uint32'}
+
+
+def runtime_dtype(dtype):
+    d = convert_np_dtype(dtype)
+    return _RUNTIME_DTYPE.get(d, d)
+
+
+class OpCtx(object):
+    """Kernel-facing view of one op during lowering."""
+
+    __slots__ = ('op', 'env', 'runner')
+
+    def __init__(self, op, env, runner):
+        self.op = op
+        self.env = env
+        self.runner = runner
+
+    # ---- inputs -----------------------------------------------------------------
+    def input(self, slot, idx=0):
+        names = self.op.inputs.get(slot) or []
+        if not names:
+            return None
+        return self.env[names[idx]]
+
+    def inputs(self, slot):
+        return [self.env[n] for n in self.op.inputs.get(slot, [])]
+
+    def has_input(self, slot):
+        return bool(self.op.inputs.get(slot))
+
+    def input_name(self, slot, idx=0):
+        return self.op.inputs[slot][idx]
+
+    # ---- outputs ----------------------------------------------------------------
+    def set_output(self, slot, val, idx=0):
+        self.env[self.op.outputs[slot][idx]] = val
+
+    def output_name(self, slot, idx=0):
+        return self.op.outputs[slot][idx]
+
+    def output_names(self, slot):
+        return self.op.outputs.get(slot, [])
+
+    def out_var(self, slot, idx=0):
+        return self.runner.block._find_var_recursive(
+            self.op.outputs[slot][idx])
+
+    def in_var(self, slot, idx=0):
+        return self.runner.block._find_var_recursive(self.op.inputs[slot][idx])
+
+    # ---- attrs / misc -----------------------------------------------------------
+    def attr(self, name, default=None):
+        return self.op.attrs.get(name, default)
+
+    def next_rng(self):
+        k1, k2 = jax.random.split(self.env[RNG_KEY])
+        self.env[RNG_KEY] = k1
+        return k2
+
+    def out_dtype(self, slot, idx=0):
+        var = self.out_var(slot, idx)
+        return runtime_dtype(var.dtype if var is not None else 'float32')
+
+    def is_test(self):
+        return bool(self.attr('is_test', False))
+
+
+class BlockRunner(object):
+    """Executes a Block's op list into an environment of traced values."""
+
+    def __init__(self, block, grad_mode=False):
+        self.block = block
+        self.grad_mode = grad_mode
+
+    def run_ops(self, ops, env):
+        for op in ops:
+            kernel = get_kernel(op.type)
+            try:
+                kernel(OpCtx(op, env, self))
+            except Exception as e:
+                raise type(e)(
+                    "while lowering op %r (%s -> %s): %s" %
+                    (op.type, op.inputs, op.outputs, e)) from e
+            if self.grad_mode:
+                for name in op.output_arg_names:
+                    var = self.block._find_var_recursive(name)
+                    if var is not None and var.stop_gradient and \
+                            name in env and _is_float(env[name]):
+                        env[name] = jax.tree_util.tree_map(
+                            jax.lax.stop_gradient, env[name])
+        return env
+
+
+def _is_float(val):
+    leaves = jax.tree_util.tree_leaves(val)
+    return any(jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+               for l in leaves)
+
+
+def _find_marker(ops):
+    for i, op in enumerate(ops):
+        if op.type == 'backward_marker':
+            return i
+    return -1
+
+
+def lower_block(program, block, feed_names, fetch_names, state_in_names,
+                state_out_names):
+    """Build ``fn(feeds, state) -> (fetches, new_state)`` for jit.
+
+    ``feeds``/``state`` are dicts name->array (SequenceTensor allowed).
+    ``state`` includes the PRNG key under ``RNG_KEY``.
+    """
+    ops = list(block.ops)
+    marker_idx = _find_marker(ops)
+
+    def fn(feeds, state):
+        env = {}
+        env.update(state)
+        env.update(feeds)
+        if marker_idx < 0:
+            BlockRunner(block).run_ops(ops, env)
+        else:
+            marker = ops[marker_idx]
+            param_names = [p for p in marker.attrs['params']]
+            grad_names = list(marker.attrs['grads'])
+            loss_name = marker.inputs['Loss'][0]
+            pre, post = ops[:marker_idx], ops[marker_idx + 1:]
+            base_env = {k: v for k, v in env.items()
+                        if k not in set(param_names)}
+
+            def g(param_vals):
+                genv = dict(base_env)
+                genv.update(param_vals)
+                BlockRunner(block, grad_mode=True).run_ops(pre, genv)
+                loss = genv[loss_name]
+                return jnp.sum(loss), genv
+
+            param_vals = {p: env[p] for p in param_names}
+            (_, env2), pgrads = jax.value_and_grad(
+                g, has_aux=True)(param_vals)
+            env = env2
+            env.update(param_vals)
+            scale = marker.attrs.get('loss_scale', None)
+            for p, gname in zip(param_names, grad_names):
+                gval = pgrads[p]
+                if scale is not None and scale != 1.0:
+                    gval = gval * scale
+                env[gname] = gval
+            BlockRunner(block).run_ops(post, env)
+
+        fetches = [env[n] for n in fetch_names]
+        new_state = {}
+        for n in state_out_names:
+            if n in env:
+                new_state[n] = env[n]
+            elif n in state:
+                new_state[n] = state[n]
+        return fetches, new_state
+
+    return fn

@@ -1,0 +1,85 @@
+"""Device places.
+
+Parity: paddle/fluid/platform/place.h (CPUPlace/CUDAPlace/CUDAPinnedPlace).
+BASELINE north star: add ``TPUPlace`` alongside. On this stack every place
+maps to a JAX backend; ``CUDAPlace`` is accepted for script compatibility and
+resolves to the best available accelerator (TPU if present).
+"""
+import functools
+
+__all__ = ['TPUPlace', 'CPUPlace', 'CUDAPlace', 'CUDAPinnedPlace',
+           'is_compiled_with_cuda', 'is_compiled_with_tpu']
+
+
+@functools.lru_cache(maxsize=None)
+def _backend_devices(platform):
+    import jax
+    try:
+        return tuple(jax.devices(platform))
+    except RuntimeError:
+        return ()
+
+
+class Place(object):
+    platform = 'cpu'
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        devs = _backend_devices(self.platform)
+        if not devs:
+            devs = _backend_devices(None)  # default backend
+        return devs[self.device_id % len(devs)]
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+
+class CPUPlace(Place):
+    platform = 'cpu'
+
+    def __init__(self, device_id=0):
+        super(CPUPlace, self).__init__(device_id)
+
+
+class TPUPlace(Place):
+    platform = 'tpu'
+
+    def jax_device(self):
+        devs = _backend_devices('tpu')
+        if not devs:
+            devs = _backend_devices(None)
+        return devs[self.device_id % len(devs)]
+
+
+class CUDAPlace(Place):
+    """Compatibility alias: scripts written for CUDAPlace run on the best
+    available accelerator (TPU > GPU > CPU)."""
+    platform = None
+
+    def jax_device(self):
+        for plat in ('tpu', 'gpu', None):
+            devs = _backend_devices(plat)
+            if devs:
+                return devs[self.device_id % len(devs)]
+        raise RuntimeError("no jax devices")
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+def is_compiled_with_cuda():
+    return bool(_backend_devices('gpu'))
+
+
+def is_compiled_with_tpu():
+    return bool(_backend_devices('tpu'))

@@ -1,0 +1,35 @@
+"""Op kernel registry.
+
+Parity: paddle/fluid/framework/op_registry.h — but instead of per-(place,
+dtype,layout,library) kernel keys, every op has ONE traceable JAX kernel;
+XLA specializes per dtype/shape and fuses across ops at lowering.
+
+A kernel is ``fn(ctx)`` where ``ctx`` is a ``paddle_tpu.core.lowering.OpCtx``.
+It reads inputs from the lowering environment and writes outputs back.
+"""
+
+_KERNELS = {}
+
+
+def register_kernel(op_type):
+    def deco(fn):
+        _KERNELS[op_type] = fn
+        return fn
+    return deco
+
+
+def get_kernel(op_type):
+    try:
+        return _KERNELS[op_type]
+    except KeyError:
+        raise NotImplementedError(
+            "paddle_tpu has no kernel for op type %r. Registered: %d ops."
+            % (op_type, len(_KERNELS)))
+
+
+def has_kernel(op_type):
+    return op_type in _KERNELS
+
+
+def registered_ops():
+    return sorted(_KERNELS)

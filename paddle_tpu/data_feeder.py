@@ -1,0 +1,96 @@
+"""DataFeeder — host-side batch assembly.
+
+Parity: python/paddle/fluid/data_feeder.py. Converts a minibatch (list of
+example tuples) into the Executor feed dict. Sequence slots (lod_level>0)
+become SequenceTensors with bucketed padded length (bounds XLA recompiles).
+"""
+import numpy as np
+
+from .framework import Variable, default_main_program
+from .lod import SequenceTensor, bucket_length
+
+__all__ = ['DataFeeder']
+
+
+class DataToLoDTensorConverter(object):
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = [s for s in shape]
+        self.dtype = dtype
+        self.data = []
+
+    def feed(self, data):
+        self.data.append(data)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.asarray(self.data, dtype=self.dtype)
+            shape = [s for s in self.shape if s != -1]
+            if shape and list(arr.shape[1:]) != shape and \
+                    int(np.prod(arr.shape[1:])) == int(np.prod(shape)):
+                arr = arr.reshape([arr.shape[0]] + shape)
+            elif arr.ndim == 1 and shape == [1]:
+                arr = arr[:, None]
+            return arr
+        if self.lod_level == 1:
+            seqs = [np.asarray(s, dtype=self.dtype) for s in self.data]
+            lens = np.asarray([len(s) for s in seqs], np.int32)
+            max_len = bucket_length(int(lens.max()) if len(lens) else 1)
+            feat = list(seqs[0].shape[1:]) if seqs[0].ndim > 1 else []
+            trailing = [s for s in self.shape if s != -1]
+            if not feat and trailing == [1]:
+                feat = [1]
+                seqs = [s[:, None] if s.ndim == 1 else s for s in seqs]
+            out = np.zeros([len(seqs), max_len] + feat, dtype=self.dtype)
+            for i, s in enumerate(seqs):
+                out[i, :len(s)] = s
+            return SequenceTensor(out, lens)
+        # lod_level == 2: list of list of sequences
+        from .lod import create_lod_tensor
+        outer = [len(ex) for ex in self.data]
+        inner = [len(s) for ex in self.data for s in ex]
+        flat = [item for ex in self.data for s in ex for item in s]
+        arr = np.asarray(flat, dtype=self.dtype)
+        if arr.ndim == 1:
+            arr = arr[:, None]
+        return create_lod_tensor(arr, [outer, inner], self.place)
+
+
+class DataFeeder(object):
+    def __init__(self, feed_list, place, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        if program is None:
+            program = default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("Feed list should contain a list of "
+                                "variable")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = []
+        for lod_level, shape, dtype in zip(
+                self.feed_lod_level, self.feed_shapes, self.feed_dtypes):
+            converters.append(DataToLoDTensorConverter(
+                place=self.place, lod_level=lod_level, shape=shape,
+                dtype=dtype))
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "The number of fields in data (%s) does not match "
+                "len(feed_list) (%s)" % (len(each_sample), len(converters)))
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        ret_dict = {}
+        for each_name, each_converter in zip(self.feed_names, converters):
+            ret_dict[each_name] = each_converter.done()
+        return ret_dict

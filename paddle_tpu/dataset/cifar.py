@@ -1,0 +1,27 @@
+"""CIFAR-10/100. Parity: python/paddle/dataset/cifar.py (synthetic
+fallback; images flattened 3*32*32 in [-1,1])."""
+from . import _synth
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+
+def train10():
+    return _synth.image_sampler('cifar10_train', 10, (3, 32, 32), 8192)
+
+
+def test10():
+    return _synth.image_sampler('cifar10_test', 10, (3, 32, 32), 1024,
+                                seed_salt=1)
+
+
+def train100():
+    return _synth.image_sampler('cifar100_train', 100, (3, 32, 32), 8192)
+
+
+def test100():
+    return _synth.image_sampler('cifar100_test', 100, (3, 32, 32), 1024,
+                                seed_salt=1)
+
+
+def fetch():
+    pass

@@ -1,0 +1,67 @@
+"""Dataset cache helpers. Parity: python/paddle/dataset/common.py (download
+is gated: zero-egress environment)."""
+import hashlib
+import os
+
+from ._synth import DATA_HOME
+
+__all__ = ['DATA_HOME', 'download', 'md5file', 'split', 'cluster_files_reader']
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, url.split('/')[-1] if save_name is None else save_name)
+    if os.path.exists(filename) and (md5sum is None or
+                                     md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        "paddle_tpu runs in a zero-egress environment: cannot download %s. "
+        "Place the file at %s or rely on the synthetic dataset fallback."
+        % (url, filename))
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    import pickle
+    dumper = dumper or pickle.dump
+    lines = []
+    indx_f = 0
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+                lines = []
+                indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    import glob
+    import pickle
+    loader = loader or pickle.load
+
+    def reader():
+        file_list = glob.glob(files_pattern)
+        file_list.sort()
+        my_file_list = []
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                my_file_list.append(fn)
+        for fn in my_file_list:
+            with open(fn, "rb") as f:
+                lines = loader(f)
+                for line in lines:
+                    yield line
+    return reader

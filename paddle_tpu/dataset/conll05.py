@@ -1,0 +1,57 @@
+"""CoNLL-05 SRL. Parity: python/paddle/dataset/conll05.py (synthetic
+fallback with the same 8-slot schema + BIO label space)."""
+import numpy as np
+
+from . import _synth
+
+__all__ = ['get_dict', 'get_embedding', 'test']
+
+_WORD_VOCAB = 44068
+_PRED_VOCAB = 3162
+_LABEL_COUNT = 59
+_MARK_DICT_LEN = 2
+
+
+def get_dict():
+    word_dict = {('w%d' % i): i for i in range(_WORD_VOCAB)}
+    verb_dict = {('v%d' % i): i for i in range(_PRED_VOCAB)}
+    label_dict = {('l%d' % i): i for i in range(_LABEL_COUNT)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    return _synth.rng('conll05_emb').rand(_WORD_VOCAB, 32).astype('float32')
+
+
+def _sampler(name, n, salt=0):
+    def reader():
+        r = _synth.rng(name, salt)
+        for _ in range(n):
+            length = int(r.randint(5, 30))
+            word = [int(w) for w in r.randint(0, _WORD_VOCAB, size=length)]
+            pred_idx = int(r.randint(length))
+            predicate = [int(r.randint(0, _PRED_VOCAB))] * length
+            mark = [1 if i == pred_idx else 0 for i in range(length)]
+            # label depends on distance to predicate: learnable
+            label = [int(min(_LABEL_COUNT - 1, abs(i - pred_idx)))
+                     for i in range(length)]
+            ctx_n2 = [word[max(0, pred_idx - 2)]] * length
+            ctx_n1 = [word[max(0, pred_idx - 1)]] * length
+            ctx_0 = [word[pred_idx]] * length
+            ctx_p1 = [word[min(length - 1, pred_idx + 1)]] * length
+            ctx_p2 = [word[min(length - 1, pred_idx + 2)]] * length
+            yield word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, \
+                predicate, mark, label
+    return reader
+
+
+def test():
+    return _sampler('conll05_test', 1024, salt=1)
+
+
+def train():
+    return _sampler('conll05_train', 4096)
+
+
+def fetch():
+    pass

@@ -1,0 +1,23 @@
+"""Flowers-102. Parity: python/paddle/dataset/flowers.py (synthetic
+fallback; 3x224x224 images)."""
+from . import _synth
+
+__all__ = ['train', 'test', 'valid']
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synth.image_sampler('flowers_train', 102, (3, 224, 224), 2048)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synth.image_sampler('flowers_test', 102, (3, 224, 224), 256,
+                                seed_salt=1)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synth.image_sampler('flowers_valid', 102, (3, 224, 224), 256,
+                                seed_salt=2)
+
+
+def fetch():
+    pass

@@ -1,0 +1,31 @@
+"""IMDB sentiment. Parity: python/paddle/dataset/imdb.py (synthetic
+fallback: 2-class Zipfian token sequences)."""
+from . import _synth
+
+__all__ = ['build_dict', 'train', 'test', 'word_dict']
+
+_VOCAB = 5148
+
+
+def word_dict():
+    return {('w%d' % i): i for i in range(_VOCAB)}
+
+
+def build_dict(pattern=None, cutoff=None):
+    return word_dict()
+
+
+def train(word_idx):
+    n = len(word_idx)
+    return _synth.seq_sampler('imdb_train', n, 2, 4096, min_len=10,
+                              max_len=120)
+
+
+def test(word_idx):
+    n = len(word_idx)
+    return _synth.seq_sampler('imdb_test', n, 2, 512, min_len=10,
+                              max_len=120, seed_salt=1)
+
+
+def fetch():
+    pass

@@ -1,0 +1,37 @@
+"""PTB-style LM dataset. Parity: python/paddle/dataset/imikolov.py
+(synthetic fallback: Markov-ish id stream over a fixed vocab)."""
+from . import _synth
+
+__all__ = ['build_dict', 'train', 'test']
+
+N_VOCAB = 2074
+
+
+def build_dict(min_word_freq=50):
+    return {('w%d' % i): i for i in range(N_VOCAB)}
+
+
+def _ngram_sampler(name, word_idx, n, count, salt=0):
+    vocab = len(word_idx)
+
+    def reader():
+        r = _synth.rng(name, salt)
+        for _ in range(count):
+            # deterministic-ish chain: next word depends on prev word
+            seq = [int(r.randint(vocab))]
+            for _i in range(n - 1):
+                seq.append(int((seq[-1] * 31 + 7) % vocab))
+            yield tuple(seq)
+    return reader
+
+
+def train(word_idx, n):
+    return _ngram_sampler('imikolov_train', word_idx, n, 8192)
+
+
+def test(word_idx, n):
+    return _ngram_sampler('imikolov_test', word_idx, n, 1024, salt=1)
+
+
+def fetch():
+    pass

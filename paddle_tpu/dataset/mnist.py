@@ -1,0 +1,18 @@
+"""MNIST. Parity: python/paddle/dataset/mnist.py (synthetic fallback:
+class-conditional 28x28 templates; see _synth.py)."""
+from . import _synth
+
+__all__ = ['train', 'test']
+
+
+def train():
+    return _synth.image_sampler('mnist_train', 10, (1, 28, 28), 8192)
+
+
+def test():
+    return _synth.image_sampler('mnist_test', 10, (1, 28, 28), 1024,
+                                seed_salt=1)
+
+
+def fetch():
+    pass

@@ -1,0 +1,78 @@
+"""MovieLens-1M. Parity: python/paddle/dataset/movielens.py (synthetic
+fallback with the same field schema)."""
+from . import _synth
+
+__all__ = ['train', 'test', 'get_movie_title_dict', 'max_movie_id',
+           'max_user_id', 'max_job_id', 'age_table', 'movie_categories',
+           'user_info', 'movie_info']
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_N_USERS = 6040
+_N_MOVIES = 3952
+_N_JOBS = 21
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 5175
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {('cat%d' % i): i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {('t%d' % i): i for i in range(_TITLE_VOCAB)}
+
+
+def _sampler(name, n, salt=0):
+    def reader():
+        r = _synth.rng(name, salt)
+        for _ in range(n):
+            user_id = int(r.randint(1, _N_USERS + 1))
+            gender = int(r.randint(0, 2))
+            age = int(r.randint(0, len(age_table)))
+            job = int(r.randint(0, _N_JOBS))
+            movie_id = int(r.randint(1, _N_MOVIES + 1))
+            n_cat = int(r.randint(1, 4))
+            categories = [int(c) for c in
+                          r.randint(0, _N_CATEGORIES, size=n_cat)]
+            n_title = int(r.randint(2, 6))
+            title = [int(t) for t in r.randint(0, _TITLE_VOCAB,
+                                               size=n_title)]
+            # learnable signal: score correlates with (user+movie) parity
+            base = 3.0 + ((user_id + movie_id) % 5 - 2) * 0.8
+            score = float(min(5.0, max(1.0, base + 0.3 * r.randn())))
+            yield [user_id], [gender], [age], [job], [movie_id], \
+                categories, title, [score]
+    return reader
+
+
+def train():
+    return _sampler('movielens_train', 8192)
+
+
+def test():
+    return _sampler('movielens_test', 1024, salt=1)
+
+
+def user_info():
+    return {}
+
+
+def movie_info():
+    return {}
+
+
+def fetch():
+    pass

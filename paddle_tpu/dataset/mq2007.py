@@ -1,0 +1,39 @@
+"""MQ2007 learning-to-rank. Parity: python/paddle/dataset/mq2007.py
+(synthetic fallback: 46-dim feature vectors with graded relevance)."""
+import numpy as np
+
+from . import _synth
+
+__all__ = ['train', 'test']
+
+_W = _synth.rng('mq2007_w').randn(46).astype('float32')
+
+
+def _sampler(name, n, salt=0, format="pairwise"):
+    def reader():
+        r = _synth.rng(name, salt)
+        for _ in range(n):
+            if format == "pairwise":
+                a = r.randn(46).astype('float32')
+                b = r.randn(46).astype('float32')
+                if a @ _W < b @ _W:
+                    a, b = b, a
+                yield 1, a, b
+            else:
+                x = r.randn(46).astype('float32')
+                score = float(x @ _W)
+                rel = int(np.clip(round(score + 1), 0, 2))
+                yield rel, x
+    return reader
+
+
+def train(format="pairwise"):
+    return _sampler('mq2007_train', 4096, format=format)
+
+
+def test(format="pairwise"):
+    return _sampler('mq2007_test', 512, salt=1, format=format)
+
+
+def fetch():
+    pass

@@ -1,0 +1,27 @@
+"""Movie-review sentiment. Parity: python/paddle/dataset/sentiment.py."""
+from . import _synth
+
+__all__ = ['get_word_dict', 'train', 'test']
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+_VOCAB = 8192
+
+
+def get_word_dict():
+    return [('w%d' % i, i) for i in range(_VOCAB)]
+
+
+def train():
+    return _synth.seq_sampler('sentiment_train', _VOCAB, 2,
+                              NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _synth.seq_sampler('sentiment_test', _VOCAB, 2,
+                              NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES,
+                              seed_salt=1)
+
+
+def fetch():
+    pass

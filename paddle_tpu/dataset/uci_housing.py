@@ -1,0 +1,35 @@
+"""UCI housing. Parity: python/paddle/dataset/uci_housing.py (synthetic
+fallback: fixed 13-dim linear model + noise, normalized features)."""
+import numpy as np
+
+from . import _synth
+
+__all__ = ['train', 'test']
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+_W = _synth.rng('uci_housing_w').randn(13).astype('float32')
+_B = 22.5
+
+
+def _sampler(n, salt):
+    def reader():
+        r = _synth.rng('uci_housing', salt)
+        for _ in range(n):
+            x = r.randn(13).astype('float32')
+            y = float(x @ _W + _B / 22.5 + 0.05 * r.randn())
+            yield x, np.array([y], dtype='float32')
+    return reader
+
+
+def train():
+    return _sampler(404, 0)
+
+
+def test():
+    return _sampler(102, 1)
+
+
+def fetch():
+    pass

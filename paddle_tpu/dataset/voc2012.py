@@ -1,0 +1,33 @@
+"""VOC2012 segmentation. Parity: python/paddle/dataset/voc2012.py
+(synthetic fallback: image + integer mask pairs)."""
+import numpy as np
+
+from . import _synth
+
+__all__ = ['train', 'test', 'val']
+
+
+def _sampler(name, n, salt=0):
+    def reader():
+        r = _synth.rng(name, salt)
+        for _ in range(n):
+            img = r.rand(3, 64, 64).astype('float32')
+            label = (img.sum(0) > 1.5).astype('int32')
+            yield img, label
+    return reader
+
+
+def train():
+    return _sampler('voc2012_train', 512)
+
+
+def test():
+    return _sampler('voc2012_test', 128, salt=1)
+
+
+def val():
+    return _sampler('voc2012_val', 128, salt=2)
+
+
+def fetch():
+    pass

@@ -1,0 +1,32 @@
+"""WMT-14 en-fr. Parity: python/paddle/dataset/wmt14.py (synthetic
+fallback: deterministic token mapping, see _synth.translation_sampler)."""
+from . import _synth
+
+__all__ = ['train', 'test', 'get_dict']
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+def train(dict_size):
+    return _synth.translation_sampler('wmt14_train', dict_size, 8192)
+
+
+def test(dict_size):
+    return _synth.translation_sampler('wmt14_test', dict_size, 512,
+                                      seed_salt=1)
+
+
+def get_dict(dict_size, reverse=False):
+    src = {('s%d' % i): i for i in range(dict_size)}
+    trg = {('t%d' % i): i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def fetch():
+    pass

@@ -1,0 +1,34 @@
+"""WMT-16 en-de (multimodal task subset). Parity:
+python/paddle/dataset/wmt16.py."""
+from . import _synth
+
+__all__ = ['train', 'test', 'validation', 'get_dict', 'fetch']
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _synth.translation_sampler('wmt16_train',
+                                      min(src_dict_size, trg_dict_size),
+                                      8192)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _synth.translation_sampler('wmt16_test',
+                                      min(src_dict_size, trg_dict_size),
+                                      512, seed_salt=1)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _synth.translation_sampler('wmt16_valid',
+                                      min(src_dict_size, trg_dict_size),
+                                      512, seed_salt=2)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {('%s%d' % (lang, i)): i for i in range(dict_size)}
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d
+
+
+def fetch():
+    pass

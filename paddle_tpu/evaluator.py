@@ -1,0 +1,137 @@
+"""Evaluator classes (deprecated in the reference in favor of metrics; kept
+for book-script parity). Parity: python/paddle/fluid/evaluator.py."""
+import numpy as np
+
+from . import layers
+from .framework import Program, Variable, program_guard
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from . import unique_name
+
+__all__ = ['ChunkEvaluator', 'EditDistance', 'DetectionMAP', 'Evaluator']
+
+
+def _clone_var_(block, var):
+    return block.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                            lod_level=var.lod_level, persistable=True)
+
+
+class Evaluator(object):
+    """Accumulates per-batch statistics into persistable state vars."""
+
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        import jax.numpy as jnp
+        from .executor import global_scope
+        for var in self.states:
+            global_scope().set_var(
+                var.name, jnp.zeros([int(s) for s in var.shape],
+                                    dtype=var.dtype if var.dtype !=
+                                    'int64' else 'int32'))
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def create_state(self, suffix, dtype, shape):
+        state = self.helper.create_variable(
+            name="_".join([unique_name.generate(self.helper.name), suffix]),
+            persistable=True, dtype=dtype, shape=tuple(shape))
+        self.states.append(state)
+        return state
+
+
+class ChunkEvaluator(Evaluator):
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super(ChunkEvaluator, self).__init__("chunk_eval")
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.num_infer_chunks = self.create_state(
+            dtype='int64', shape=[1], suffix='num_infer_chunks')
+        self.num_label_chunks = self.create_state(
+            dtype='int64', shape=[1], suffix='num_label_chunks')
+        self.num_correct_chunks = self.create_state(
+            dtype='int64', shape=[1], suffix='num_correct_chunks')
+        precision, recall, f1_score, num_infer_chunks, num_label_chunks, \
+            num_correct_chunks = layers.chunk_eval(
+                input=input, label=label, chunk_scheme=chunk_scheme,
+                num_chunk_types=num_chunk_types,
+                excluded_chunk_types=excluded_chunk_types)
+        layers.sums(input=[self.num_infer_chunks, num_infer_chunks],
+                    out=self.num_infer_chunks)
+        layers.sums(input=[self.num_label_chunks, num_label_chunks],
+                    out=self.num_label_chunks)
+        layers.sums(input=[self.num_correct_chunks, num_correct_chunks],
+                    out=self.num_correct_chunks)
+        self.metrics.extend([precision, recall, f1_score])
+
+    def eval(self, executor, eval_program=None):
+        from .executor import global_scope, as_numpy
+        num_infer_chunks = float(
+            np.asarray(as_numpy(global_scope().find_var(
+                self.num_infer_chunks.name))).sum())
+        num_label_chunks = float(
+            np.asarray(as_numpy(global_scope().find_var(
+                self.num_label_chunks.name))).sum())
+        num_correct_chunks = float(
+            np.asarray(as_numpy(global_scope().find_var(
+                self.num_correct_chunks.name))).sum())
+        precision = num_correct_chunks / num_infer_chunks \
+            if num_infer_chunks else 0
+        recall = num_correct_chunks / num_label_chunks \
+            if num_label_chunks else 0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if num_correct_chunks else 0
+        return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super(EditDistance, self).__init__("edit_distance", **kwargs)
+        self.total_distance = self.create_state(
+            dtype='float32', shape=[1], suffix='total_distance')
+        self.seq_num = self.create_state(dtype='int64', shape=[1],
+                                         suffix='seq_num')
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens)
+        total = layers.reduce_sum(distances)
+        layers.sums(input=[self.total_distance, total],
+                    out=self.total_distance)
+        layers.sums(input=[self.seq_num, seq_num], out=self.seq_num)
+        self.metrics.append(distances)
+
+    def eval(self, executor, eval_program=None):
+        from .executor import global_scope, as_numpy
+        total = float(np.asarray(as_numpy(global_scope().find_var(
+            self.total_distance.name))).sum())
+        n = float(np.asarray(as_numpy(global_scope().find_var(
+            self.seq_num.name))).sum())
+        return np.array([total / max(n, 1.0)])
+
+
+class DetectionMAP(Evaluator):
+    def __init__(self, input, gt_label, gt_box, class_num,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version='integral'):
+        super(DetectionMAP, self).__init__("map_eval")
+        label = layers.concat([gt_label, gt_box], axis=1)
+        map_out = layers.detection_map(input, label, class_num,
+                                       ap_version=ap_version)
+        self.cur_map = map_out
+        self.accum_map = self.create_state(
+            dtype='float32', shape=[1], suffix='accum_map')
+        layers.sums(input=[self.accum_map, map_out], out=self.accum_map)
+
+    def get_map_var(self):
+        return self.cur_map, self.accum_map
+
+    def eval(self, executor, eval_program=None):
+        from .executor import global_scope, as_numpy
+        return np.asarray(as_numpy(global_scope().find_var(
+            self.accum_map.name)))

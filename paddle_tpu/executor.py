@@ -1,0 +1,194 @@
+"""Executor / Scope.
+
+Parity: python/paddle/fluid/executor.py (Executor.run, global_scope,
+scope_guard, fetch_var) and paddle/fluid/framework/{executor.cc,scope.cc}.
+
+TPU design: ``run`` fingerprints (program, feed signature, fetch list) and
+compiles the whole block once via :mod:`paddle_tpu.core.lowering`; repeat
+steps hit the executable cache. Persistable state (parameters, optimizer
+accumulators, BN moving stats, step counters, PRNG key) flows through the
+executable as donated buffers, so a training step is a single device
+computation with no host round-trips.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .framework import Program, Variable, default_main_program
+from .core import places as _places
+from .core.lowering import lower_block, runtime_dtype, RNG_KEY
+from .lod import SequenceTensor
+
+__all__ = ['Executor', 'global_scope', 'scope_guard', 'switch_scope',
+           'fetch_var', 'as_numpy']
+
+
+class Scope(object):
+    """name -> runtime value (jax array / SequenceTensor). Parity: Scope."""
+
+    def __init__(self, parent=None):
+        self.vars = {}
+        self.parent = parent
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def set_var(self, name, value):
+        self.vars[name] = value
+
+    def new_scope(self):
+        return Scope(parent=self)
+
+    def drop_kids(self):
+        pass
+
+    def keys(self):
+        return self.vars.keys()
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def switch_scope(scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    return prev
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    prev = switch_scope(scope)
+    yield
+    switch_scope(prev)
+
+
+def as_numpy(value):
+    if isinstance(value, SequenceTensor):
+        return SequenceTensor(np.asarray(value.data),
+                              np.asarray(value.lengths),
+                              None if value.sub_lengths is None
+                              else np.asarray(value.sub_lengths))
+    if isinstance(value, (list, tuple)):
+        return [as_numpy(v) for v in value]
+    return np.asarray(value)
+
+
+def fetch_var(name, scope=None, return_numpy=True):
+    scope = scope or global_scope()
+    val = scope.find_var(name)
+    if return_numpy and val is not None:
+        return as_numpy(val)
+    return val
+
+
+def _spec(val):
+    if isinstance(val, SequenceTensor):
+        return ('seq', tuple(val.data.shape), str(val.data.dtype),
+                val.sub_lengths is not None)
+    arr = np.asarray(val) if not hasattr(val, 'shape') else val
+    return (tuple(arr.shape), str(arr.dtype))
+
+
+class Executor(object):
+    def __init__(self, place=None):
+        self.place = place or _places.TPUPlace(0)
+        self._cache = {}
+
+    # -------------------------------------------------------------------------
+    def _prepare_feed(self, program, feed):
+        block = program.global_block()
+        out = {}
+        for name, val in feed.items():
+            var = block._find_var_recursive(name)
+            if isinstance(val, SequenceTensor):
+                data = np.asarray(val.data)
+                dt = runtime_dtype(var.dtype if var else data.dtype)
+                out[name] = SequenceTensor(
+                    data.astype(dt), np.asarray(val.lengths, np.int32),
+                    None if val.sub_lengths is None
+                    else np.asarray(val.sub_lengths, np.int32))
+            else:
+                arr = np.asarray(val)
+                dt = runtime_dtype(var.dtype if var else arr.dtype)
+                out[name] = arr.astype(dt)
+        return out
+
+    def _state_names(self, program, scope):
+        names_in, names_out = [], set()
+        for b in program.blocks:
+            for v in b.vars.values():
+                if v.persistable and scope.find_var(v.name) is not None:
+                    names_in.append(v.name)
+            for op in b.ops:
+                for n in op.output_arg_names:
+                    var = b._find_var_recursive(n)
+                    if var is not None and var.persistable:
+                        names_out.add(n)
+        names_in = sorted(set(names_in))
+        names_out = sorted(names_out | set(names_in))
+        return names_in, names_out
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name='feed', fetch_var_name='fetch', scope=None,
+            return_numpy=True, use_program_cache=True):
+        if program is None:
+            program = default_main_program()
+        if not isinstance(program, Program):
+            raise TypeError("Executor requires Program as its Parameter. But "
+                            "you passed in %s" % type(program))
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+        feed = self._prepare_feed(program, feed)
+        state_in_names, state_out_names = self._state_names(program, scope)
+        if scope.find_var(RNG_KEY) is None:
+            scope.set_var(RNG_KEY,
+                          jax.random.PRNGKey(program.random_seed or 0))
+        state_in_names = sorted(set(state_in_names) | {RNG_KEY})
+        state_out_names = sorted(set(state_out_names) | {RNG_KEY})
+
+        key = (program.fingerprint(),
+               tuple(sorted((n, _spec(v)) for n, v in feed.items())),
+               tuple(fetch_names), tuple(state_in_names),
+               tuple(state_out_names))
+        entry = self._cache.get(key)
+        if entry is None:
+            fn = lower_block(program, program.global_block(),
+                             sorted(feed.keys()), fetch_names,
+                             state_in_names, state_out_names)
+            jitted = jax.jit(fn, donate_argnums=(1,))
+            self._cache[key] = jitted
+        else:
+            jitted = entry
+
+        state = {n: scope.find_var(n) for n in state_in_names}
+
+        with jax.default_device(self.place.jax_device()):
+            fetches, new_state = jitted(feed, state)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            fetches = [as_numpy(f) for f in fetches]
+        return fetches
+
+    def close(self):
+        self._cache.clear()

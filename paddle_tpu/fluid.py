@@ -1,0 +1,31 @@
+"""`paddle_tpu.fluid` — the fluid-compatible namespace.
+
+Reference scripts do `import paddle.fluid as fluid`; with paddle_tpu:
+`import paddle_tpu.fluid as fluid` (or `from paddle_tpu import fluid`).
+"""
+from . import (framework, layers, initializer, regularizer, clip, optimizer,  # noqa
+               backward, unique_name, io, nets, metrics, evaluator, average,
+               profiler)
+from .framework import (Program, Block, Variable, Operator,  # noqa
+                        default_startup_program, default_main_program,
+                        program_guard, switch_startup_program,
+                        switch_main_program, get_var)
+from .core.places import (TPUPlace, CPUPlace, CUDAPlace, CUDAPinnedPlace,  # noqa
+                          is_compiled_with_cuda, is_compiled_with_tpu)
+from .executor import (Executor, global_scope, scope_guard, switch_scope,  # noqa
+                       fetch_var)
+from .backward import append_backward  # noqa
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa
+from .data_feeder import DataFeeder  # noqa
+from .lod import (SequenceTensor, create_lod_tensor,  # noqa
+                  create_random_int_lodtensor)
+from .parallel.parallel_executor import ParallelExecutor  # noqa
+from .parallel.transpiler import (DistributeTranspiler,  # noqa
+                                  InferenceTranspiler, memory_optimize,
+                                  release_memory)
+from .clip import (ErrorClipByValue, GradientClipByValue,  # noqa
+                   GradientClipByNorm, GradientClipByGlobalNorm)
+from .initializer import init_on_cpu  # noqa
+from .recordio_writer import (convert_reader_to_recordio_file,  # noqa
+                              convert_reader_to_recordio_files)
+LoDTensor = SequenceTensor

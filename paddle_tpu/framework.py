@@ -1,0 +1,513 @@
+"""Program / Block / Variable / Operator IR.
+
+Parity: python/paddle/fluid/framework.py and the C++ ProgramDesc/BlockDesc/
+OpDesc/VarDesc stack (paddle/fluid/framework/{program_desc,block_desc,op_desc,
+var_desc}.h) in the reference.
+
+TPU-first design notes
+----------------------
+The reference keeps the IR as protobuf descs and executes op-by-op through a
+DeviceContext. Here the IR is a lightweight Python graph whose only consumer is
+the lowering pass (``paddle_tpu.core.lowering``) that traces an entire block
+into ONE jitted XLA computation. Consequences:
+
+* No per-op kernel dispatch at runtime; XLA fuses across op boundaries.
+* ``Operator`` carries no kernel state — it is a pure description
+  (type, input/output var names per slot, attrs, optional sub-block).
+* Shapes may contain -1 (batch); concrete shapes come from the feed at
+  lowering time, and the compiled executable is cached per shape signature.
+"""
+import collections
+import contextlib
+import copy
+import hashlib
+import json
+
+import numpy as np
+
+from . import unique_name
+
+__all__ = [
+    'Program', 'Block', 'Variable', 'Operator', 'Parameter',
+    'default_startup_program', 'default_main_program', 'program_guard',
+    'switch_startup_program', 'switch_main_program', 'get_var',
+    'grad_var_name', 'convert_np_dtype',
+]
+
+GRAD_VAR_SUFFIX = '@GRAD'
+ZERO_VAR_SUFFIX = '@ZERO'
+
+_NP_DTYPE = {
+    'float16': np.float16, 'float32': np.float32, 'float64': np.float64,
+    'bfloat16': 'bfloat16', 'int8': np.int8, 'int16': np.int16,
+    'int32': np.int32, 'int64': np.int64, 'uint8': np.uint8, 'bool': np.bool_,
+}
+
+
+def grad_var_name(name):
+    return name + GRAD_VAR_SUFFIX
+
+
+def convert_np_dtype(dtype):
+    """Normalize a dtype spec (str, np.dtype, jnp dtype) to canonical string."""
+    if dtype is None:
+        return 'float32'
+    if isinstance(dtype, str):
+        if dtype in _NP_DTYPE:
+            return dtype
+        return np.dtype(dtype).name
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = getattr(dtype, '__name__', str(dtype))
+    if name == 'bfloat16' or 'bfloat16' in str(dtype):
+        return 'bfloat16'
+    return name
+
+
+class Variable(object):
+    """A symbolic tensor in a Block.
+
+    Parity: fluid.framework.Variable (VarDesc). ``lod_level > 0`` marks a
+    ragged sequence: at runtime it binds to a
+    :class:`paddle_tpu.lod.SequenceTensor` (dense padded data + lengths)
+    rather than the reference's LoD offset representation — padded-and-masked
+    is the layout XLA can tile onto the MXU.
+    """
+
+    def __init__(self, block, name=None, shape=None, dtype='float32',
+                 lod_level=0, persistable=False, stop_gradient=False,
+                 is_data=False, initializer=None, type=None, **kwargs):
+        self.block = block
+        if name is None:
+            name = unique_name.generate('_generated_var')
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else ()
+        self.dtype = convert_np_dtype(dtype)
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.type = type or 'lod_tensor'
+        self.op = None           # defining op (set by append_op)
+        self.sharding = kwargs.get('sharding', None)  # PartitionSpec-like tuple
+        self.error_clip = kwargs.get('error_clip', None)
+
+    # ---- fluid-compatible sugar -------------------------------------------------
+    def astype(self, dtype):
+        from .layers import tensor as _t
+        return _t.cast(self, dtype)
+
+    @property
+    def grad_name(self):
+        return grad_var_name(self.name)
+
+    def set_sharding(self, spec):
+        """Attach a PartitionSpec-like tuple (mesh axis names per dim)."""
+        self.sharding = tuple(spec)
+        return self
+
+    def to_string(self, throw_on_error=False):
+        return "Variable(name=%s, shape=%s, dtype=%s, lod=%d%s)" % (
+            self.name, self.shape, self.dtype, self.lod_level,
+            ', persistable' if self.persistable else '')
+
+    __repr__ = __str__ = to_string
+
+    def _desc(self):
+        return (self.name, self.shape, self.dtype, self.lod_level,
+                self.persistable, self.stop_gradient)
+
+
+class Parameter(Variable):
+    """A trainable persistable Variable.
+
+    Parity: fluid.framework.Parameter. Carries optimize/regularizer/clip
+    attributes consumed by ``paddle_tpu.optimizer`` and ``paddle_tpu.clip``.
+    """
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        if shape is None or len(shape) == 0:
+            raise ValueError("Parameter shape cannot be empty")
+        for d in shape:
+            if d < 0:
+                raise ValueError("Parameter shape must be static, got %s"
+                                 % (shape,))
+        kwargs.setdefault('persistable', True)
+        super(Parameter, self).__init__(block, shape=shape, dtype=dtype,
+                                        **kwargs)
+        self.trainable = kwargs.get('trainable', True)
+        self.optimize_attr = kwargs.get('optimize_attr', {'learning_rate': 1.0})
+        self.regularizer = kwargs.get('regularizer', None)
+        self.gradient_clip_attr = kwargs.get('gradient_clip_attr', None)
+        self.do_model_average = kwargs.get('do_model_average', None)
+
+
+class Operator(object):
+    """Pure op description: type, slot->var-names maps, attrs, sub-blocks.
+
+    Parity: fluid.framework.Operator / OpDesc. Kernels live in
+    ``paddle_tpu.ops`` keyed by ``type`` and are only consulted at lowering.
+    """
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        self.inputs = {}   # slot -> [var name]
+        self.outputs = {}
+        self.attrs = dict(attrs or {})
+
+        def _names(v):
+            if v is None:
+                return []
+            if not isinstance(v, (list, tuple)):
+                v = [v]
+            out = []
+            for item in v:
+                out.append(item.name if isinstance(item, Variable) else item)
+            return out
+
+        for slot, v in (inputs or {}).items():
+            self.inputs[slot] = _names(v)
+        for slot, v in (outputs or {}).items():
+            names = _names(v)
+            self.outputs[slot] = names
+            for item in (v if isinstance(v, (list, tuple)) else [v]):
+                if isinstance(item, Variable):
+                    item.op = self
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self):
+        return [n for ns in self.inputs.values() for n in ns]
+
+    @property
+    def output_arg_names(self):
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def set_attr(self, name, val):
+        self.attrs[name] = val
+        self.block.program._bump_version()
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def _desc(self):
+        def _clean(a):
+            out = {}
+            for k, v in sorted(a.items()):
+                if isinstance(v, np.ndarray):
+                    out[k] = ('ndarray', v.shape, str(v.dtype),
+                              hashlib.md5(v.tobytes()).hexdigest())
+                elif isinstance(v, Block):
+                    out[k] = ('block', v.idx)
+                elif callable(v):
+                    out[k] = ('callable', getattr(v, '__name__', 'fn'))
+                else:
+                    out[k] = v
+            return out
+        return (self.type, sorted(self.inputs.items()),
+                sorted(self.outputs.items()), _clean(self.attrs))
+
+    def __repr__(self):
+        return "{%s: %s -> %s}" % (self.type, self.inputs, self.outputs)
+
+
+class Block(object):
+    """An ordered list of Operators plus a symbol table of Variables.
+
+    Parity: fluid.framework.Block / BlockDesc. Sub-blocks (control flow,
+    RNN step blocks) reference their parent for symbol lookup.
+    """
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = collections.OrderedDict()
+        self.ops = []
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.block(self.parent_idx)
+
+    # ---- variables --------------------------------------------------------------
+    def create_var(self, **kwargs):
+        name = kwargs.get('name')
+        if name is not None and name in self.vars:
+            return self.vars[name]
+        var = Variable(self, **kwargs)
+        self.vars[var.name] = var
+        self.program._bump_version()
+        return var
+
+    def create_parameter(self, **kwargs):
+        global_block = self.program.global_block()
+        param = Parameter(global_block, **kwargs)
+        global_block.vars[param.name] = param
+        self.program._bump_version()
+        return param
+
+    def var(self, name):
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise ValueError("Variable %r not found in block %d" %
+                             (name, self.idx))
+        return v
+
+    def has_var(self, name):
+        return self._find_var_recursive(name) is not None
+
+    def has_var_local(self, name):
+        return name in self.vars
+
+    def _find_var_recursive(self, name):
+        blk = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        return None
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def iter_parameters(self):
+        return iter(self.all_parameters())
+
+    # ---- ops --------------------------------------------------------------------
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs,
+                      attrs=attrs)
+        self.ops.append(op)
+        self.program._bump_version()
+        return op
+
+    def prepend_op(self, type=None, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs,
+                      attrs=attrs)
+        self.ops.insert(0, op)
+        self.program._bump_version()
+        return op
+
+    def insert_op(self, index, type=None, inputs=None, outputs=None,
+                  attrs=None):
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs,
+                      attrs=attrs)
+        self.ops.insert(index, op)
+        self.program._bump_version()
+        return op
+
+    def remove_op(self, index):
+        op = self.ops.pop(index)
+        self.program._bump_version()
+        return op
+
+    def _desc(self):
+        return (self.idx, self.parent_idx,
+                [v._desc() for v in self.vars.values()],
+                [op._desc() for op in self.ops])
+
+    def __repr__(self):
+        return "Block(%d) vars=%d ops=[%s]" % (
+            self.idx, len(self.vars), ", ".join(op.type for op in self.ops))
+
+
+class Program(object):
+    """A list of Blocks; block 0 is the global block.
+
+    Parity: fluid.framework.Program / ProgramDesc. ``clone(for_test=True)``
+    freezes train-only behavior (dropout, batch-norm stat updates) exactly as
+    the reference's ``inference_optimize`` does, by flipping ``is_test`` attrs.
+    """
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0
+        self._fingerprint_cache = None
+        self._op_role = 'forward'
+
+    # ---- structure --------------------------------------------------------------
+    def global_block(self):
+        return self.blocks[0]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def create_block(self, parent_idx=None):
+        new_idx = len(self.blocks)
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        self.blocks.append(Block(self, new_idx, parent_idx=parent))
+        self.current_block_idx = new_idx
+        self._bump_version()
+        return self.current_block()
+
+    def rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+        self._bump_version()
+
+    def _bump_version(self):
+        self._version += 1
+        self._fingerprint_cache = None
+
+    def fingerprint(self):
+        if self._fingerprint_cache is None or \
+                self._fingerprint_cache[0] != self._version:
+            desc = json.dumps([b._desc() for b in self.blocks],
+                              default=str, sort_keys=True)
+            h = hashlib.sha1(desc.encode()).hexdigest()
+            self._fingerprint_cache = (self._version, h)
+        return self._fingerprint_cache[1]
+
+    # ---- clone / prune ----------------------------------------------------------
+    def clone(self, for_test=False):
+        p = Program()
+        p.random_seed = self.random_seed
+        p.blocks = []
+        memo = {}
+        for b in self.blocks:
+            nb = Block(p, b.idx, b.parent_idx)
+            for name, v in b.vars.items():
+                nv = copy.copy(v)
+                nv.block = nb
+                nb.vars[name] = nv
+                memo[id(v)] = nv
+            p.blocks.append(nb)
+        for b, nb in zip(self.blocks, p.blocks):
+            for op in b.ops:
+                nop = Operator(nb, op.type)
+                nop.inputs = {k: list(v) for k, v in op.inputs.items()}
+                nop.outputs = {k: list(v) for k, v in op.outputs.items()}
+                nop.attrs = {}
+                for k, v in op.attrs.items():
+                    if isinstance(v, Block):
+                        nop.attrs[k] = p.blocks[v.idx]
+                    else:
+                        nop.attrs[k] = v
+                nb.ops.append(nop)
+        if for_test:
+            p._inference_optimize()
+        p._bump_version()
+        return p
+
+    def _inference_optimize(self):
+        for b in self.blocks:
+            for op in b.ops:
+                if 'is_test' in op.attrs:
+                    op.attrs['is_test'] = True
+                if op.type == 'dropout':
+                    op.attrs['is_test'] = True
+
+    def prune(self, targets):
+        """Keep only ops that (transitively) produce ``targets``.
+
+        Parity: Executor's prune before run / get_inference_program.
+        """
+        if not isinstance(targets, (list, tuple)):
+            targets = [targets]
+        target_names = set(t.name if isinstance(t, Variable) else t
+                           for t in targets)
+        block = self.global_block()
+        needed = set(target_names)
+        keep = []
+        for op in reversed(block.ops):
+            if op.type in ('backward_marker',):
+                continue
+            produced = set(op.output_arg_names)
+            if produced & needed:
+                keep.append(op)
+                needed |= set(op.input_arg_names)
+        keep.reverse()
+        p = self.clone()
+        nb = p.global_block()
+        keep_desc = set(id(self.global_block().ops[i])
+                        for i, op in enumerate(self.global_block().ops)
+                        if op in keep)
+        new_ops = []
+        for op, orig in zip(nb.ops, self.global_block().ops):
+            if id(orig) in keep_desc:
+                new_ops.append(op)
+        nb.ops = new_ops
+        p._bump_version()
+        return p
+
+    def list_vars(self):
+        for b in self.blocks:
+            for v in b.vars.values():
+                yield v
+
+    def __repr__(self):
+        return "Program(blocks=%d, ops=%s)" % (
+            len(self.blocks), [len(b.ops) for b in self.blocks])
+
+    def to_string(self, throw_on_error=False):
+        lines = []
+        for b in self.blocks:
+            lines.append("-- block %d (parent %d) --" % (b.idx, b.parent_idx))
+            for v in b.vars.values():
+                lines.append("  " + str(v))
+            for op in b.ops:
+                lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+
+# ---- default programs -----------------------------------------------------------
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_startup_program():
+    return _startup_program_
+
+
+def default_main_program():
+    return _main_program_
+
+
+def switch_main_program(program):
+    global _main_program_
+    prev = _main_program_
+    _main_program_ = program
+    return prev
+
+
+def switch_startup_program(program):
+    global _startup_program_
+    prev = _startup_program_
+    _startup_program_ = program
+    return prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    if not isinstance(main_program, Program):
+        raise TypeError("main_program must be a Program")
+    prev_main = switch_main_program(main_program)
+    prev_start = None
+    if startup_program is not None:
+        prev_start = switch_startup_program(startup_program)
+    yield
+    switch_main_program(prev_main)
+    if prev_start is not None:
+        switch_startup_program(prev_start)
+
+
+def get_var(name, program=None):
+    if program is None:
+        program = default_main_program()
+    return program.global_block().var(name)

@@ -1,0 +1,286 @@
+"""Model IO: save/load vars, params, persistables, inference model,
+checkpoints.
+
+Parity: python/paddle/fluid/io.py. Serialization: one ``.npz`` per call plus
+a JSON manifest for the inference program (the reference pickles ProgramDesc
+protobufs; we serialize the IR to JSON).
+"""
+import json
+import os
+import shutil
+
+import numpy as np
+
+from . import framework
+from .framework import Program, Parameter, Variable, default_main_program
+from .executor import global_scope, as_numpy
+
+__all__ = [
+    'save_vars', 'save_params', 'save_persistables', 'load_vars',
+    'load_params', 'load_persistables', 'save_inference_model',
+    'load_inference_model', 'get_inference_program', 'save_checkpoint',
+    'load_checkpoint', 'clean_checkpoint',
+]
+
+PARAMS_FILE = '__params__.npz'
+MODEL_FILE = '__model__.json'
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def _save_var_list(executor, dirname, var_names, scope=None, filename=None):
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for name in var_names:
+        val = scope.find_var(name)
+        if val is None:
+            continue
+        arrays[name] = np.asarray(as_numpy(val))
+    path = os.path.join(dirname, filename or PARAMS_FILE)
+    np.savez(path, **arrays)
+    return path
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if vars is None:
+        if main_program is None:
+            main_program = default_main_program()
+        if not isinstance(main_program, Program):
+            raise TypeError("program should be as Program type or None")
+        vars = list(filter(predicate, main_program.list_vars()))
+    names = [v.name if isinstance(v, Variable) else v for v in vars]
+    return _save_var_list(executor, dirname, names, filename=filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+def _load_npz(dirname, filename=None):
+    path = os.path.join(dirname, filename or PARAMS_FILE)
+    if not os.path.exists(path):
+        raise IOError("no saved parameters at %s" % path)
+    return np.load(path, allow_pickle=False)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+    if vars is None:
+        if main_program is None:
+            main_program = default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    data = _load_npz(dirname, filename)
+    scope = global_scope()
+    from .core.lowering import runtime_dtype
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else v
+        if name in data:
+            arr = data[name]
+            dt = runtime_dtype(str(arr.dtype))
+            scope.set_var(name, jnp.asarray(arr.astype(dt)))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+# ---- program serialization ------------------------------------------------------
+def _var_to_json(v):
+    return {'name': v.name, 'shape': list(v.shape), 'dtype': v.dtype,
+            'lod_level': v.lod_level, 'persistable': v.persistable,
+            'stop_gradient': v.stop_gradient, 'is_data': v.is_data,
+            'is_parameter': isinstance(v, Parameter)}
+
+
+def _op_to_json(op):
+    attrs = {}
+    for k, val in op.attrs.items():
+        if isinstance(val, framework.Block):
+            attrs[k] = {'__block__': val.idx}
+        elif isinstance(val, np.ndarray):
+            attrs[k] = {'__ndarray__': val.tolist(),
+                        'dtype': str(val.dtype)}
+        elif callable(val):
+            continue
+        else:
+            attrs[k] = val
+    return {'type': op.type, 'inputs': op.inputs, 'outputs': op.outputs,
+            'attrs': attrs}
+
+
+def program_to_json(program):
+    return {
+        'random_seed': program.random_seed,
+        'blocks': [{
+            'idx': b.idx, 'parent_idx': b.parent_idx,
+            'vars': [_var_to_json(v) for v in b.vars.values()],
+            'ops': [_op_to_json(op) for op in b.ops],
+        } for b in program.blocks]
+    }
+
+
+def program_from_json(data):
+    p = Program()
+    p.random_seed = data.get('random_seed', 0)
+    p.blocks = []
+    for bdata in data['blocks']:
+        b = framework.Block(p, bdata['idx'], bdata['parent_idx'])
+        p.blocks.append(b)
+    for b, bdata in zip(p.blocks, data['blocks']):
+        for vd in bdata['vars']:
+            cls = Parameter if vd.pop('is_parameter', False) else Variable
+            if cls is Parameter:
+                var = Parameter(b, shape=vd['shape'], dtype=vd['dtype'],
+                                name=vd['name'],
+                                persistable=vd['persistable'])
+                var.stop_gradient = vd['stop_gradient']
+            else:
+                var = Variable(b, **vd)
+            b.vars[var.name] = var
+        for od in bdata['ops']:
+            op = framework.Operator(b, od['type'])
+            op.inputs = od['inputs']
+            op.outputs = od['outputs']
+            attrs = {}
+            for k, val in od['attrs'].items():
+                if isinstance(val, dict) and '__block__' in val:
+                    attrs[k] = p.blocks[val['__block__']]
+                elif isinstance(val, dict) and '__ndarray__' in val:
+                    attrs[k] = np.asarray(val['__ndarray__'],
+                                          dtype=val['dtype'])
+                else:
+                    attrs[k] = val
+            op.attrs = attrs
+            b.ops.append(op)
+    p._bump_version()
+    return p
+
+
+def get_inference_program(target_vars, main_program=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    pruned = main_program.prune(target_vars)
+    pruned._inference_optimize()
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    if main_program is None:
+        main_program = default_main_program()
+    pruned = main_program.prune(target_vars)
+    pruned._inference_optimize()
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        'program': program_to_json(pruned),
+        'feed_names': feeded_var_names,
+        'fetch_names': [t.name for t in target_vars],
+    }
+    with open(os.path.join(dirname, model_filename or MODEL_FILE),
+              'w') as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned,
+                      filename=params_filename)
+    return [t.name for t in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or MODEL_FILE)) as f:
+        meta = json.load(f)
+    program = program_from_json(meta['program'])
+    load_persistables(executor, dirname, program,
+                      filename=params_filename)
+    fetch_vars = [program.global_block().var(n)
+                  for n in meta['fetch_names']]
+    return [program, meta['feed_names'], fetch_vars]
+
+
+# ---- checkpoints ----------------------------------------------------------------
+SUCCESS_MARK_FILENAME = "_SUCCESS"
+CHECKPOINT_PREFIX = "checkpoint"
+
+
+def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
+                    save_interval_secs=600, main_program=None):
+    if checkpoint_dir is None:
+        checkpoint_dir = os.getcwd()
+    serials = _get_checkpoint_serials(checkpoint_dir)
+    serial = (max(serials) + 1) if serials else 0
+    cur_dir = os.path.join(checkpoint_dir,
+                           "%s_%d" % (CHECKPOINT_PREFIX, serial))
+    save_persistables(executor, cur_dir, main_program)
+    open(os.path.join(cur_dir, SUCCESS_MARK_FILENAME), 'w').close()
+    serials = _get_checkpoint_serials(checkpoint_dir)
+    for s in sorted(serials)[:-max_num_checkpoints]:
+        shutil.rmtree(os.path.join(checkpoint_dir,
+                                   "%s_%d" % (CHECKPOINT_PREFIX, s)))
+    return cur_dir
+
+
+def load_checkpoint(executor, checkpoint_dir=None, serial=None,
+                    main_program=None):
+    if checkpoint_dir is None:
+        checkpoint_dir = os.getcwd()
+    serials = _get_checkpoint_serials(checkpoint_dir)
+    if not serials:
+        raise IOError("no checkpoints under %s" % checkpoint_dir)
+    serial = serial if serial is not None else max(serials)
+    cur_dir = os.path.join(checkpoint_dir,
+                           "%s_%d" % (CHECKPOINT_PREFIX, serial))
+    load_persistables(executor, cur_dir, main_program)
+    return cur_dir
+
+
+def clean_checkpoint(checkpoint_dir, delete_dir=False):
+    if checkpoint_dir is None:
+        checkpoint_dir = os.getcwd()
+    for s in _get_checkpoint_serials(checkpoint_dir):
+        shutil.rmtree(os.path.join(checkpoint_dir,
+                                   "%s_%d" % (CHECKPOINT_PREFIX, s)))
+    if delete_dir and not os.listdir(checkpoint_dir):
+        os.rmdir(checkpoint_dir)
+
+
+def _get_checkpoint_serials(checkpoint_dir):
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    serials = []
+    for d in os.listdir(checkpoint_dir):
+        if d.startswith(CHECKPOINT_PREFIX + "_"):
+            try:
+                s = int(d.split('_')[-1])
+            except ValueError:
+                continue
+            if os.path.exists(os.path.join(checkpoint_dir, d,
+                                           SUCCESS_MARK_FILENAME)):
+                serials.append(s)
+    return serials

@@ -1,0 +1,31 @@
+"""Layers API. Parity: python/paddle/fluid/layers/__init__.py."""
+from . import ops
+from .ops import *  # noqa
+from . import nn
+from .nn import *  # noqa
+from . import io
+from .io import *  # noqa
+from . import tensor
+from .tensor import *  # noqa
+from . import control_flow
+from .control_flow import *  # noqa
+from . import device
+from .device import *  # noqa
+from . import math_op_patch  # noqa
+from . import detection
+from .detection import *  # noqa
+from . import metric
+from .metric import *  # noqa
+from .learning_rate_scheduler import *  # noqa
+from . import learning_rate_scheduler
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += io.__all__
+__all__ += tensor.__all__
+__all__ += control_flow.__all__
+__all__ += ops.__all__
+__all__ += device.__all__
+__all__ += detection.__all__
+__all__ += metric.__all__
+__all__ += learning_rate_scheduler.__all__

@@ -1,0 +1,12 @@
+"""Device placement layer. Parity: python/paddle/fluid/layers/device.py.
+
+On the XLA path op-level device pinning is a no-op: the whole block compiles
+to the executor's place. Kept for API compatibility.
+"""
+__all__ = ['get_places']
+
+
+def get_places(device_count=None, device_type=None):
+    import jax
+    n = device_count or len(jax.devices())
+    return list(range(n))

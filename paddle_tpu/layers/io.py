@@ -1,0 +1,135 @@
+"""Data layer + program-level readers.
+
+Parity: python/paddle/fluid/layers/io.py. ``data`` declares a feed slot.
+Reader layers (open_recordio_file/open_files/shuffle/batch/double_buffer)
+map onto the native prefetching loader (paddle_tpu/native) driven from the
+host side; ``read_file`` binds its output slots as ordinary feeds filled by
+the Executor's reader plumbing.
+"""
+from ..layer_helper import LayerHelper
+from ..framework import Variable, default_main_program
+
+__all__ = ['data', 'BlockGuardServ', 'ListenAndServ', 'Send',
+           'open_recordio_file', 'open_files', 'read_file', 'shuffle',
+           'batch', 'double_buffer', 'Recv']
+
+
+def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
+         type=None, stop_gradient=True):
+    helper = LayerHelper('data', name=name)
+    shape = list(shape)
+    for i in range(len(shape)):
+        if shape[i] is None:
+            shape[i] = -1
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.create_global_variable(
+        name=name, shape=tuple(shape), dtype=dtype,
+        stop_gradient=stop_gradient, lod_level=lod_level, is_data=True)
+
+
+class ReaderVar(Variable):
+    """A host-side reader bound into the program (TPU-native: the reader
+    stays on host; Executor pulls batches and feeds the XLA program)."""
+    pass
+
+
+def _reader_var(helper, feed_vars, source=None):
+    var = ReaderVar(helper.main_program.global_block(),
+                    name=helper.name, shape=(), dtype='float32')
+    var.feed_vars = list(feed_vars)
+    var.source = source
+    var.decorators = []
+    helper.main_program.global_block().vars[var.name] = var
+    return var
+
+
+def open_recordio_file(filename, shapes, lod_levels, dtypes,
+                       pass_num=1, for_parallel=False):
+    from ..reader_io import RecordIOSource
+    helper = LayerHelper('open_recordio_file')
+    feed_vars = []
+    for i, (shape, dt, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+        feed_vars.append(helper.create_global_variable(
+            name='%s_slot_%d' % (helper.name, i), shape=tuple(shape),
+            dtype=dt, lod_level=lod, is_data=True))
+    return _reader_var(helper, feed_vars,
+                       RecordIOSource(filename, shapes, dtypes, lod_levels,
+                                      pass_num))
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
+               buffer_size=None, pass_num=1, for_parallel=False):
+    from ..reader_io import RecordIOSource
+    helper = LayerHelper('open_files')
+    feed_vars = []
+    for i, (shape, dt, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+        feed_vars.append(helper.create_global_variable(
+            name='%s_slot_%d' % (helper.name, i), shape=tuple(shape),
+            dtype=dt, lod_level=lod, is_data=True))
+    return _reader_var(helper, feed_vars,
+                       RecordIOSource(filenames, shapes, dtypes, lod_levels,
+                                      pass_num))
+
+
+def shuffle(reader, buffer_size):
+    reader.decorators.append(('shuffle', buffer_size))
+    return reader
+
+
+def batch(reader, batch_size):
+    reader.decorators.append(('batch', batch_size))
+    return reader
+
+
+def double_buffer(reader, place=None, name=None):
+    reader.decorators.append(('double_buffer', place))
+    return reader
+
+
+def read_file(file_obj):
+    """Returns the reader's data Variables; Executor.run feeds them from
+    the bound host reader each step."""
+    if len(file_obj.feed_vars) == 1:
+        return file_obj.feed_vars[0]
+    return list(file_obj.feed_vars)
+
+
+# ---- distributed shims (full impl in paddle_tpu/parallel/transpiler.py) ---------
+class BlockGuardServ(object):
+    def __init__(self, server):
+        self.server = server
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class ListenAndServ(object):
+    """Parity: layers/io.py::ListenAndServ (gRPC pserver loop). On the TPU
+    stack the pserver role is absorbed by sharded optimizer state; this shim
+    records the server program for the transpiler."""
+
+    def __init__(self, endpoint, inputs, fan_in=1, optimizer_mode=True):
+        self.endpoint = endpoint
+        self.inputs = inputs
+        self.fan_in = fan_in
+
+    def do(self):
+        return BlockGuardServ(self)
+
+
+def Send(endpoints, send_vars, get_vars=None):
+    """Parity: layers/io.py::Send (send op -> gRPC). Lowered to collective
+    ops by the distribute transpiler; as a layer it is a no-op marker."""
+    helper = LayerHelper('send')
+    helper.append_op(type='send_marker', inputs={'X': send_vars},
+                     outputs={'Out': get_vars or []},
+                     attrs={'endpoints': endpoints})
+    return get_vars
+
+
+def Recv(endpoints, get_vars):
+    return get_vars

@@ -1,0 +1,119 @@
+"""Auto-generated single-op layers.
+
+Parity: python/paddle/fluid/layers/ops.py + layer_function_generator.py —
+each name is a thin layer fn appending one op of the same type.
+"""
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+
+__activations__ = [
+    'sigmoid', 'logsigmoid', 'exp', 'relu', 'tanh', 'tanh_shrink',
+    'softshrink', 'sqrt', 'abs', 'ceil', 'floor', 'cos', 'sin', 'round',
+    'reciprocal', 'log', 'square', 'softplus', 'softsign', 'brelu',
+    'leaky_relu', 'soft_relu', 'elu', 'relu6', 'pow', 'stanh', 'hard_shrink',
+    'thresholded_relu', 'hard_sigmoid', 'swish',
+]
+
+__all__ = [
+    'mean', 'mul', 'scale', 'sigmoid_cross_entropy_with_logits',
+    'elementwise_add', 'elementwise_div', 'elementwise_sub',
+    'elementwise_mul', 'elementwise_max', 'elementwise_min',
+    'elementwise_pow', 'clip', 'clip_by_norm', 'logical_and', 'logical_or',
+    'logical_xor', 'logical_not', 'uniform_random',
+    'uniform_random_batch_size_like', 'gaussian_random',
+    'gaussian_random_batch_size_like', 'cumsum', 'scatter', 'sum', 'sign',
+] + __activations__
+
+_BINARY = {'elementwise_add', 'elementwise_div', 'elementwise_sub',
+           'elementwise_mul', 'elementwise_max', 'elementwise_min',
+           'elementwise_pow', 'logical_and', 'logical_or', 'logical_xor',
+           'mul'}
+
+_SLOT_MAP = {
+    'scatter': (('X', 'Ids', 'Updates'), 'Out'),
+    'sigmoid_cross_entropy_with_logits': (('X', 'Label'), 'Out'),
+}
+
+
+def _gen_layer(op_type):
+    def layer(*args, **kwargs):
+        helper = LayerHelper(op_type, **kwargs)
+        inputs = {}
+        attrs = {}
+        arg_vals = list(args)
+        slots, out_slot = _SLOT_MAP.get(
+            op_type,
+            ((('X', 'Y'), 'Out') if op_type in _BINARY else (('X',), 'Out')))
+        for slot in slots:
+            lk = slot.lower()
+            if lk in kwargs:
+                inputs[slot] = kwargs.pop(lk)
+            elif arg_vals:
+                inputs[slot] = arg_vals.pop(0)
+        for k, v in kwargs.items():
+            if k in ('name', 'act', 'param_attr', 'bias_attr'):
+                continue
+            if isinstance(v, Variable):
+                inputs[k.capitalize() if k != 'ids' else 'Ids'] = v
+            else:
+                attrs[k] = v
+        src = None
+        for v in inputs.values():
+            if isinstance(v, Variable):
+                src = v
+                break
+        dtype = src.dtype if src is not None else attrs.get('dtype',
+                                                            'float32')
+        lod = src.lod_level if src is not None else 0
+        out = helper.create_tmp_variable(
+            dtype=dtype, lod_level=lod,
+            shape=src.shape if src is not None else ())
+        helper.append_op(type=op_type, inputs=inputs,
+                         outputs={out_slot: out}, attrs=attrs)
+        return helper.append_activation(out)
+    layer.__name__ = op_type
+    layer.__doc__ = "Layer wrapper for op %r (see paddle_tpu.ops)." % op_type
+    return layer
+
+
+for _op in set(__all__) - {'mean', 'sum', 'uniform_random',
+                           'gaussian_random'}:
+    globals()[_op] = _gen_layer(_op)
+
+
+def mean(x=None, **kwargs):
+    helper = LayerHelper('mean', **kwargs)
+    x = x if x is not None else kwargs.get('input')
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=(1,))
+    helper.append_op(type='mean', inputs={'X': x}, outputs={'Out': out})
+    return out
+
+
+def sum(input, **kwargs):
+    helper = LayerHelper('sum', **kwargs)
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_tmp_variable(dtype=xs[0].dtype, shape=xs[0].shape,
+                                     lod_level=xs[0].lod_level)
+    helper.append_op(type='sum', inputs={'X': list(xs)},
+                     outputs={'Out': out})
+    return out
+
+
+def uniform_random(shape, dtype='float32', min=-1.0, max=1.0, seed=0,
+                   **kwargs):
+    helper = LayerHelper('uniform_random', **kwargs)
+    out = helper.create_tmp_variable(dtype=dtype, shape=tuple(shape))
+    helper.append_op(type='uniform_random', outputs={'Out': out},
+                     attrs={'shape': list(shape), 'dtype': dtype,
+                            'min': min, 'max': max, 'seed': seed})
+    return out
+
+
+def gaussian_random(shape, dtype='float32', mean=0.0, std=1.0, seed=0,
+                    **kwargs):
+    helper = LayerHelper('gaussian_random', **kwargs)
+    out = helper.create_tmp_variable(dtype=dtype, shape=tuple(shape))
+    helper.append_op(type='gaussian_random', outputs={'Out': out},
+                     attrs={'shape': list(shape), 'dtype': dtype,
+                            'mean': mean, 'std': std, 'seed': seed})
+    return out

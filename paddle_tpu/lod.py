@@ -1,0 +1,159 @@
+"""SequenceTensor — the TPU-native replacement for LoDTensor.
+
+Parity: paddle/fluid/framework/lod_tensor.{h,cc} and
+python/paddle/fluid/lod_tensor.py.
+
+Design
+------
+The reference packs variable-length sequences contiguously and keeps a
+"level of detail" offset table (LoD). That layout is hostile to the MXU:
+every kernel needs gather/scatter indirection and dynamic extents.
+
+paddle_tpu instead stores a batch of sequences as
+    data    : [batch, padded_len, *feature_dims]   (dense, static shape)
+    lengths : [batch] int32                        (true lengths)
+and masks where semantics require it. ``padded_len`` is bucketed (rounded up
+to a small set of sizes) so XLA recompiles O(log max_len) times, not per
+batch. Nested LoD (level 2, e.g. paragraphs of sentences) is represented by a
+second lengths array over the flattened outer level.
+
+The public helpers mirror the reference API (``create_lod_tensor``,
+``create_random_int_lodtensor``) accepting recursive-sequence-lengths.
+"""
+import numpy as np
+
+__all__ = ['SequenceTensor', 'create_lod_tensor',
+           'create_random_int_lodtensor', 'bucket_length']
+
+_BUCKETS = (8, 16, 32, 64, 96, 128, 192, 256, 384, 512, 768, 1024,
+            1536, 2048, 3072, 4096, 8192)
+
+
+def bucket_length(n):
+    """Round ``n`` up to the next bucket to bound XLA recompilation."""
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return int(np.ceil(n / 1024.0) * 1024)
+
+
+class SequenceTensor(object):
+    """Dense padded sequences + lengths. Registered as a JAX pytree."""
+
+    def __init__(self, data, lengths, sub_lengths=None):
+        self.data = data
+        self.lengths = lengths
+        # level-2 LoD support: lengths of inner sequences, [batch, padded_outer]
+        self.sub_lengths = sub_lengths
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def lod_level(self):
+        return 2 if self.sub_lengths is not None else 1
+
+    def mask(self, dtype='float32'):
+        """[batch, padded_len] validity mask."""
+        import jax.numpy as jnp
+        t = self.data.shape[1]
+        return (jnp.arange(t)[None, :] <
+                jnp.asarray(self.lengths)[:, None]).astype(dtype)
+
+    def recursive_sequence_lengths(self):
+        return [np.asarray(self.lengths).tolist()]
+
+    def lod(self):
+        """Reference-style offset LoD (for compatibility display)."""
+        lens = np.asarray(self.lengths)
+        return [np.concatenate([[0], np.cumsum(lens)]).tolist()]
+
+    def to_dense_rows(self):
+        """Back to the reference's packed [sum(lengths), ...] layout (host)."""
+        data = np.asarray(self.data)
+        lens = np.asarray(self.lengths)
+        return np.concatenate([data[i, :lens[i]] for i in range(len(lens))],
+                              axis=0)
+
+    def __repr__(self):
+        return "SequenceTensor(data=%s %s, lengths=%s)" % (
+            tuple(self.data.shape), self.data.dtype, tuple(
+                np.asarray(self.lengths).shape))
+
+
+def _register_pytree():
+    import jax
+    jax.tree_util.register_pytree_node(
+        SequenceTensor,
+        lambda s: ((s.data, s.lengths, s.sub_lengths), None),
+        lambda aux, ch: SequenceTensor(ch[0], ch[1], ch[2]))
+
+
+try:
+    _register_pytree()
+except Exception:  # pragma: no cover - jax always present in this image
+    pass
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Build a SequenceTensor from packed data + recursive sequence lengths.
+
+    Parity: fluid.create_lod_tensor(data, recursive_seq_lens, place).
+    ``data``: np.ndarray of shape [sum(lens), *feat] or list of lists.
+    """
+    if isinstance(data, list):
+        # list of sequences (possibly of ids); flatten
+        seq_lens = [len(s) for s in data]
+        if recursive_seq_lens is None:
+            recursive_seq_lens = [seq_lens]
+        flat = []
+        for s in data:
+            flat.extend(s)
+        arr = np.asarray(flat)
+        if arr.ndim == 1:
+            arr = arr[:, None]
+        data = arr
+    data = np.asarray(data)
+    lens = list(recursive_seq_lens[-1])
+    if len(recursive_seq_lens) > 1:
+        # level-2: outer lens group the inner sequences
+        outer = list(recursive_seq_lens[0])
+        inner = lens
+        max_outer = bucket_length(max(outer)) if outer else 1
+        max_inner = bucket_length(max(inner)) if inner else 1
+        feat = data.shape[1:]
+        batch = len(outer)
+        out = np.zeros((batch, max_outer, max_inner) + feat, data.dtype)
+        sub = np.zeros((batch, max_outer), np.int32)
+        pos = 0
+        k = 0
+        for i, n_inner in enumerate(outer):
+            for j in range(n_inner):
+                L = inner[k]
+                out[i, j, :L] = data[pos:pos + L]
+                sub[i, j] = L
+                pos += L
+                k += 1
+        return SequenceTensor(out, np.asarray(outer, np.int32), sub)
+    max_len = bucket_length(max(lens)) if lens else 1
+    feat = data.shape[1:]
+    out = np.zeros((len(lens), max_len) + feat, data.dtype)
+    pos = 0
+    for i, L in enumerate(lens):
+        out[i, :L] = data[pos:pos + L]
+        pos += L
+    return SequenceTensor(out, np.asarray(lens, np.int32))
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    lens = recursive_seq_lens[-1]
+    total = int(np.sum(lens))
+    shape = [total] + list(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype('int64')
+    return create_lod_tensor(data, recursive_seq_lens, place)

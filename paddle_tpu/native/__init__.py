@@ -1,0 +1,3 @@
+"""Native (C++) runtime components: recordio reader + prefetch loader.
+Built lazily via make; Python fallbacks keep everything functional."""
+from . import loader  # noqa

@@ -1,0 +1,13 @@
+"""ctypes binding for the C++ recordio reader (built in a later phase this
+round; falls back to the pure-Python implementation in reader_io.py)."""
+import os
+
+_LIB = None
+
+
+def available():
+    return _LIB is not None
+
+
+def read_records(path):
+    raise NotImplementedError("native loader not built")

@@ -1,0 +1,39 @@
+"""Shared kernel helpers (SequenceTensor transparency, broadcasting)."""
+import jax.numpy as jnp
+
+from ..lod import SequenceTensor
+
+
+def unwrap(v):
+    """Return dense data for kernels that are layout-transparent."""
+    return v.data if isinstance(v, SequenceTensor) else v
+
+
+def rewrap(template, data):
+    if isinstance(template, SequenceTensor):
+        return SequenceTensor(data, template.lengths, template.sub_lengths)
+    return data
+
+
+def seq_of(*vals):
+    for v in vals:
+        if isinstance(v, SequenceTensor):
+            return v
+    return None
+
+
+def bcast_y(x, y, axis):
+    """Fluid elementwise broadcast: y's shape matches a contiguous slice of
+    x's shape starting at ``axis`` (trailing 1s in y are squeezed).
+    Parity: paddle/fluid/operators/elementwise_op_function.h."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if y.ndim == 0 or x.shape == y.shape:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    ys = list(y.shape)
+    while ys and axis + len(ys) > x.ndim and ys[-1] == 1:
+        ys.pop()
+    new_shape = [1] * axis + ys + [1] * (x.ndim - axis - len(ys))
+    return y.reshape(new_shape)

@@ -1,0 +1,355 @@
+"""Neural-net kernels: conv/pool/norm/losses/dropout/metrics.
+
+Parity: paddle/fluid/operators/{conv,pool,batch_norm,layer_norm,lrn,softmax,
+cross_entropy,dropout,accuracy,auc,...}_op.* — all lowered to XLA HLO that
+maps onto the MXU (convs as conv_general_dilated, losses fused into the
+surrounding graph).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from .common import unwrap, rewrap
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+@register_kernel('conv2d')
+@register_kernel('depthwise_conv2d')
+def _conv2d(ctx):
+    """NCHW conv. groups/dilation per operators/conv_op.cc. bf16-friendly:
+    dtype follows the input; XLA tiles onto the MXU."""
+    x = unwrap(ctx.input('Input'))
+    w = unwrap(ctx.input('Filter'))
+    strides = _pair(ctx.attr('strides', [1, 1]))
+    pads = _pair(ctx.attr('paddings', [0, 0]))
+    dilations = _pair(ctx.attr('dilations', [1, 1]))
+    groups = ctx.attr('groups', 1) or 1
+    if ctx.op.type == 'depthwise_conv2d':
+        groups = x.shape[1]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    ctx.set_output('Output', out)
+
+
+@register_kernel('conv2d_transpose')
+def _conv2d_transpose(ctx):
+    x = unwrap(ctx.input('Input'))
+    w = unwrap(ctx.input('Filter'))  # [in_c, out_c, kh, kw]
+    strides = _pair(ctx.attr('strides', [1, 1]))
+    pads = _pair(ctx.attr('paddings', [0, 0]))
+    dilations = _pair(ctx.attr('dilations', [1, 1]))
+    kh, kw = w.shape[2], w.shape[3]
+    # grad-of-conv formulation: transposed conv == lhs-dilated conv with
+    # flipped kernel (parity: conv2d_transpose_op.cc uses col2im)
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3)).swapaxes(0, 1),
+        window_strides=(1, 1),
+        padding=[(dilations[0] * (kh - 1) - pads[0],
+                  dilations[0] * (kh - 1) - pads[0]),
+                 (dilations[1] * (kw - 1) - pads[1],
+                  dilations[1] * (kw - 1) - pads[1])],
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    ctx.set_output('Output', out)
+
+
+@register_kernel('pool2d')
+def _pool2d(ctx):
+    x = unwrap(ctx.input('X'))
+    ptype = ctx.attr('pooling_type', 'max')
+    ksize = _pair(ctx.attr('ksize', [2, 2]))
+    strides = _pair(ctx.attr('strides', [1, 1]))
+    pads = _pair(ctx.attr('paddings', [0, 0]))
+    if ctx.attr('global_pooling', False):
+        ksize = (x.shape[2], x.shape[3])
+        strides = ksize
+        pads = (0, 0)
+    window = (1, 1) + ksize
+    strides4 = (1, 1) + strides
+    padding = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+    if ptype == 'max':
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4,
+                                    padding)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4,
+                                  padding)
+        if ctx.attr('exclusive', True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides4, padding)
+            out = s / cnt
+        else:
+            out = s / float(ksize[0] * ksize[1])
+    ctx.set_output('Out', out)
+
+
+@register_kernel('batch_norm')
+def _batch_norm(ctx):
+    """Train: batch stats + moving-average update (MeanOut/VarianceOut write
+    back to the persistable stats). Test: moving stats.
+    Parity: operators/batch_norm_op.cc."""
+    x = unwrap(ctx.input('X'))
+    scale = unwrap(ctx.input('Scale'))
+    bias = unwrap(ctx.input('Bias'))
+    mean = unwrap(ctx.input('Mean'))
+    var = unwrap(ctx.input('Variance'))
+    momentum = ctx.attr('momentum', 0.9)
+    eps = ctx.attr('epsilon', 1e-5)
+    layout = ctx.attr('data_layout', 'NCHW')
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == 'NCHW' and x.ndim > 2 else
+                          x.ndim - 1))
+    c_axis = 1 if (layout == 'NCHW' and x.ndim > 2) else x.ndim - 1
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if ctx.is_test():
+        use_mean, use_var = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        new_mean = mean * momentum + use_mean * (1.0 - momentum)
+        new_var = var * momentum + use_var * (1.0 - momentum)
+        ctx.set_output('MeanOut', jax.lax.stop_gradient(new_mean))
+        ctx.set_output('VarianceOut', jax.lax.stop_gradient(new_var))
+        ctx.set_output('SavedMean', use_mean)
+        ctx.set_output('SavedVariance', use_var)
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * \
+        scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.set_output('Y', y)
+
+
+@register_kernel('layer_norm')
+def _layer_norm(ctx):
+    x = unwrap(ctx.input('X'))
+    begin = ctx.attr('begin_norm_axis', 1)
+    eps = ctx.attr('epsilon', 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if ctx.has_input('Scale'):
+        y = y * unwrap(ctx.input('Scale')).reshape(norm_shape)
+    if ctx.has_input('Bias'):
+        y = y + unwrap(ctx.input('Bias')).reshape(norm_shape)
+    ctx.set_output('Y', y)
+    ctx.set_output('Mean', mean.reshape(x.shape[:begin] + (1,) * 0)
+                   .reshape((-1,)))
+    ctx.set_output('Variance', var.reshape((-1,)))
+
+
+@register_kernel('lrn')
+def _lrn(ctx):
+    x = unwrap(ctx.input('X'))
+    n = ctx.attr('n', 5)
+    k = ctx.attr('k', 2.0)
+    alpha = ctx.attr('alpha', 1e-4)
+    beta = ctx.attr('beta', 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    ctx.set_output('Out', x / jnp.power(k + alpha * acc, beta))
+    ctx.set_output('MidOut', k + alpha * acc)
+
+
+@register_kernel('softmax')
+def _softmax(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', rewrap(x, jax.nn.softmax(unwrap(x), axis=-1)))
+
+
+@register_kernel('cross_entropy')
+def _cross_entropy(ctx):
+    x = unwrap(ctx.input('X'))
+    label = unwrap(ctx.input('Label'))
+    eps = 1e-8
+    if ctx.attr('soft_label', False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        idx = label.astype('int32')
+        if idx.ndim == x.ndim:
+            idx = idx.reshape(idx.shape[:-1])
+        p = jnp.take_along_axis(x, idx[..., None], axis=-1)
+        loss = -jnp.log(p + eps)
+    ctx.set_output('Y', loss)
+
+
+@register_kernel('softmax_with_cross_entropy')
+def _softmax_with_cross_entropy(ctx):
+    logits = unwrap(ctx.input('Logits'))
+    label = unwrap(ctx.input('Label'))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if ctx.attr('soft_label', False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        idx = label.astype('int32')
+        if idx.ndim == logits.ndim:
+            idx = idx.reshape(idx.shape[:-1])
+        loss = -jnp.take_along_axis(logp, idx[..., None], axis=-1)
+    ctx.set_output('Softmax', jnp.exp(logp))
+    ctx.set_output('Loss', loss)
+
+
+@register_kernel('sigmoid_cross_entropy_with_logits')
+def _sigmoid_xent(ctx):
+    x = unwrap(ctx.input('X'))
+    label = unwrap(ctx.input('Label'))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ctx.set_output('Out', loss)
+
+
+@register_kernel('dropout')
+def _dropout(ctx):
+    """Old-fluid semantics (operators/dropout_op.cc): train out = x * mask,
+    infer out = x * (1 - p) — no inverted scaling."""
+    x = ctx.input('X')
+    xd = unwrap(x)
+    p = ctx.attr('dropout_prob', 0.5)
+    if ctx.is_test():
+        ctx.set_output('Out', rewrap(x, xd * (1.0 - p)))
+        return
+    key = ctx.next_rng()
+    mask = jax.random.bernoulli(key, 1.0 - p, xd.shape).astype(xd.dtype)
+    ctx.set_output('Out', rewrap(x, xd * mask))
+    if ctx.output_names('Mask'):
+        ctx.set_output('Mask', mask)
+
+
+@register_kernel('accuracy')
+def _accuracy(ctx):
+    idx = unwrap(ctx.input('Indices'))
+    label = unwrap(ctx.input('Label')).astype('int32')
+    label_cmp = label if label.ndim == idx.ndim else label[:, None]
+    correct = jnp.any(idx.astype('int32') == label_cmp, axis=-1)
+    acc = jnp.mean(correct.astype('float32')).reshape((1,))
+    ctx.set_output('Accuracy', acc)
+    if ctx.output_names('Correct'):
+        ctx.set_output('Correct', jnp.sum(correct.astype('int32'))
+                       .reshape((1,)))
+    if ctx.output_names('Total'):
+        ctx.set_output('Total', jnp.asarray([correct.shape[0]], 'int32'))
+
+
+@register_kernel('auc')
+def _auc(ctx):
+    """Streaming-free single-batch AUC (trapezoidal over thresholds).
+    Parity: operators/auc_op.cc."""
+    probs = unwrap(ctx.input('Predict'))
+    label = unwrap(ctx.input('Label')).reshape((-1,)).astype('float32')
+    pos_score = probs[:, 1] if probs.ndim == 2 and probs.shape[1] > 1 \
+        else probs.reshape((-1,))
+    num_t = ctx.attr('num_thresholds', 200)
+    th = jnp.linspace(0.0, 1.0, num_t)
+    pred = pos_score[None, :] >= th[:, None]
+    tp = jnp.sum(pred * label[None, :], axis=1)
+    fp = jnp.sum(pred * (1 - label)[None, :], axis=1)
+    pos = jnp.maximum(jnp.sum(label), 1e-6)
+    neg = jnp.maximum(jnp.sum(1 - label), 1e-6)
+    tpr = tp / pos
+    fpr = fp / neg
+    auc = -jnp.trapezoid(tpr, fpr) if hasattr(jnp, 'trapezoid') else \
+        -jnp.trapz(tpr, fpr)
+    ctx.set_output('AUC', jnp.abs(auc).reshape((1,)))
+
+
+@register_kernel('bilinear_interp')
+def _bilinear_interp(ctx):
+    x = unwrap(ctx.input('X'))
+    out_h = ctx.attr('out_h')
+    out_w = ctx.attr('out_w')
+    n, c, h, w = x.shape
+    out = jax.image.resize(x, (n, c, out_h, out_w), method='bilinear')
+    ctx.set_output('Out', out)
+
+
+@register_kernel('label_smooth')
+def _label_smooth(ctx):
+    x = unwrap(ctx.input('X'))
+    eps = ctx.attr('epsilon', 0.1)
+    if ctx.has_input('PriorDist'):
+        prior = unwrap(ctx.input('PriorDist'))
+        out = (1 - eps) * x + eps * prior
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    ctx.set_output('Out', out)
+
+
+@register_kernel('dice_loss')
+def _dice_loss(ctx):
+    x = unwrap(ctx.input('X'))
+    label = unwrap(ctx.input('Label')).astype(x.dtype)
+    eps = ctx.attr('epsilon', 1e-5)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = 2.0 * jnp.sum(x * label, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label, axis=reduce_dims)
+    ctx.set_output('Out', jnp.mean(1.0 - inter / (union + eps)).reshape((1,)))
+
+
+@register_kernel('nce')
+def _nce(ctx):
+    """Sampled NCE loss. TPU-first: fixed sample count per step (static
+    shape), uniform negative sampling. Parity: operators/nce_op.cc."""
+    x = unwrap(ctx.input('Input'))
+    label = unwrap(ctx.input('Label')).astype('int32').reshape((-1,))
+    w = unwrap(ctx.input('Weight'))
+    num_neg = ctx.attr('num_neg_samples', 10)
+    num_classes = ctx.attr('num_total_classes', w.shape[0])
+    key = ctx.next_rng()
+    neg = jax.random.randint(key, (num_neg,), 0, num_classes)
+    b = unwrap(ctx.input('Bias')) if ctx.has_input('Bias') else None
+
+    def logit(ids):
+        lw = jnp.take(w, ids, axis=0)
+        out = jnp.einsum('bd,kd->bk', x, lw) if ids.ndim == 1 else \
+            jnp.sum(x * lw, -1, keepdims=True)
+        if b is not None:
+            out = out + jnp.take(b, ids).reshape((1, -1) if ids.ndim == 1
+                                                 else (-1, 1))
+        return out
+
+    pos_logit = jnp.sum(x * jnp.take(w, label, axis=0), -1, keepdims=True)
+    if b is not None:
+        pos_logit = pos_logit + jnp.take(b, label)[:, None]
+    neg_logit = logit(neg)
+    p_noise = 1.0 / num_classes
+    pos_loss = -jax.nn.log_sigmoid(pos_logit - jnp.log(num_neg * p_noise))
+    neg_loss = -jnp.sum(jax.nn.log_sigmoid(
+        -(neg_logit - jnp.log(num_neg * p_noise))), -1, keepdims=True)
+    ctx.set_output('Cost', pos_loss + neg_loss)
+    if ctx.output_names('SampleLogits'):
+        ctx.set_output('SampleLogits', neg_logit)
+    if ctx.output_names('SampleLabels'):
+        ctx.set_output('SampleLabels', neg)
+
+
+@register_kernel('im2sequence')
+def _im2sequence(ctx):
+    """Image patches -> sequence. Parity: operators/im2sequence_op.cc.
+    Output is a SequenceTensor [N, L, C*kh*kw] with equal lengths."""
+    from ..lod import SequenceTensor
+    x = unwrap(ctx.input('X'))
+    kh, kw = _pair(ctx.attr('kernels', [1, 1]))
+    sh, sw = _pair(ctx.attr('strides', [1, 1]))
+    pads = ctx.attr('paddings', [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])])
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), 'VALID',
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    seq = patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)
+    ctx.set_output('Out', SequenceTensor(
+        seq, jnp.full((n,), oh * ow, dtype='int32')))

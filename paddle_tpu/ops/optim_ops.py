@@ -1,0 +1,153 @@
+"""Optimizer update kernels.
+
+Parity: paddle/fluid/operators/{sgd,momentum,adam,adamax,adagrad,
+decayed_adagrad,adadelta,rmsprop,ftrl}_op.* — each writes ParamOut (and
+accumulator outs) back to the persistable state, so the whole update fuses
+into the step's single XLA program (no separate optimizer dispatch).
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from .common import unwrap
+
+
+def _lr(ctx):
+    lr = unwrap(ctx.input('LearningRate'))
+    return lr.reshape(()) if hasattr(lr, 'reshape') else lr
+
+
+@register_kernel('sgd')
+def _sgd(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    ctx.set_output('ParamOut', p - _lr(ctx) * g.astype(p.dtype))
+
+
+@register_kernel('momentum')
+def _momentum(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    v = unwrap(ctx.input('Velocity'))
+    mu = ctx.attr('mu')
+    lr = _lr(ctx)
+    v_out = mu * v + g
+    if ctx.attr('use_nesterov', False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    ctx.set_output('ParamOut', p_out)
+    ctx.set_output('VelocityOut', v_out)
+
+
+@register_kernel('adam')
+def _adam(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    m1, m2 = unwrap(ctx.input('Moment1')), unwrap(ctx.input('Moment2'))
+    b1p = unwrap(ctx.input('Beta1Pow')).reshape(())
+    b2p = unwrap(ctx.input('Beta2Pow')).reshape(())
+    b1, b2 = ctx.attr('beta1', 0.9), ctx.attr('beta2', 0.999)
+    eps = ctx.attr('epsilon', 1e-8)
+    lr = _lr(ctx)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    ctx.set_output('ParamOut', p - lr_t * m1o / (jnp.sqrt(m2o) + eps))
+    ctx.set_output('Moment1Out', m1o)
+    ctx.set_output('Moment2Out', m2o)
+
+
+@register_kernel('adamax')
+def _adamax(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    m = unwrap(ctx.input('Moment'))
+    inf_norm = unwrap(ctx.input('InfNorm'))
+    b1p = unwrap(ctx.input('Beta1Pow')).reshape(())
+    b1, b2 = ctx.attr('beta1', 0.9), ctx.attr('beta2', 0.999)
+    eps = ctx.attr('epsilon', 1e-8)
+    lr = _lr(ctx)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    ctx.set_output('ParamOut',
+                   p - (lr / (1 - b1p)) * m_out / (inf_out + eps))
+    ctx.set_output('MomentOut', m_out)
+    ctx.set_output('InfNormOut', inf_out)
+
+
+@register_kernel('adagrad')
+def _adagrad(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    m = unwrap(ctx.input('Moment'))
+    eps = ctx.attr('epsilon', 1e-6)
+    m_out = m + jnp.square(g)
+    ctx.set_output('ParamOut', p - _lr(ctx) * g / (jnp.sqrt(m_out) + eps))
+    ctx.set_output('MomentOut', m_out)
+
+
+@register_kernel('decayed_adagrad')
+def _decayed_adagrad(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    m = unwrap(ctx.input('Moment'))
+    decay = ctx.attr('decay', 0.95)
+    eps = ctx.attr('epsilon', 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    ctx.set_output('ParamOut', p - _lr(ctx) * g / (jnp.sqrt(m_out) + eps))
+    ctx.set_output('MomentOut', m_out)
+
+
+@register_kernel('adadelta')
+def _adadelta(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    avg_sq_grad = unwrap(ctx.input('AvgSquaredGrad'))
+    avg_sq_upd = unwrap(ctx.input('AvgSquaredUpdate'))
+    rho = ctx.attr('rho', 0.95)
+    eps = ctx.attr('epsilon', 1e-6)
+    asg = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_upd + (1 - rho) * jnp.square(update)
+    ctx.set_output('ParamOut', p + update)
+    ctx.set_output('AvgSquaredGradOut', asg)
+    ctx.set_output('AvgSquaredUpdateOut', asu)
+
+
+@register_kernel('rmsprop')
+def _rmsprop(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    ms = unwrap(ctx.input('MeanSquare'))
+    mom = unwrap(ctx.input('Moment'))
+    rho = ctx.attr('decay', 0.95)
+    eps = ctx.attr('epsilon', 1e-6)
+    momentum = ctx.attr('momentum', 0.0)
+    lr = _lr(ctx)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    ctx.set_output('ParamOut', p - mom_out)
+    ctx.set_output('MeanSquareOut', ms_out)
+    ctx.set_output('MomentOut', mom_out)
+
+
+@register_kernel('ftrl')
+def _ftrl(ctx):
+    p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    sq_accum = unwrap(ctx.input('SquaredAccumulator'))
+    lin_accum = unwrap(ctx.input('LinearAccumulator'))
+    l1 = ctx.attr('l1', 0.0)
+    l2 = ctx.attr('l2', 0.0)
+    lr_power = ctx.attr('lr_power', -0.5)
+    lr = _lr(ctx)
+    new_accum = sq_accum + jnp.square(g)
+    lin_out = lin_accum + g - (
+        jnp.power(new_accum, -lr_power) - jnp.power(sq_accum, -lr_power)
+    ) / lr * p
+    x = jnp.clip(lin_out, -l1, l1) - lin_out
+    y = jnp.power(new_accum, -lr_power) / lr + 2 * l2
+    ctx.set_output('ParamOut', x / y)
+    ctx.set_output('SquaredAccumOut', new_accum)
+    ctx.set_output('LinearAccumOut', lin_out)
+
+
+@register_kernel('sign')
+def _sign(ctx):
+    ctx.set_output('Out', jnp.sign(unwrap(ctx.input('X'))))
+
+
+@register_kernel('sqrt_op')
+def _sqrt_op(ctx):
+    ctx.set_output('Out', jnp.sqrt(unwrap(ctx.input('X'))))

@@ -1,0 +1,292 @@
+"""Tensor manipulation / creation kernels.
+
+Parity: paddle/fluid/operators/{fill_constant,assign,cast,concat,split,
+reshape,transpose,pad,one_hot,gather,scatter,top_k,uniform_random,
+gaussian_random,lookup_table,...}_op.*
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from ..core.lowering import runtime_dtype
+from .common import unwrap, rewrap
+
+
+@register_kernel('fill_constant')
+def _fill_constant(ctx):
+    shape = [int(s) for s in ctx.attr('shape', [1])]
+    dtype = runtime_dtype(ctx.attr('dtype', 'float32'))
+    value = ctx.attr('value', 0.0)
+    ctx.set_output('Out', jnp.full(shape, value, dtype=dtype))
+
+
+@register_kernel('fill_constant_batch_size_like')
+def _fill_constant_bsl(ctx):
+    ref = unwrap(ctx.input('Input'))
+    shape = [int(s) for s in ctx.attr('shape')]
+    in_idx = ctx.attr('input_dim_idx', 0)
+    out_idx = ctx.attr('output_dim_idx', 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = runtime_dtype(ctx.attr('dtype', 'float32'))
+    ctx.set_output('Out', jnp.full(shape, ctx.attr('value', 0.0),
+                                   dtype=dtype))
+
+
+@register_kernel('fill_zeros_like')
+def _fill_zeros_like(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', rewrap(x, jnp.zeros_like(unwrap(x))))
+
+
+@register_kernel('assign')
+def _assign(ctx):
+    ctx.set_output('Out', ctx.input('X'))
+
+
+@register_kernel('assign_value')
+def _assign_value(ctx):
+    import numpy as np
+    shape = ctx.attr('shape')
+    dtype = runtime_dtype(ctx.attr('dtype', 'float32'))
+    values = ctx.attr('values')
+    ctx.set_output('Out', jnp.asarray(np.array(values), dtype=dtype)
+                   .reshape(shape))
+
+
+@register_kernel('cast')
+def _cast(ctx):
+    x = ctx.input('X')
+    dtype = runtime_dtype(ctx.attr('out_dtype', ctx.out_dtype('Out')))
+    ctx.set_output('Out', rewrap(x, unwrap(x).astype(dtype)))
+
+
+@register_kernel('concat')
+def _concat(ctx):
+    xs = [unwrap(v) for v in ctx.inputs('X')]
+    ctx.set_output('Out', jnp.concatenate(xs, axis=ctx.attr('axis', 0)))
+
+
+@register_kernel('split')
+def _split(ctx):
+    x = unwrap(ctx.input('X'))
+    axis = ctx.attr('axis', 0)
+    sections = ctx.attr('sections', None)
+    num = ctx.attr('num', 0)
+    names = ctx.output_names('Out')
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num or len(names), axis=axis)
+    for i, p in enumerate(parts):
+        ctx.set_output('Out', p, idx=i)
+
+
+@register_kernel('reshape')
+def _reshape(ctx):
+    x = unwrap(ctx.input('X'))
+    shape = list(ctx.attr('shape'))
+    # fluid semantics: 0 means copy input dim; -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    ctx.set_output('Out', x.reshape(shape))
+
+
+@register_kernel('squeeze')
+def _squeeze(ctx):
+    x = unwrap(ctx.input('X'))
+    axes = ctx.attr('axes', None)
+    ctx.set_output('Out', jnp.squeeze(x, axis=tuple(axes) if axes else None))
+
+
+@register_kernel('unsqueeze')
+def _unsqueeze(ctx):
+    x = unwrap(ctx.input('X'))
+    out = x
+    for a in sorted(ctx.attr('axes')):
+        out = jnp.expand_dims(out, a)
+    ctx.set_output('Out', out)
+
+
+@register_kernel('transpose')
+def _transpose(ctx):
+    x = unwrap(ctx.input('X'))
+    ctx.set_output('Out', jnp.transpose(x, ctx.attr('axis')))
+
+
+@register_kernel('pad')
+def _pad(ctx):
+    x = unwrap(ctx.input('X'))
+    paddings = ctx.attr('paddings')
+    pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_output('Out', jnp.pad(x, pads,
+                                  constant_values=ctx.attr('pad_value', 0.0)))
+
+
+@register_kernel('crop')
+def _crop(ctx):
+    x = unwrap(ctx.input('X'))
+    offsets = ctx.attr('offsets')
+    shape = ctx.attr('shape')
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_output('Out', x[slices])
+
+
+@register_kernel('one_hot')
+def _one_hot(ctx):
+    x = unwrap(ctx.input('X'))
+    depth = ctx.attr('depth')
+    idx = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    ctx.set_output('Out', jax.nn.one_hot(idx, depth, dtype='float32'))
+
+
+@register_kernel('gather')
+def _gather(ctx):
+    x = unwrap(ctx.input('X'))
+    idx = unwrap(ctx.input('Index')).astype('int32')
+    idx = idx.reshape((-1,))
+    ctx.set_output('Out', jnp.take(x, idx, axis=0))
+
+
+@register_kernel('scatter')
+def _scatter(ctx):
+    x = unwrap(ctx.input('X'))
+    idx = unwrap(ctx.input('Ids')).astype('int32').reshape((-1,))
+    upd = unwrap(ctx.input('Updates'))
+    ctx.set_output('Out', x.at[idx].set(upd))
+
+
+@register_kernel('top_k')
+def _top_k(ctx):
+    x = unwrap(ctx.input('X'))
+    k = ctx.attr('k', 1)
+    vals, idx = jax.lax.top_k(x, k)
+    ctx.set_output('Out', vals)
+    ctx.set_output('Indices', idx.astype('int32'))
+
+
+@register_kernel('multiplex')
+def _multiplex(ctx):
+    ids = unwrap(ctx.input('Ids')).astype('int32').reshape((-1,))
+    xs = jnp.stack([unwrap(v) for v in ctx.inputs('X')], axis=0)
+    rows = jnp.arange(ids.shape[0])
+    ctx.set_output('Out', xs[ids, rows])
+
+
+@register_kernel('uniform_random')
+@register_kernel('uniform_random_batch_size_like')
+def _uniform_random(ctx):
+    shape = [int(s) for s in ctx.attr('shape')]
+    if ctx.op.type.endswith('batch_size_like'):
+        ref = unwrap(ctx.input('Input'))
+        shape[ctx.attr('output_dim_idx', 0)] = \
+            ref.shape[ctx.attr('input_dim_idx', 0)]
+    dtype = runtime_dtype(ctx.attr('dtype', 'float32'))
+    lo, hi = ctx.attr('min', -1.0), ctx.attr('max', 1.0)
+    seed = ctx.attr('seed', 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    ctx.set_output('Out', jax.random.uniform(key, shape, dtype=dtype,
+                                             minval=lo, maxval=hi))
+
+
+@register_kernel('gaussian_random')
+@register_kernel('gaussian_random_batch_size_like')
+def _gaussian_random(ctx):
+    shape = [int(s) for s in ctx.attr('shape')]
+    if ctx.op.type.endswith('batch_size_like'):
+        ref = unwrap(ctx.input('Input'))
+        shape[ctx.attr('output_dim_idx', 0)] = \
+            ref.shape[ctx.attr('input_dim_idx', 0)]
+    dtype = runtime_dtype(ctx.attr('dtype', 'float32'))
+    mean, std = ctx.attr('mean', 0.0), ctx.attr('std', 1.0)
+    seed = ctx.attr('seed', 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    ctx.set_output('Out', mean + std * jax.random.normal(key, shape,
+                                                         dtype=dtype))
+
+
+@register_kernel('truncated_gaussian_random')
+def _truncated_gaussian_random(ctx):
+    shape = [int(s) for s in ctx.attr('shape')]
+    dtype = runtime_dtype(ctx.attr('dtype', 'float32'))
+    mean, std = ctx.attr('mean', 0.0), ctx.attr('std', 1.0)
+    seed = ctx.attr('seed', 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    ctx.set_output('Out', mean + std * jax.random.truncated_normal(
+        key, -2.0, 2.0, shape, dtype=dtype))
+
+
+@register_kernel('lookup_table')
+def _lookup_table(ctx):
+    """Embedding. Parity: operators/lookup_table_op.* (padding_idx rows
+    return zeros). Sequence inputs keep their lengths."""
+    w = unwrap(ctx.input('W'))
+    ids_in = ctx.input('Ids')
+    ids = unwrap(ids_in).astype('int32')
+    squeeze_last = ids.shape and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids.reshape(ids.shape[:-1])
+    padding_idx = ctx.attr('padding_idx', None)
+    out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None],
+                        jnp.zeros_like(out), out)
+    ctx.set_output('Out', rewrap(ids_in, out))
+
+
+@register_kernel('reverse')
+def _reverse(ctx):
+    x = unwrap(ctx.input('X'))
+    axis = ctx.attr('axis')
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    ctx.set_output('Out', jnp.flip(x, axes))
+
+
+@register_kernel('increment')
+def _increment(ctx):
+    x = unwrap(ctx.input('X'))
+    ctx.set_output('Out', x + ctx.attr('step', 1.0))
+
+
+@register_kernel('is_empty')
+def _is_empty(ctx):
+    x = unwrap(ctx.input('X'))
+    ctx.set_output('Out', jnp.asarray(x.size == 0))
+
+
+@register_kernel('shape')
+def _shape(ctx):
+    x = unwrap(ctx.input('Input'))
+    ctx.set_output('Out', jnp.asarray(x.shape, dtype='int32'))
+
+
+@register_kernel('arg_max')
+def _arg_max(ctx):
+    x = unwrap(ctx.input('X'))
+    ctx.set_output('Out', jnp.argmax(x, axis=ctx.attr('axis', -1))
+                   .astype('int32'))
+
+
+@register_kernel('arg_min')
+def _arg_min(ctx):
+    x = unwrap(ctx.input('X'))
+    ctx.set_output('Out', jnp.argmin(x, axis=ctx.attr('axis', -1))
+                   .astype('int32'))
+
+
+@register_kernel('print')
+def _print(ctx):
+    # Parity: operators/print_op (host callback avoided; debug via fetch).
+    x = ctx.input('X')
+    ctx.set_output('Out', x)
+
+
+@register_kernel('feed')
+@register_kernel('fetch')
+def _feed_fetch(ctx):
+    ctx.set_output('Out', ctx.input('X'))

@@ -1,0 +1,5 @@
+from .mesh import get_mesh, set_mesh  # noqa
+from .parallel_executor import ParallelExecutor  # noqa
+from .transpiler import (DistributeTranspiler,  # noqa
+                         DistributeTranspilerSimple, InferenceTranspiler,
+                         memory_optimize, release_memory)

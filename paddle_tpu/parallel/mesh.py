@@ -1,0 +1,42 @@
+"""Device-mesh management.
+
+The TPU replacement for the reference's device list + NCCL communicator
+bootstrap (paddle/fluid/platform/nccl_helper.h): a jax.sharding.Mesh whose
+axes name the parallelism kinds (dp = data, mp = tensor, pp = pipeline
+stage, sp = sequence). Collectives ride ICI within a host's mesh slice and
+DCN across hosts — placement is XLA's job once shardings are annotated.
+"""
+import numpy as np
+
+_current_mesh = None
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh(num_devices=None, axes=None, shape=None):
+    """Build (or return the cached) mesh.
+
+    axes defaults to 1-D ('dp',). Pass shape=dict(dp=4, mp=2) for
+    multi-axis meshes.
+    """
+    global _current_mesh
+    import jax
+    from jax.sharding import Mesh
+    if _current_mesh is not None and num_devices is None and shape is None:
+        return _current_mesh
+    devices = jax.devices()
+    if shape:
+        axes = tuple(shape.keys())
+        dims = tuple(shape.values())
+        n = int(np.prod(dims))
+        mesh = Mesh(np.asarray(devices[:n]).reshape(dims), axes)
+    else:
+        n = num_devices or len(devices)
+        axes = axes or ('dp',)
+        mesh = Mesh(np.asarray(devices[:n]).reshape((n,)), axes)
+    _current_mesh = mesh
+    return mesh

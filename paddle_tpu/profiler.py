@@ -1,0 +1,66 @@
+"""Profiler.
+
+Parity: python/paddle/fluid/profiler.py (CUDA-event profiler + nvprof).
+TPU design: wraps jax.profiler traces (viewable in TensorBoard/Perfetto)
+plus host wall-clock per-run stats collected by the Executor.
+"""
+import contextlib
+import os
+import time
+
+__all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
+           'stop_profiler']
+
+_stats = {'runs': 0, 'wall': 0.0}
+_trace_dir = None
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Kept for script parity; on TPU this is the XLA trace profiler."""
+    with profiler('All', 'total', output_file):
+        yield
+
+
+def reset_profiler():
+    _stats['runs'] = 0
+    _stats['wall'] = 0.0
+
+
+def start_profiler(state='All', tracer_option=None,
+                   trace_dir='/tmp/paddle_tpu_trace'):
+    global _trace_dir
+    import jax
+    os.makedirs(trace_dir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(trace_dir)
+        _trace_dir = trace_dir
+    except Exception:
+        _trace_dir = None
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _trace_dir
+    import jax
+    if _trace_dir is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        print("[paddle_tpu.profiler] trace written to %s" % _trace_dir)
+        _trace_dir = None
+    if _stats['runs']:
+        print("[paddle_tpu.profiler] %d runs, %.3f s total, %.3f ms/run" %
+              (_stats['runs'], _stats['wall'],
+               1000.0 * _stats['wall'] / _stats['runs']))
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path=None,
+             tracer_option=None):
+    start_profiler(state)
+    t0 = time.time()
+    yield
+    _stats['runs'] += 1
+    _stats['wall'] += time.time() - t0
+    stop_profiler(sorted_key, profile_path)

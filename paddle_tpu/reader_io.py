@@ -1,0 +1,84 @@
+"""RecordIO-backed program readers (host side).
+
+Parity: paddle/fluid/recordio + reader ops (open_recordio_file etc.).
+The chunked binary format is implemented natively in C++
+(paddle_tpu/native/recordio.cc) with a Python fallback here; records are
+pickled tuples of numpy arrays.
+"""
+import os
+import pickle
+import struct
+import zlib
+
+import numpy as np
+
+MAGIC = b'PTRC'
+
+
+class RecordIOWriter(object):
+    """Chunked record file: [MAGIC][n_records][chunk...] where each chunk is
+    [len][crc32][payload]."""
+
+    def __init__(self, path, compressor=None, max_num_records=1000):
+        self.path = path
+        self.f = open(path, 'wb')
+        self.f.write(MAGIC)
+        self.count = 0
+
+    def write(self, record_bytes):
+        payload = record_bytes
+        self.f.write(struct.pack('<II', len(payload),
+                                 zlib.crc32(payload) & 0xffffffff))
+        self.f.write(payload)
+        self.count += 1
+
+    def write_arrays(self, arrays):
+        self.write(pickle.dumps([np.asarray(a) for a in arrays],
+                                protocol=4))
+
+    def close(self):
+        self.f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def read_records(path):
+    with open(path, 'rb') as f:
+        magic = f.read(4)
+        if magic != MAGIC:
+            raise IOError("%s is not a paddle_tpu recordio file" % path)
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            length, crc = struct.unpack('<II', header)
+            payload = f.read(length)
+            if (zlib.crc32(payload) & 0xffffffff) != crc:
+                raise IOError("recordio crc mismatch in %s" % path)
+            yield payload
+
+
+class RecordIOSource(object):
+    """Host-side source bound to open_recordio_file/open_files outputs."""
+
+    def __init__(self, filenames, shapes, dtypes, lod_levels, pass_num=1):
+        if isinstance(filenames, str):
+            filenames = [filenames]
+        self.filenames = filenames
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.lod_levels = lod_levels
+        self.pass_num = pass_num
+
+    def __iter__(self):
+        from .native import loader as native_loader
+        for _ in range(self.pass_num):
+            for fn in self.filenames:
+                it = native_loader.read_records(fn) \
+                    if native_loader.available() else read_records(fn)
+                for payload in it:
+                    yield pickle.loads(payload)

@@ -1,0 +1,60 @@
+"""convert_reader_to_recordio_file.
+
+Parity: python/paddle/fluid/recordio_writer.py.
+"""
+import pickle
+
+import numpy as np
+
+from .reader_io import RecordIOWriter
+
+__all__ = ['convert_reader_to_recordio_file',
+           'convert_reader_to_recordio_files']
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder,
+                                    compressor=None, max_num_records=1000,
+                                    feed_order=None):
+    if feed_order is None:
+        feed_order = feeder.feed_names
+    counter = 0
+    with RecordIOWriter(filename, compressor, max_num_records) as writer:
+        for batch in reader_creator():
+            res = feeder.feed(batch)
+            slots = []
+            for name in feed_order:
+                v = res[name]
+                slots.append(np.asarray(v.data) if hasattr(v, 'data')
+                             else np.asarray(v))
+            writer.write(pickle.dumps(slots, protocol=4))
+            counter += 1
+    return counter
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, feeder,
+                                     compressor=None, max_num_records=1000,
+                                     feed_order=None):
+    if feed_order is None:
+        feed_order = feeder.feed_names
+    f_name, f_ext = filename.rsplit('.', 1) if '.' in filename else \
+        (filename, 'recordio')
+    lines = []
+    f_idx = 0
+    counter = 0
+    for batch in reader_creator():
+        lines.append(batch)
+        if len(lines) == batch_per_file:
+            filename = "%s-%05d.%s" % (f_name, f_idx, f_ext)
+            with RecordIOWriter(filename, compressor,
+                                max_num_records) as writer:
+                for l in lines:
+                    res = feeder.feed(l)
+                    slots = [np.asarray(res[n].data)
+                             if hasattr(res[n], 'data')
+                             else np.asarray(res[n]) for n in feed_order]
+                    writer.write(pickle.dumps(slots, protocol=4))
+                    counter += 1
+                lines = []
+                f_idx += 1
+    return counter

@@ -1,0 +1,52 @@
+"""End-to-end: linear regression converges (book/01).
+Parity: python/paddle/fluid/tests/book/test_fit_a_line.py."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_fit_a_line_converges(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        sgd = fluid.optimizer.SGD(learning_rate=0.01)
+        sgd.minimize(avg_cost)
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                              buf_size=500),
+        batch_size=20)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y], program=main)
+    exe.run(startup)
+
+    first_loss = None
+    last_loss = None
+    for _pass in range(12):
+        for data in train_reader():
+            loss_v, = exe.run(main, feed=feeder.feed(data),
+                              fetch_list=[avg_cost])
+            if first_loss is None:
+                first_loss = float(loss_v[0])
+            last_loss = float(loss_v[0])
+    assert np.isfinite(last_loss)
+    assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+
+    # inference model round trip
+    with fluid.program_guard(main, startup):
+        fluid.io.save_inference_model(str(tmp_path / "model"), ['x'],
+                                      [y_predict], exe, main_program=main)
+    infer_prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        str(tmp_path / "model"), exe)
+    xs = np.random.RandomState(0).randn(4, 13).astype('float32')
+    out, = exe.run(infer_prog, feed={feed_names[0]: xs},
+                   fetch_list=fetch_vars)
+    assert out.shape == (4, 1)
