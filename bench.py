@@ -1,0 +1,66 @@
+"""Headline benchmark: ResNet-50 ImageNet-shape training images/sec/chip.
+
+Parity target (BASELINE.json): Paddle-CUDA ResNet-50 fp32 batch 64 on V100
+~= 195 img/s. We train through the fluid API (Program -> one fused XLA
+step: fwd + bwd + momentum update, donated state) on whatever chip JAX
+sees, and report one JSON line.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def build(batch_size):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[3, 224, 224],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        predict = resnet.resnet_imagenet(img, class_dim=1000, depth=50)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(x=cost)
+        opt = fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+        opt.minimize(avg_cost)
+    return main, startup, avg_cost
+
+
+def main():
+    import jax
+    import paddle_tpu.fluid as fluid
+
+    batch_size = 64
+    main_prog, startup, avg_cost = build(batch_size)
+    place = fluid.TPUPlace(0) if jax.default_backend() != 'cpu' \
+        else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    img = rng.randn(batch_size, 3, 224, 224).astype('float32')
+    label = rng.randint(0, 1000, size=(batch_size, 1)).astype('int64')
+    feed = {'img': img, 'label': label}
+
+    # warmup: compile + 2 steps
+    for _ in range(3):
+        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+    dt = time.perf_counter() - t0
+    ips = steps * batch_size / dt
+    print(json.dumps({
+        'metric': 'resnet50_train_images_per_sec_per_chip',
+        'value': round(ips, 2),
+        'unit': 'images/sec',
+        'vs_baseline': round(ips / 195.0, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -150,6 +150,7 @@ class LayerHelper(object):
         b = self.create_parameter(attr=bias_attr, shape=size,
                                   dtype=input_var.dtype, is_bias=True)
         tmp = self.create_tmp_variable(dtype=input_var.dtype,
+                                       shape=input_var.shape,
                                        lod_level=input_var.lod_level)
         self.append_op(
             type='elementwise_add', inputs={'X': [input_var], 'Y': [b]},
@@ -165,6 +166,7 @@ class LayerHelper(object):
         act = copy.deepcopy(act)
         act_type = act.pop('type')
         tmp = self.create_tmp_variable(dtype=input_var.dtype,
+                                       shape=input_var.shape,
                                        lod_level=input_var.lod_level)
         self.append_op(type=act_type, inputs={'X': [input_var]},
                        outputs={'Out': [tmp]}, attrs=act)

@@ -1,0 +1,5 @@
+"""Model zoo: fluid-style builders for the reference's book/benchmark models
+plus the TPU-native transformer flagship."""
+from . import transformer  # noqa: F401
+from . import resnet  # noqa: F401
+from . import vgg  # noqa: F401

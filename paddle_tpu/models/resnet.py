@@ -1,0 +1,129 @@
+"""ResNet / SE-ResNeXt builders on the fluid layer API.
+
+Parity: benchmark/fluid/resnet.py and benchmark/fluid/se_resnext.py in the
+reference (ResNet-50/101/152 bottleneck nets for ImageNet; basicblock net
+for cifar10). Built from paddle_tpu.layers conv2d/batch_norm/pool2d so the
+whole model lowers into one XLA program per training step.
+"""
+from .. import layers
+
+__all__ = ['resnet_imagenet', 'resnet_cifar10', 'se_resnext']
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
+                  is_test=False):
+    conv = layers.conv2d(input=input, filter_size=filter_size,
+                         num_filters=ch_out, stride=stride, padding=padding,
+                         act=None, bias_attr=False)
+    return layers.batch_norm(input=conv, act=act, is_test=is_test)
+
+
+def shortcut(input, ch_in, ch_out, stride, is_test=False):
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, None,
+                             is_test=is_test)
+    return input
+
+
+def basicblock(input, ch_in, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_in, ch_out, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+    return layers.elementwise_add(x=short, y=conv2, act='relu')
+
+
+def bottleneck(input, ch_in, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_in, ch_out * 4, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          is_test=is_test)
+    return layers.elementwise_add(x=short, y=conv3, act='relu')
+
+
+def layer_warp(block_func, input, ch_in, ch_out, count, stride,
+               is_test=False):
+    res_out = block_func(input, ch_in, ch_out, stride, is_test)
+    for _ in range(1, count):
+        res_out = block_func(res_out, ch_out * (4 if block_func is bottleneck
+                                                else 1),
+                             ch_out, 1, is_test)
+    return res_out
+
+
+def resnet_imagenet(input, class_dim, depth=50, is_test=False):
+    cfg = {18: ([2, 2, 2, 1], basicblock),
+           34: ([3, 4, 6, 3], basicblock),
+           50: ([3, 4, 6, 3], bottleneck),
+           101: ([3, 4, 23, 3], bottleneck),
+           152: ([3, 8, 36, 3], bottleneck)}
+    stages, block_func = cfg[depth]
+    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                          padding=3, is_test=is_test)
+    pool1 = layers.pool2d(input=conv1, pool_type='max', pool_size=3,
+                          pool_stride=2, pool_padding=1)
+    res1 = layer_warp(block_func, pool1, 64, 64, stages[0], 1, is_test)
+    res2 = layer_warp(block_func, res1, 256, 128, stages[1], 2, is_test)
+    res3 = layer_warp(block_func, res2, 512, 256, stages[2], 2, is_test)
+    res4 = layer_warp(block_func, res3, 1024, 512, stages[3], 2, is_test)
+    pool2 = layers.pool2d(input=res4, pool_size=7, pool_type='avg',
+                          global_pooling=True)
+    return layers.fc(input=pool2, size=class_dim, act='softmax')
+
+
+def resnet_cifar10(input, class_dim, depth=32, is_test=False):
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input, ch_out=16, filter_size=3, stride=1,
+                          padding=1, is_test=is_test)
+    res1 = layer_warp(basicblock, conv1, 16, 16, n, 1, is_test)
+    res2 = layer_warp(basicblock, res1, 16, 32, n, 2, is_test)
+    res3 = layer_warp(basicblock, res2, 32, 64, n, 2, is_test)
+    pool = layers.pool2d(input=res3, pool_size=8, pool_type='avg',
+                         global_pooling=True)
+    return layers.fc(input=pool, size=class_dim, act='softmax')
+
+
+def _squeeze_excitation(input, num_channels, reduction_ratio):
+    pool = layers.pool2d(input=input, pool_type='avg', global_pooling=True)
+    squeeze = layers.fc(input=pool, size=num_channels // reduction_ratio,
+                        act='relu')
+    excitation = layers.fc(input=squeeze, size=num_channels, act='sigmoid')
+    return layers.elementwise_mul(x=input, y=excitation, axis=0)
+
+
+def _se_bottleneck(input, num_filters, stride, cardinality, reduction_ratio,
+                   ch_in, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, 1, 0, is_test=is_test)
+    conv1 = layers.conv2d(input=conv0, num_filters=num_filters,
+                          filter_size=3, stride=stride, padding=1,
+                          groups=cardinality, act=None, bias_attr=False)
+    conv1 = layers.batch_norm(input=conv1, act='relu', is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 2, 1, 1, 0, act=None,
+                          is_test=is_test)
+    scale = _squeeze_excitation(conv2, num_filters * 2, reduction_ratio)
+    short = shortcut(input, ch_in, num_filters * 2, stride, is_test)
+    return layers.elementwise_add(x=short, y=scale, act='relu')
+
+
+def se_resnext(input, class_dim, depth=50, is_test=False):
+    """SE-ResNeXt-50/101/152 (benchmark/fluid/se_resnext.py parity)."""
+    cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+    depth_cfg = cfg[depth]
+    cardinality, reduction_ratio = 32, 16
+    num_filters = [128, 256, 512, 1024]
+
+    conv = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test)
+    conv = layers.pool2d(input=conv, pool_size=3, pool_stride=2,
+                         pool_padding=1, pool_type='max')
+    ch_in = 64
+    for block in range(len(depth_cfg)):
+        for i in range(depth_cfg[block]):
+            conv = _se_bottleneck(conv, num_filters[block],
+                                  2 if i == 0 and block != 0 else 1,
+                                  cardinality, reduction_ratio, ch_in,
+                                  is_test)
+            ch_in = num_filters[block] * 2
+    pool = layers.pool2d(input=conv, pool_size=7, pool_type='avg',
+                         global_pooling=True)
+    return layers.fc(input=pool, size=class_dim, act='softmax')

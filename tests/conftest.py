@@ -1,9 +1,24 @@
 """Test config: force CPU backend with 8 virtual devices so multi-chip
-sharding paths are exercised without TPU hardware (SURVEY.md §4)."""
+sharding paths are exercised without TPU hardware (SURVEY.md §4).
+
+Note: this image's sitecustomize registers a TPU PJRT plugin and calls
+``jax.config.update('jax_platforms', 'axon,cpu')`` at interpreter start,
+overriding the JAX_PLATFORMS env var — so override via jax.config (which
+wins over env) before any backend is initialised.
+"""
 import os
+import re
 
 os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-flags = os.environ.get('XLA_FLAGS', '')
-if 'xla_force_host_platform_device_count' not in flags:
-    os.environ['XLA_FLAGS'] = (
-        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+# Honor an externally chosen device count (either convention) for debugging
+# smaller meshes; default to 8.
+_m = re.search(r'xla_force_host_platform_device_count=(\d+)',
+               os.environ.get('XLA_FLAGS', ''))
+_n = int(_m.group(1)) if _m else int(
+    os.environ.get('PADDLE_TPU_TEST_DEVICES', 8))
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', _n)

@@ -1,0 +1,54 @@
+"""Flagship transformer: ring attention == dense attention; sharded train
+step runs and improves loss on all mesh shapes."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models import transformer as T
+
+
+def test_ring_attention_matches_dense():
+    from jax.sharding import Mesh
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs).reshape(4), ('sp',))
+    B, Tlen, H, Dh = 2, 32, 2, 8
+    rng = np.random.RandomState(0)
+    q = rng.randn(B, Tlen, H, Dh).astype(np.float32)
+    k = rng.randn(B, Tlen, H, Dh).astype(np.float32)
+    v = rng.randn(B, Tlen, H, Dh).astype(np.float32)
+
+    dense = T._causal_attention(jnp.asarray(q), jnp.asarray(k),
+                                jnp.asarray(v))
+
+    from jax.sharding import PartitionSpec as P
+    import functools
+    ring = jax.jit(functools.partial(
+        jax.shard_map,
+        mesh=mesh, in_specs=(P(None, 'sp'),) * 3,
+        out_specs=P(None, 'sp'), check_vma=False)(
+            lambda a, b, c: T.ring_attention(a, b, c, 'sp')))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize('shape', [(1, 1, 1), (2, 2, 2), (1, 2, 4)])
+def test_train_step_converges(shape):
+    from jax.sharding import Mesh
+    dp, tp, sp = shape
+    n = dp * tp * sp
+    mesh = Mesh(np.asarray(jax.devices()[:n]).reshape(shape),
+                ('dp', 'tp', 'sp'))
+    cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                              d_ff=64, max_len=32, dtype=jnp.float32)
+    params = T.shard_params(T.init_params(cfg, 0), cfg, mesh)
+    opt = T.init_adam_state(params)
+    step = T.make_train_step(cfg, mesh, lr=1e-2)
+    toks = np.random.RandomState(0).randint(
+        0, cfg.vocab, size=(2 * dp, 17)).astype(np.int32)
+    x, y = toks[:, :-1], toks[:, 1:]
+    losses = []
+    for _ in range(20):
+        loss, params, opt = step(params, opt, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses
