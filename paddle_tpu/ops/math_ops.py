@@ -19,7 +19,15 @@ def _elementwise(name, fn):
         x, y = ctx.input('X'), ctx.input('Y')
         tmpl = seq_of(x, y)
         xd, yd = unwrap(x), unwrap(y)
-        yd = bcast_y(xd, yd, ctx.attr('axis', -1))
+        axis = ctx.attr('axis', -1)
+        from ..lod import SequenceTensor
+        if (isinstance(x, SequenceTensor)
+                and not isinstance(y, SequenceTensor)
+                and axis not in (None, -1) and axis >= 1):
+            # IR shapes follow the reference's packed [total, ...] layout;
+            # runtime data is padded [B, T, ...] so dims >= 1 shift by one.
+            axis += 1
+        yd = bcast_y(xd, yd, axis)
         out = fn(jnp.asarray(xd), yd)
         if ctx.attr('scale', None) not in (None, 1.0):
             out = out * ctx.attr('scale')
@@ -195,16 +203,25 @@ def _swish(ctx):
 @register_kernel('mul')
 def _mul(ctx):
     """fc matmul. X flattened by x_num_col_dims, Y by y_num_col_dims.
-    Parity: operators/mul_op.cc. Feeds the MXU directly."""
-    x, y = unwrap(ctx.input('X')), unwrap(ctx.input('Y'))
+    Parity: operators/mul_op.cc. Feeds the MXU directly.
+
+    Sequence inputs: the reference packs time into dim 0 ([total, D]); our
+    runtime layout is padded [B, T, D], so the time dim joins the row dims
+    and the result stays a SequenceTensor."""
+    x_in, y = ctx.input('X'), unwrap(ctx.input('Y'))
+    x = unwrap(x_in)
     xd = ctx.attr('x_num_col_dims', 1)
     yd = ctx.attr('y_num_col_dims', 1)
+    from ..lod import SequenceTensor
+    is_seq = isinstance(x_in, SequenceTensor)
+    if is_seq:
+        xd += 1  # [B, T] both count as row dims
     xs, ys = x.shape, y.shape
     x2 = x.reshape((_prod(xs[:xd]), _prod(xs[xd:])))
     y2 = y.reshape((_prod(ys[:yd]), _prod(ys[yd:])))
     out = x2 @ y2
     out = out.reshape(tuple(xs[:xd]) + tuple(ys[yd:]))
-    ctx.set_output('Out', out)
+    ctx.set_output('Out', rewrap(x_in, out) if is_seq else out)
 
 
 def _prod(t):

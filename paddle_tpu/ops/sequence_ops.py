@@ -1,1 +1,333 @@
-"""Filled in by a later build phase this round."""
+"""Sequence op kernels on SequenceTensor (padded [B, T, ...] + lengths).
+
+Parity: paddle/fluid/operators/sequence_*_op.*, row_conv_op,
+im2sequence_op.
+
+The reference walks LoD offset tables on the host; here every kernel is a
+masked dense computation (VPU/MXU friendly, jit-safe, differentiable by
+JAX). Dynamic-length results keep static padded shapes with updated
+``lengths``.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from ..lod import SequenceTensor
+from .common import unwrap
+
+
+def _seq(v, what='input'):
+    if not isinstance(v, SequenceTensor):
+        raise TypeError("%s must be a SequenceTensor, got %r" %
+                        (what, type(v)))
+    return v
+
+
+def _mask(st, extra_dims=0):
+    """[B, T] (+ trailing 1s) float32 validity mask."""
+    t = st.data.shape[1]
+    m = (jnp.arange(t)[None, :] <
+         jnp.asarray(st.lengths)[:, None]).astype(jnp.float32)
+    return m.reshape(m.shape + (1,) * extra_dims)
+
+
+def masked_reverse(data, lengths):
+    """Reverse each sequence's valid prefix in place (padding stays put)."""
+    t = data.shape[1]
+    ar = jnp.arange(t)[None, :]
+    L = jnp.asarray(lengths)[:, None]
+    idx = jnp.where(ar < L, L - 1 - ar, ar).astype('int32')
+    return jnp.take_along_axis(
+        data, idx.reshape(idx.shape + (1,) * (data.ndim - 2)), axis=1,
+        mode='clip')
+
+
+# ---- pooling --------------------------------------------------------------------
+@register_kernel('sequence_pool')
+def _sequence_pool(ctx):
+    st = _seq(ctx.input('X'))
+    pool = (ctx.attr('pooltype', 'AVERAGE') or 'AVERAGE').upper()
+    x = jnp.asarray(st.data)
+    m = _mask(st, x.ndim - 2)
+    L = jnp.maximum(jnp.asarray(st.lengths), 1).astype(x.dtype)
+    Lb = L.reshape((-1,) + (1,) * (x.ndim - 2))
+    max_index = None
+    if pool == 'SUM':
+        out = jnp.sum(x * m, axis=1)
+    elif pool == 'AVERAGE':
+        out = jnp.sum(x * m, axis=1) / Lb
+    elif pool == 'SQRT':
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(Lb)
+    elif pool == 'MAX':
+        neg = jnp.full_like(x, -3.4e38)
+        masked = jnp.where(m > 0, x, neg)
+        out = jnp.max(masked, axis=1)
+        max_index = jnp.argmax(masked, axis=1).astype(jnp.int32)
+    elif pool == 'FIRST':
+        out = x[:, 0]
+    elif pool == 'LAST':
+        idx = (jnp.asarray(st.lengths) - 1).clip(0).astype('int32')
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1,
+            mode='clip')[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % pool)
+    if ctx.output_names('MaxIndex'):
+        if max_index is None:
+            max_index = jnp.zeros(out.shape, jnp.int32)
+        ctx.set_output('MaxIndex', max_index)
+    ctx.set_output('Out', out)
+
+
+@register_kernel('sequence_softmax')
+def _sequence_softmax(ctx):
+    st = _seq(ctx.input('X'))
+    x = jnp.asarray(st.data)
+    # canonical use: scores [B, T, 1] (or [B, T]); softmax over valid steps
+    squeeze = x.ndim > 2 and x.shape[-1] == 1
+    v = x[..., 0] if squeeze else x
+    m = _mask(st) > 0
+    v = jnp.where(m, v.astype(jnp.float32), -jnp.inf)
+    out = jax.nn.softmax(v, axis=1)
+    out = jnp.where(m, out, 0.0)
+    if squeeze:
+        out = out[..., None]
+    ctx.set_output('Out', SequenceTensor(out.astype(x.dtype), st.lengths,
+                                         st.sub_lengths))
+
+
+# ---- expand / reshape / lod plumbing --------------------------------------------
+@register_kernel('sequence_expand')
+def _sequence_expand(ctx):
+    """Expand x rows to match y's sequence lengths.
+    Canonical NMT use: x [B, D] dense -> broadcast each row over y's
+    timesteps; x a SequenceTensor -> re-lengthed to y's lengths."""
+    x_in = ctx.input('X')
+    y = _seq(ctx.input('Y'), 'Y')
+    T = y.data.shape[1]
+    if isinstance(x_in, SequenceTensor):
+        xd = jnp.asarray(x_in.data)
+        if xd.shape[1] == T:
+            out = xd
+        elif xd.shape[1] > T:
+            out = xd[:, :T]
+        else:
+            out = jnp.pad(xd, [(0, 0), (0, T - xd.shape[1])] +
+                          [(0, 0)] * (xd.ndim - 2))
+    else:
+        xd = jnp.asarray(unwrap(x_in))
+        out = jnp.broadcast_to(xd[:, None], (xd.shape[0], T) + xd.shape[1:])
+    ctx.set_output('Out', SequenceTensor(out, y.lengths, y.sub_lengths))
+
+
+@register_kernel('sequence_reshape')
+def _sequence_reshape(ctx):
+    st = _seq(ctx.input('X'))
+    new_dim = int(ctx.attr('new_dim'))
+    B, T, D = st.data.shape[0], st.data.shape[1], st.data.shape[-1]
+    if (T * D) % new_dim != 0:
+        raise ValueError("sequence_reshape: T*D=%d not divisible by %d" %
+                         (T * D, new_dim))
+    new_t = T * D // new_dim
+    out = jnp.asarray(st.data).reshape(B, new_t, new_dim)
+    new_len = (jnp.asarray(st.lengths) * D) // new_dim
+    ctx.set_output('Out', SequenceTensor(out, new_len.astype(jnp.int32)))
+
+
+def _to_packed(x_in):
+    """Rows of x in the reference's packed [total, *feat] order."""
+    if isinstance(x_in, SequenceTensor):
+        d = jnp.asarray(x_in.data)
+        B, T = d.shape[0], d.shape[1]
+        flat = d.reshape((B * T,) + d.shape[2:])
+        valid = (jnp.arange(T)[None, :] <
+                 jnp.asarray(x_in.lengths)[:, None]).reshape(-1)
+        key = jnp.where(valid, jnp.arange(B * T), B * T + jnp.arange(B * T))
+        return jnp.take(flat, jnp.argsort(key), axis=0)
+    return jnp.asarray(unwrap(x_in))
+
+
+@register_kernel('lod_reset')
+def _lod_reset(ctx):
+    """Re-segment x's packed rows into new sequence lengths.
+    Parity: operators/lod_reset_op.* — there it only swaps the offset
+    table; in the padded layout the rows must actually be regrouped."""
+    packed = _to_packed(ctx.input('X'))
+    T_out = None
+    if ctx.has_input('Y'):
+        y = ctx.input('Y')
+        if isinstance(y, SequenceTensor):
+            lens = jnp.asarray(y.lengths).astype(jnp.int32)
+            T_out = int(y.data.shape[1])
+        else:
+            # offset-style target lod [0, o1, o2, ...] -> lengths
+            yv = jnp.asarray(unwrap(y)).reshape(-1)
+            lens = (yv[1:] - yv[:-1]).astype(jnp.int32)
+    else:
+        import numpy as _np
+        tl = _np.asarray(ctx.attr('target_lod'), 'int64').reshape(-1)
+        ls = tl[1:] - tl[:-1] if tl.size and tl[0] == 0 else tl
+        from ..lod import bucket_length
+        T_out = bucket_length(int(ls.max())) if ls.size else 1
+        lens = jnp.asarray(ls.astype('int32'))
+    B2 = int(lens.shape[0])
+    if T_out is None:
+        T_out = int(packed.shape[0])  # dynamic lens: safe static bound
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(lens)[:-1].astype(jnp.int32)])
+    idx = offs[:, None] + jnp.arange(T_out)[None, :]
+    out = jnp.take(packed, jnp.clip(idx, 0, packed.shape[0] - 1).reshape(-1),
+                   axis=0).reshape((B2, T_out) + packed.shape[1:])
+    m = (jnp.arange(T_out)[None, :] < lens[:, None])
+    out = out * m.reshape(m.shape + (1,) * (packed.ndim - 1)).astype(
+        out.dtype)
+    ctx.set_output('Out', SequenceTensor(out, lens))
+
+
+@register_kernel('sequence_concat')
+def _sequence_concat(ctx):
+    """Concatenate corresponding sequences along time (valid prefixes)."""
+    xs = [_seq(v) for v in ctx.inputs('X')]
+    if len(xs) == 1:
+        ctx.set_output('Out', xs[0])
+        return
+    total_T = sum(int(s.data.shape[1]) for s in xs)
+    feat = tuple(xs[0].data.shape[2:])
+    dtype = xs[0].data.dtype
+    t_out = jnp.arange(total_T)
+    res = jnp.zeros((xs[0].data.shape[0], total_T) + feat, dtype)
+    start = jnp.zeros((xs[0].data.shape[0],), jnp.int32)
+    for s in xs:
+        d = jnp.asarray(s.data)
+        Ti = d.shape[1]
+        ln = jnp.asarray(s.lengths).astype(jnp.int32)
+        src_idx = t_out[None, :] - start[:, None]          # [B, total_T]
+        valid = (src_idx >= 0) & (src_idx < ln[:, None])
+        shifted = jnp.take_along_axis(
+            jnp.pad(d, [(0, 0), (0, total_T - Ti)] +
+                    [(0, 0)] * (d.ndim - 2)),
+            jnp.clip(src_idx, 0, total_T - 1)
+            .reshape(src_idx.shape + (1,) * (d.ndim - 2)), axis=1)
+        res = jnp.where(valid.reshape(valid.shape + (1,) * (d.ndim - 2)),
+                        shifted, res)
+        start = start + ln
+    new_len = start
+    ctx.set_output('Out', SequenceTensor(res, new_len))
+
+
+@register_kernel('sequence_erase')
+def _sequence_erase(ctx):
+    st = _seq(ctx.input('X'))
+    import numpy as _np
+    tokens = _np.asarray(ctx.attr('tokens') or [], 'int32')
+    x = jnp.asarray(st.data)
+    ids = x[..., 0] if x.ndim == 3 else x  # [B, T] int
+    keep = _mask(st) > 0
+    if tokens.size:
+        keep &= ~jnp.isin(ids, jnp.asarray(tokens))
+    T = ids.shape[1]
+    # stable compaction: kept elements sort to the front in order
+    order = jnp.where(keep, jnp.arange(T)[None], T + jnp.arange(T)[None])
+    perm = jnp.argsort(order, axis=1)
+    compacted = jnp.take_along_axis(ids, perm, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    tmask = jnp.arange(T)[None] < new_len[:, None]
+    compacted = jnp.where(tmask, compacted, 0)
+    if x.ndim == 3:
+        compacted = compacted[..., None]
+    ctx.set_output('Out', SequenceTensor(compacted, new_len))
+
+
+@register_kernel('sequence_slice')
+def _sequence_slice(ctx):
+    st = _seq(ctx.input('X'))
+    off = jnp.asarray(unwrap(ctx.input('Offset'))).reshape(-1).astype('int32')
+    ln = jnp.asarray(unwrap(ctx.input('Length'))).reshape(-1).astype('int32')
+    x = jnp.asarray(st.data)
+    T = x.shape[1]
+    idx = off[:, None] + jnp.arange(T)[None, :]
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1,
+        mode='clip')
+    m = jnp.arange(T)[None, :] < ln[:, None]
+    out = out * m.reshape(m.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+    ctx.set_output('Out', SequenceTensor(out, ln))
+
+
+# ---- convolution over time ------------------------------------------------------
+def _valid_shift(T, shift, lengths):
+    """[B, T, 1] mask for positions whose shifted source is in [0, len)."""
+    ar = jnp.arange(T)[None, :]
+    L = jnp.asarray(lengths)[:, None]
+    src = ar + shift
+    ok = (src >= 0) & (src < L)
+    return ok[..., None].astype(jnp.float32)
+
+
+@register_kernel('sequence_conv')
+def _sequence_conv(ctx):
+    """out[b,t] = concat_j x[b, t+start+j] @ W  (masked outside lengths).
+    Parity: operators/sequence_conv_op.* (context projection + gemm)."""
+    st = _seq(ctx.input('X'))
+    w = jnp.asarray(unwrap(ctx.input('Filter')))
+    start = int(ctx.attr('contextStart', -1))
+    length = int(ctx.attr('contextLength', 3))
+    x = jnp.asarray(st.data)
+    B, T, D = x.shape
+    m = _mask(st, 1)
+    xm = x * m
+    cols = []
+    for j in range(length):
+        shift = start + j
+        cols.append(jnp.roll(xm, -shift, axis=1) *
+                    _valid_shift(T, shift, st.lengths))
+    ctxmat = jnp.concatenate(cols, axis=-1)  # [B, T, length*D]
+    out = jnp.einsum('btd,dm->btm', ctxmat, w,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    out = out * m
+    ctx.set_output('Out', SequenceTensor(out, st.lengths))
+
+
+@register_kernel('row_conv')
+def _row_conv(ctx):
+    """Lookahead conv: out[b,t] = sum_j x[b,t+j] * W[j] (elementwise over
+    channels). Parity: operators/row_conv_op.*"""
+    st = ctx.input('X')
+    is_seq = isinstance(st, SequenceTensor)
+    x = jnp.asarray(unwrap(st))
+    w = jnp.asarray(unwrap(ctx.input('Filter')))  # [k+1, D]
+    k = w.shape[0]
+    B, T = x.shape[0], x.shape[1]
+    if is_seq:
+        L = jnp.asarray(st.lengths)[:, None]
+    else:
+        L = jnp.full((B, 1), T)
+    out = jnp.zeros_like(x)
+    ar = jnp.arange(T)[None, :]
+    for j in range(k):
+        src = ar + j
+        ok = (src < L)[..., None].astype(x.dtype)
+        out = out + jnp.roll(x, -j, axis=1) * ok * w[j]
+    res = SequenceTensor(out, st.lengths) if is_seq else out
+    ctx.set_output('Out', res)
+
+
+@register_kernel('im2sequence')
+def _im2sequence(ctx):
+    """[B, C, H, W] -> sequence of flattened patches, len = oh*ow.
+    Parity: operators/im2sequence_op.*"""
+    x = jnp.asarray(unwrap(ctx.input('X')))
+    ks = ctx.attr('kernels', [1, 1])
+    strides = ctx.attr('strides', [1, 1])
+    pads = ctx.attr('paddings', [0, 0, 0, 0])
+    B, C, H, W = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    kh, kw = ks
+    oh = (x.shape[2] - kh) // strides[0] + 1
+    ow = (x.shape[3] - kw) // strides[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(strides), 'VALID',
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))  # [B, C*kh*kw, oh, ow]
+    seq = patches.reshape(B, C * kh * kw, oh * ow).transpose(0, 2, 1)
+    lens = jnp.full((B,), oh * ow, jnp.int32)
+    ctx.set_output('Out', SequenceTensor(seq, lens))

@@ -1,0 +1,88 @@
+"""Miniature book/06 understand_sentiment: conv net + stacked LSTM on
+variable-length sequences converge.
+Parity: python/paddle/fluid/tests/book/test_understand_sentiment.py."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.lod import create_lod_tensor
+
+VOCAB = 50
+CLASSES = 2
+EMB = 16
+
+
+def convolution_net(data, label):
+    emb = fluid.layers.embedding(input=data, size=[VOCAB, EMB])
+    conv_3 = fluid.nets.sequence_conv_pool(input=emb, num_filters=8,
+                                           filter_size=3, act="tanh",
+                                           pool_type="sqrt")
+    conv_4 = fluid.nets.sequence_conv_pool(input=emb, num_filters=8,
+                                           filter_size=4, act="tanh",
+                                           pool_type="sqrt")
+    prediction = fluid.layers.fc(input=[conv_3, conv_4], size=CLASSES,
+                                 act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    return fluid.layers.mean(cost), prediction
+
+
+def stacked_lstm_net(data, label, stacked_num=3):
+    emb = fluid.layers.embedding(input=data, size=[VOCAB, EMB])
+    fc1 = fluid.layers.fc(input=emb, size=EMB * 4)
+    lstm1, cell1 = fluid.layers.dynamic_lstm(input=fc1, size=EMB * 4)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fluid.layers.fc(input=inputs, size=EMB * 4)
+        lstm, cell = fluid.layers.dynamic_lstm(input=fc, size=EMB * 4,
+                                               is_reverse=(i % 2) == 0)
+        inputs = [fc, lstm]
+    fc_last = fluid.layers.sequence_pool(input=inputs[0], pool_type='max')
+    lstm_last = fluid.layers.sequence_pool(input=inputs[1],
+                                           pool_type='max')
+    prediction = fluid.layers.fc(input=[fc_last, lstm_last], size=CLASSES,
+                                 act='softmax')
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    return fluid.layers.mean(cost), prediction
+
+
+def _data(rng, batch=16):
+    """Class-separable synthetic reviews: class 1 favors high token ids."""
+    lens = rng.randint(3, 9, size=batch).tolist()
+    labels = rng.randint(0, CLASSES, size=(batch, 1)).astype('int64')
+    rows = []
+    for i, L in enumerate(lens):
+        lo, hi = (0, VOCAB // 2) if labels[i, 0] == 0 else (VOCAB // 2,
+                                                            VOCAB)
+        rows.append(rng.randint(lo, hi, size=(L, 1)))
+    flat = np.concatenate(rows).astype('int64')
+    return create_lod_tensor(flat, [lens]), labels
+
+
+def _train(net_fn, steps=40, lr=0.01):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                                 lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        cost, pred = net_fn(data, label)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        st, labels = _data(rng)
+        loss, = exe.run(main, feed={'words': st, 'label': labels},
+                        fetch_list=[cost])
+        losses.append(float(np.asarray(loss).ravel()[0]))
+    return losses
+
+
+def test_sentiment_conv_converges():
+    losses = _train(convolution_net)
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_sentiment_stacked_lstm_converges():
+    losses = _train(stacked_lstm_net, steps=50)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])

@@ -1,0 +1,292 @@
+"""SequenceTensor ops vs numpy references (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.lod import SequenceTensor, create_lod_tensor
+
+
+def run_op(op_type, inputs, attrs, out_slots=('Out',), extra_outs=()):
+    """Build a one-op program and run it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        in_vars = {}
+        feed = {}
+        for slot, (val, lod) in inputs.items():
+            name = slot.lower()
+            v = fluid.layers.data(name=name, shape=list(
+                np.asarray(val.data if isinstance(val, SequenceTensor)
+                           else val).shape[1:]),
+                dtype=str(np.asarray(
+                    val.data if isinstance(val, SequenceTensor)
+                    else val).dtype), lod_level=lod)
+            in_vars[slot] = v
+            feed[name] = val
+        outs = {}
+        block = main.global_block()
+        for i, slot in enumerate(tuple(out_slots) + tuple(extra_outs)):
+            outs[slot] = block.create_var(name='out_%d' % i,
+                                          dtype='float32')
+        block.append_op(type=op_type,
+                        inputs={k: [v] for k, v in in_vars.items()},
+                        outputs={k: [v] for k, v in outs.items()},
+                        attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main, feed=feed,
+                   fetch_list=[outs[s] for s in out_slots])
+
+
+def make_seq(lens, feat, seed=0, dtype='float32'):
+    rng = np.random.RandomState(seed)
+    data = rng.randn(sum(lens), feat).astype(dtype)
+    return create_lod_tensor(data, [list(lens)]), data
+
+
+@pytest.mark.parametrize('pool,ref', [
+    ('SUM', lambda rows: rows.sum(0)),
+    ('AVERAGE', lambda rows: rows.mean(0)),
+    ('SQRT', lambda rows: rows.sum(0) / np.sqrt(len(rows))),
+    ('MAX', lambda rows: rows.max(0)),
+    ('FIRST', lambda rows: rows[0]),
+    ('LAST', lambda rows: rows[-1]),
+])
+def test_sequence_pool(pool, ref):
+    lens = [3, 1, 5]
+    st, data = make_seq(lens, 4)
+    out, = run_op('sequence_pool', {'X': (st, 1)}, {'pooltype': pool},
+                  extra_outs=('MaxIndex',))
+    off = np.concatenate([[0], np.cumsum(lens)])
+    want = np.stack([ref(data[off[i]:off[i + 1]]) for i in range(3)])
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_softmax():
+    lens = [3, 1, 4]
+    st, data = make_seq(lens, 1)
+    out, = run_op('sequence_softmax', {'X': (st, 1)}, {})
+    off = np.concatenate([[0], np.cumsum(lens)])
+    for i, L in enumerate(lens):
+        rows = data[off[i]:off[i + 1], 0]
+        e = np.exp(rows - rows.max())
+        np.testing.assert_allclose(out.data[i, :L, 0], e / e.sum(),
+                                   rtol=1e-5)
+        assert np.all(out.data[i, L:] == 0)
+
+
+def test_sequence_expand_dense():
+    lens = [2, 3]
+    y, _ = make_seq(lens, 4, seed=1)
+    x = np.arange(10, dtype='float32').reshape(2, 5)
+    out, = run_op('sequence_expand', {'X': (x, 0), 'Y': (y, 1)}, {})
+    for i, L in enumerate(lens):
+        for t in range(L):
+            np.testing.assert_array_equal(out.data[i, t], x[i])
+    np.testing.assert_array_equal(np.asarray(out.lengths), lens)
+
+
+def test_sequence_reshape():
+    lens = [2, 4]
+    st, data = make_seq(lens, 6)
+    out, = run_op('sequence_reshape', {'X': (st, 1)}, {'new_dim': 3})
+    np.testing.assert_array_equal(np.asarray(out.lengths), [4, 8])
+    np.testing.assert_allclose(out.data[0, :4].ravel(),
+                               data[:2].ravel(), rtol=1e-6)
+
+
+def test_sequence_concat():
+    a, da = make_seq([2, 1], 3, seed=0)
+    b, db = make_seq([1, 2], 3, seed=1)
+    out, = _concat_two(a, b)
+    np.testing.assert_array_equal(np.asarray(out.lengths), [3, 3])
+    np.testing.assert_allclose(out.data[0, :2], da[:2], rtol=1e-6)
+    np.testing.assert_allclose(out.data[0, 2:3], db[:1], rtol=1e-6)
+    np.testing.assert_allclose(out.data[1, 0:1], da[2:3], rtol=1e-6)
+    np.testing.assert_allclose(out.data[1, 1:3], db[1:3], rtol=1e-6)
+
+
+def _concat_two(a, b):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        va = fluid.layers.data(name='a', shape=[3], lod_level=1)
+        vb = fluid.layers.data(name='b', shape=[3], lod_level=1)
+        out = main.global_block().create_var(name='out', dtype='float32')
+        main.global_block().append_op(type='sequence_concat',
+                                      inputs={'X': [va, vb]},
+                                      outputs={'Out': [out]}, attrs={})
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main, feed={'a': a, 'b': b}, fetch_list=[out])
+
+
+def test_sequence_erase():
+    ids = create_lod_tensor(
+        np.array([[1], [2], [3], [2], [9], [2]], 'int64'), [[4, 2]])
+    out, = run_op('sequence_erase', {'X': (ids, 1)}, {'tokens': [2]})
+    np.testing.assert_array_equal(np.asarray(out.lengths), [2, 1])
+    np.testing.assert_array_equal(
+        np.asarray(out.data[0, :2]).ravel(), [1, 3])
+    np.testing.assert_array_equal(np.asarray(out.data[1, :1]).ravel(), [9])
+
+
+def test_sequence_conv_full_window():
+    lens = [4, 6]
+    st, data = make_seq(lens, 3)
+    rng = np.random.RandomState(7)
+    w = rng.randn(9, 5).astype('float32')  # context 3 * feat 3 -> 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.layers.data(name='x', shape=[3], lod_level=1)
+        f = fluid.layers.create_parameter([9, 5], 'float32', name='filt')
+        out = main.global_block().create_var(name='o', dtype='float32')
+        main.global_block().append_op(
+            type='sequence_conv', inputs={'X': [v], 'Filter': [f]},
+            outputs={'Out': [out]},
+            attrs={'contextStart': -1, 'contextLength': 3,
+                   'contextStride': 1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    import paddle_tpu.executor as pexe
+    pexe.global_scope().set_var('filt', w)
+    res, = exe.run(main, feed={'x': st}, fetch_list=[out])
+    # numpy reference on first sequence
+    seq = data[:4]
+    padded = np.vstack([np.zeros((1, 3), 'float32'), seq,
+                        np.zeros((1, 3), 'float32')])
+    for t in range(4):
+        ctxv = padded[t:t + 3].ravel()
+        np.testing.assert_allclose(res.data[0, t], ctxv @ w, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_dynamic_lstm_matches_numpy():
+    lens = [3, 5]
+    H = 4
+    st, data = make_seq(lens, 4 * H, seed=3)
+    rng = np.random.RandomState(11)
+    w = rng.randn(H, 4 * H).astype('float32') * 0.3
+    b = rng.randn(1, 4 * H).astype('float32') * 0.1
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.layers.data(name='x', shape=[4 * H], lod_level=1)
+        wv = fluid.layers.create_parameter([H, 4 * H], 'float32', name='w')
+        bv = fluid.layers.create_parameter([1, 4 * H], 'float32', name='b')
+        hid = main.global_block().create_var(name='h', dtype='float32')
+        cell = main.global_block().create_var(name='c', dtype='float32')
+        main.global_block().append_op(
+            type='dynamic_lstm',
+            inputs={'Input': [v], 'Weight': [wv], 'Bias': [bv]},
+            outputs={'Hidden': [hid], 'Cell': [cell]},
+            attrs={'use_peepholes': False, 'is_reverse': False,
+                   'gate_activation': 'sigmoid', 'cell_activation': 'tanh',
+                   'candidate_activation': 'tanh'})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    import paddle_tpu.executor as pexe
+    pexe.global_scope().set_var('w', w)
+    pexe.global_scope().set_var('b', b)
+    res, = exe.run(main, feed={'x': st}, fetch_list=[hid])
+
+    def sigmoid(z):
+        return 1 / (1 + np.exp(-z))
+
+    off = np.concatenate([[0], np.cumsum(lens)])
+    for i, L in enumerate(lens):
+        h = np.zeros(H, 'float32')
+        c = np.zeros(H, 'float32')
+        for t in range(L):
+            g = data[off[i] + t] + h @ w + b[0]
+            gc, gi, gf, go = np.split(g, 4)  # ref order (c, i, f, o)
+            ii, ff, oo = sigmoid(gi), sigmoid(gf), sigmoid(go)
+            c = np.tanh(gc) * ii + c * ff
+            h = oo * np.tanh(c)
+            np.testing.assert_allclose(res.data[i, t], h, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_dynamic_gru_runs_and_masks():
+    lens = [2, 5]
+    H = 3
+    st, _ = make_seq(lens, 3 * H, seed=5)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.layers.data(name='x', shape=[3 * H], lod_level=1)
+        hid = fluid.layers.dynamic_gru(input=v, size=H)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res, = exe.run(main, feed={'x': st}, fetch_list=[hid])
+    assert res.data.shape[2] == H
+    # masked region keeps the last valid hidden (carry) — just check finite
+    assert np.all(np.isfinite(res.data))
+    np.testing.assert_array_equal(np.asarray(res.lengths), lens)
+
+
+def test_lod_reset_resegments():
+    # 6 packed rows [2, 4] -> [3, 3]
+    st, data = make_seq([2, 4], 3, seed=9)
+    out, = run_op('lod_reset', {'X': (st, 1)},
+                  {'target_lod': [0, 3, 6]})
+    np.testing.assert_array_equal(np.asarray(out.lengths), [3, 3])
+    np.testing.assert_allclose(np.asarray(out.data[0, :3]), data[:3],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out.data[1, :3]), data[3:],
+                               rtol=1e-6)
+
+
+def test_dynamic_gru_matches_numpy():
+    lens = [3, 2]
+    H = 3
+    st, data = make_seq(lens, 3 * H, seed=13)
+    rng = np.random.RandomState(17)
+    w = rng.randn(H, 3 * H).astype('float32') * 0.4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.layers.data(name='x', shape=[3 * H], lod_level=1)
+        wv = fluid.layers.create_parameter([H, 3 * H], 'float32', name='wg')
+        hid = main.global_block().create_var(name='h', dtype='float32')
+        main.global_block().append_op(
+            type='dynamic_gru', inputs={'Input': [v], 'Weight': [wv]},
+            outputs={'Hidden': [hid]},
+            attrs={'is_reverse': False, 'gate_activation': 'sigmoid',
+                   'activation': 'tanh'})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    import paddle_tpu.executor as pexe
+    pexe.global_scope().set_var('wg', w)
+    res, = exe.run(main, feed={'x': st}, fetch_list=[hid])
+
+    def sigmoid(z):
+        return 1 / (1 + np.exp(-z))
+
+    off = np.concatenate([[0], np.cumsum(lens)])
+    for i, L in enumerate(lens):
+        h = np.zeros(H, 'float32')
+        for t in range(L):
+            xg = data[off[i] + t]
+            g = sigmoid(xg[:2 * H] + h @ w[:, :2 * H])
+            u, r = g[:H], g[H:]
+            c = np.tanh(xg[2 * H:] + (r * h) @ w[:, 2 * H:])
+            h = (1 - u) * h + u * c  # ref: out = prev - u*prev + u*c
+            np.testing.assert_allclose(res.data[i, t], h, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_lstm_unit_and_gru_unit_layers():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        h0 = fluid.layers.data(name='h0', shape=[4], dtype='float32')
+        c0 = fluid.layers.data(name='c0', shape=[4], dtype='float32')
+        h, c = fluid.layers.lstm_unit(x_t=x, hidden_t_prev=h0,
+                                      cell_t_prev=c0)
+        gh, _, _ = fluid.layers.gru_unit(input=fluid.layers.fc(x, 12),
+                                         hidden=h0, size=12)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    res = exe.run(main, feed={'x': rng.randn(2, 8).astype('float32'),
+                              'h0': rng.randn(2, 4).astype('float32'),
+                              'c0': rng.randn(2, 4).astype('float32')},
+                  fetch_list=[h, c, gh])
+    assert res[0].shape == (2, 4) and res[1].shape == (2, 4)
+    assert res[2].shape == (2, 4)
