@@ -23,7 +23,7 @@ from .framework import (Program, Block, Variable, Operator,  # noqa
 from .core.places import (TPUPlace, CPUPlace, CUDAPlace,  # noqa
                           CUDAPinnedPlace, is_compiled_with_cuda,
                           is_compiled_with_tpu)
-from .executor import (Executor, global_scope, scope_guard,  # noqa
+from .executor import (Executor, Scope, global_scope, scope_guard,  # noqa
                        switch_scope, fetch_var)
 from . import layers  # noqa
 from . import initializer  # noqa

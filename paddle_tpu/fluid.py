@@ -12,7 +12,7 @@ from .framework import (Program, Block, Variable, Operator,  # noqa
                         switch_main_program, get_var)
 from .core.places import (TPUPlace, CPUPlace, CUDAPlace, CUDAPinnedPlace,  # noqa
                           is_compiled_with_cuda, is_compiled_with_tpu)
-from .executor import (Executor, global_scope, scope_guard, switch_scope,  # noqa
+from .executor import (Executor, Scope, global_scope, scope_guard, switch_scope,  # noqa
                        fetch_var)
 from .backward import append_backward  # noqa
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa

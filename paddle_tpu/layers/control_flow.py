@@ -14,7 +14,7 @@ from ..layer_helper import LayerHelper
 from ..framework import Variable, Operator
 from .. import unique_name
 from .tensor import assign, fill_constant, cast
-from . import nn as _nn
+from . import ops as _ops
 
 __all__ = [
     'split_lod_tensor', 'merge_lod_tensor', 'BlockGuard', 'While', 'Switch',
@@ -320,20 +320,20 @@ class Switch(object):
         if len(self.pre_not_conditions) == 0:
             cond_block = ConditionalBlock([condition],
                                           is_scalar_condition=True)
-            not_cond = _nn.elementwise_sub(
+            not_cond = _ops.elementwise_sub(
                 fill_constant(shape=[1], dtype='float32', value=1.0),
                 cast(condition, 'float32'))
             self.pre_not_conditions.append(not_cond)
         else:
             pre_not = self.pre_not_conditions[-1]
-            new_not_cond = _nn.elementwise_mul(
+            new_not_cond = _ops.elementwise_mul(
                 pre_not,
-                _nn.elementwise_sub(
+                _ops.elementwise_sub(
                     fill_constant(shape=[1], dtype='float32', value=1.0),
                     cast(condition, 'float32')))
             self.pre_not_conditions.append(new_not_cond)
             cond_block = ConditionalBlock(
-                [_nn.elementwise_mul(pre_not, cast(condition, 'float32'))],
+                [_ops.elementwise_mul(pre_not, cast(condition, 'float32'))],
                 is_scalar_condition=True)
         with cond_block.block():
             yield
@@ -398,7 +398,7 @@ class IfElse(object):
             raise TypeError("cond must be a Variable")
         self.helper = LayerHelper('ifelse', name=name)
         self.cond = cond
-        self.not_cond = _nn.elementwise_sub(
+        self.not_cond = _ops.elementwise_sub(
             fill_constant(shape=[1], dtype='float32', value=1.0),
             cast(cond, 'float32'))
         self.not_cond = cast(self.not_cond, 'bool')

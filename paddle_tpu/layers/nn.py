@@ -1085,23 +1085,34 @@ def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0):
     selected_scores = helper.create_tmp_variable(dtype=score_type,
                                                  lod_level=2)
     selected_ids = helper.create_tmp_variable(dtype=id_type, lod_level=2)
+    # TPU design: parent beam slots are an explicit output (the reference
+    # recovers parentage from LoD offsets); beam_search_decode consumes it
+    parent_idx = helper.create_tmp_variable(dtype='int32')
     helper.append_op(type='beam_search',
                      inputs={'pre_ids': pre_ids, 'ids': ids,
                              'scores': scores},
                      outputs={'selected_ids': selected_ids,
-                              'selected_scores': selected_scores},
+                              'selected_scores': selected_scores,
+                              'parent_idx': parent_idx},
                      attrs={'level': level, 'beam_size': beam_size,
                             'end_id': end_id})
+    selected_ids.parent_idx = parent_idx
     return selected_ids, selected_scores
 
 
-def beam_search_decode(ids, scores, name=None):
+def beam_search_decode(ids, scores, parents=None, name=None):
+    """ids/scores: tensor arrays (array_write once per step). parents:
+    the matching array of parent_idx outputs from beam_search (required
+    by the static-shape backtracking kernel)."""
     helper = LayerHelper('beam_search_decode', name=name)
     sentence_ids = helper.create_tmp_variable(dtype=ids.dtype, lod_level=2)
     sentence_scores = helper.create_tmp_variable(dtype=scores.dtype,
                                                  lod_level=2)
+    inputs = {"Ids": ids, "Scores": scores}
+    if parents is not None:
+        inputs["Parents"] = parents
     helper.append_op(type="beam_search_decode",
-                     inputs={"Ids": ids, "Scores": scores},
+                     inputs=inputs,
                      outputs={"SentenceIds": sentence_ids,
                               "SentenceScores": sentence_scores})
     return sentence_ids, sentence_scores

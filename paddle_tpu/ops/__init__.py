@@ -11,6 +11,9 @@ from . import optim_ops  # noqa
 from . import sequence_ops  # noqa
 from . import rnn_ops  # noqa
 from . import control_flow_ops  # noqa
+from . import crf_ops  # noqa
+from . import ctc_ops  # noqa
+from . import search_ops  # noqa
 from . import detection_ops  # noqa
 from . import collective_ops  # noqa
 

@@ -1,1 +1,91 @@
-"""Filled in by a later build phase this round."""
+"""Collective op kernels — XLA collectives over the device mesh.
+
+Parity: the reference's NCCL ops (paddle/fluid/operators/nccl_op.cc,
+send/recv in detail/) and platform/nccl_helper.h. TPU design: collectives
+are jax.lax primitives (psum / all_gather / ppermute / ...) that XLA
+schedules over ICI/DCN; they only act when lowering happens inside a
+mapped context (shard_map / pmap) that defines the named mesh axis. On a
+single device — or when the axis is unbound because the program runs under
+plain jit SPMD, where XLA inserts collectives itself — they are the
+identity, matching the reference's single-GPU behavior.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from .common import unwrap, rewrap
+
+
+def _axis_bound(axis_name):
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def _axis(ctx):
+    return ctx.attr('axis_name', 'dp')
+
+
+def _coll():
+    # lazy import: ops package loads before paddle_tpu.parallel
+    from ..parallel import collective
+    return collective
+
+
+@register_kernel('allreduce')
+def _allreduce(ctx):
+    x = ctx.input('X')
+    ax = _axis(ctx)
+    red = (ctx.attr('reduce_type', 'sum') or 'sum').lower()
+    v = unwrap(x)
+    if _axis_bound(ax):
+        v = _coll().all_reduce(v, ax, red)
+    ctx.set_output('Out', rewrap(x, v))
+
+
+@register_kernel('broadcast')
+def _broadcast(ctx):
+    """Root's value to all. With SPMD sharding the value is already
+    replicated; under shard_map select the root shard and psum."""
+    x = ctx.input('X')
+    ax = _axis(ctx)
+    root = int(ctx.attr('root', 0))
+    v = unwrap(x)
+    if _axis_bound(ax):
+        v = _coll().broadcast(v, ax, root)
+    ctx.set_output('Out', rewrap(x, v))
+
+
+@register_kernel('all_gather')
+def _all_gather(ctx):
+    x = ctx.input('X')
+    ax = _axis(ctx)
+    v = unwrap(x)
+    if _axis_bound(ax):
+        v = _coll().all_gather(v, ax, axis=0)
+    ctx.set_output('Out', rewrap(x, v))
+
+
+@register_kernel('reduce_scatter')
+def _reduce_scatter(ctx):
+    x = ctx.input('X')
+    ax = _axis(ctx)
+    v = unwrap(x)
+    if _axis_bound(ax):
+        v = _coll().reduce_scatter(v, ax, axis=0)
+    ctx.set_output('Out', rewrap(x, v))
+
+
+@register_kernel('ppermute')
+def _ppermute(ctx):
+    """Ring shift by ``offset`` along the axis (the primitive under ring
+    attention's KV rotation)."""
+    x = ctx.input('X')
+    ax = _axis(ctx)
+    offset = int(ctx.attr('offset', 1))
+    v = unwrap(x)
+    if _axis_bound(ax):
+        v = _coll().ring_permute(v, ax, offset)
+    ctx.set_output('Out', rewrap(x, v))

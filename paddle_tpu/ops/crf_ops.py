@@ -1,0 +1,214 @@
+"""Linear-chain CRF, Viterbi decoding, chunk evaluation.
+
+Parity: paddle/fluid/operators/{linear_chain_crf_op,crf_decoding_op,
+chunk_eval_op}.{h,cc}.
+
+Transition parameter layout (linear_chain_crf_op.h): row 0 = start
+weights, row 1 = end weights, rows 2.. = [tag_num x tag_num] transition
+matrix. The reference walks LoD'd sequences on the CPU; here everything
+is a masked lax.scan over the padded [B, T, ...] batch, differentiable by
+JAX autodiff (no hand-written backward needed).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from ..lod import SequenceTensor
+
+
+def _emission(ctx, slot='Emission'):
+    st = ctx.input(slot)
+    if not isinstance(st, SequenceTensor):
+        raise TypeError("%s must be a SequenceTensor" % slot)
+    return st
+
+
+def _labels_dense(label):
+    lab = label.data if isinstance(label, SequenceTensor) else label
+    lab = jnp.asarray(lab)
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    return lab.astype(jnp.int32)
+
+
+@register_kernel('linear_chain_crf')
+def _linear_chain_crf(ctx):
+    """LogLikelihood output = negative log-likelihood per sequence [B, 1]
+    (a cost, as in the reference — book 07 minimizes its mean)."""
+    em = _emission(ctx)
+    trans = jnp.asarray(ctx.input('Transition'))
+    label = _labels_dense(ctx.input('Label'))
+    x = jnp.asarray(em.data)                     # [B, T, S]
+    B, T, S = x.shape
+    lengths = jnp.asarray(em.lengths, jnp.int32)
+    start, end, w = trans[0], trans[1], trans[2:]
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])        # [B, T]
+
+    # ---- partition function: masked forward algorithm in log space
+    alpha0 = start[None, :] + x[:, 0, :]                      # [B, S]
+
+    def fwd(alpha, t):
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + w[None, :, :], axis=1) + x[:, t, :]
+        keep = mask[:, t][:, None]
+        return jnp.where(keep, nxt, alpha), None
+
+    alphaT, _ = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+    logZ = jax.scipy.special.logsumexp(alphaT + end[None, :], axis=1)
+
+    # ---- gold path score
+    em_score = jnp.sum(jnp.take_along_axis(
+        x, label[..., None], axis=2)[..., 0] * mask, axis=1)
+    prev, cur = label[:, :-1], label[:, 1:]
+    trans_score = jnp.sum(w[prev, cur] * mask[:, 1:], axis=1)
+    first_tag = label[:, 0]
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_tag = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    score = em_score + trans_score + start[first_tag] + end[last_tag]
+
+    nll = logZ - score
+    ctx.set_output('LogLikelihood', nll[:, None])
+    # intermediates kept for API parity (autodiff supersedes them)
+    ctx.set_output('Alpha', alphaT)
+    ctx.set_output('EmissionExps', jnp.exp(x - jnp.max(x)))
+    ctx.set_output('TransitionExps', jnp.exp(trans - jnp.max(trans)))
+
+
+@register_kernel('crf_decoding')
+def _crf_decoding(ctx):
+    """Viterbi decode. Without Label: the best path [B, T, 1] (masked).
+    With Label: per-position 1 where label == path, 0 elsewhere
+    (crf_decoding_op.h:60-63)."""
+    em = _emission(ctx)
+    trans = jnp.asarray(ctx.input('Transition'))
+    x = jnp.asarray(em.data)
+    B, T, S = x.shape
+    lengths = jnp.asarray(em.lengths, jnp.int32)
+    start, end, w = trans[0], trans[1], trans[2:]
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])
+
+    delta0 = start[None, :] + x[:, 0, :]
+
+    def viterbi(delta, t):
+        cand = delta[:, :, None] + w[None, :, :]              # [B, S, S]
+        best_prev = jnp.argmax(cand, axis=1).astype(jnp.int32)
+        nxt = jnp.max(cand, axis=1) + x[:, t, :]
+        keep = mask[:, t][:, None]
+        delta_new = jnp.where(keep, nxt, delta)
+        return delta_new, best_prev                            # bp per t
+
+    deltaT, bps = jax.lax.scan(viterbi, delta0, jnp.arange(1, T))
+    # bps: [T-1, B, S] back-pointers; add end weights at each row's last
+    # valid position by scoring deltaT (frozen past each length) + end
+    last_tag = jnp.argmax(deltaT + end[None, :], axis=1).astype(jnp.int32)
+
+    # backtrack from each sequence's end; positions past the end hold the
+    # frozen carry, which is exactly the tag at length-1
+    def back(tag, t):
+        bp_t = bps[t]                                          # [B, S]
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        # only step back while t+1 < length (t indexes bps for step t+1)
+        active = (t + 1) < lengths
+        tag_new = jnp.where(active, prev, tag)
+        return tag_new, tag_new
+
+    _, rev_path = jax.lax.scan(back, last_tag,
+                               jnp.arange(T - 2, -1, -1))
+    path = jnp.concatenate(
+        [jnp.flip(jnp.swapaxes(rev_path, 0, 1), axis=1),
+         last_tag[:, None]], axis=1)                           # [B, T]
+    path = jnp.where(mask, path, 0)
+
+    label = ctx.input('Label')
+    if label is not None:
+        lab = _labels_dense(label)
+        out = jnp.where(mask, (lab == path).astype(jnp.int32), 0)
+    else:
+        out = path.astype(jnp.int32)
+    ctx.set_output('ViterbiPath',
+                   SequenceTensor(out[..., None], lengths))
+
+
+# ---- chunk evaluation -----------------------------------------------------------
+def _chunk_marks(tags, types, valid, scheme, prev_tags, prev_types,
+                 prev_valid, next_tags, next_types, next_valid):
+    """start/end flags for well-formed chunk sequences.
+    Parity (well-formed subset): chunk_eval_op.h ChunkBegin/ChunkEnd."""
+    same_prev = prev_valid & (prev_types == types)
+    same_next = next_valid & (next_types == types)
+    if scheme == 'iob':       # B=0, I=1
+        start = valid & ((tags == 0) | (~same_prev))
+        end = valid & ((~same_next) | (next_tags == 0))
+    elif scheme == 'ioe':     # I=0, E=1
+        start = valid & ((~same_prev) | (prev_tags == 1))
+        end = valid & ((tags == 1) | (~same_next))
+    elif scheme == 'iobes':   # B=0, I=1, E=2, S=3
+        start = valid & ((tags == 0) | (tags == 3))
+        end = valid & ((tags == 2) | (tags == 3))
+    else:                     # plain: maximal same-type runs
+        start = valid & (~same_prev)
+        end = valid & (~same_next)
+    return start, end
+
+
+@register_kernel('chunk_eval')
+def _chunk_eval(ctx):
+    """Precision/recall/F1 over extracted chunks.
+    Parity: paddle/fluid/operators/chunk_eval_op.h (well-formed
+    sequences; excluded_chunk_types respected)."""
+    inf = ctx.input('Inference')
+    lab = ctx.input('Label')
+    scheme = (ctx.attr('chunk_scheme', 'IOB') or 'IOB').lower()
+    num_types = int(ctx.attr('num_chunk_types'))
+    excluded = set(int(e) for e in ctx.attr('excluded_chunk_types', []))
+    tag_counts = {'iob': 2, 'ioe': 2, 'iobes': 4, 'plain': 1}
+    ntag = tag_counts[scheme]
+
+    st = inf if isinstance(inf, SequenceTensor) else lab
+    lengths = jnp.asarray(st.lengths, jnp.int32)
+    T = st.data.shape[1]
+    seq_mask = (jnp.arange(T)[None, :] < lengths[:, None])
+
+    def analyze(ids):
+        ids = _labels_dense(ids)
+        types = ids // ntag
+        tags = ids % ntag
+        o_label = num_types * ntag
+        valid = seq_mask & (ids < o_label) & (types < num_types)
+        for e in excluded:
+            valid = valid & (types != e)
+        pad = lambda a, v: jnp.pad(a, ((0, 0), (1, 1)),
+                                   constant_values=v)
+        pt, ptyp, pv = pad(tags, 0)[:, :-2], pad(types, -1)[:, :-2], \
+            pad(valid, False)[:, :-2]
+        nt, ntyp, nv = pad(tags, 0)[:, 2:], pad(types, -1)[:, 2:], \
+            pad(valid, False)[:, 2:]
+        start, end = _chunk_marks(tags, types, valid, scheme, pt, ptyp,
+                                  pv, nt, ntyp, nv)
+        # chunk end position for the chunk starting at t: the first end
+        # flag at t' >= t (reverse scan carries the next end index)
+        def rev(carry, t):
+            e_t = jnp.where(end[:, t], t, carry)
+            return e_t, e_t
+
+        init = jnp.full((ids.shape[0],), T, jnp.int32)
+        _, ends_rev = jax.lax.scan(rev, init, jnp.arange(T - 1, -1, -1))
+        chunk_end = jnp.flip(jnp.swapaxes(ends_rev, 0, 1), axis=1)
+        return start, types, chunk_end
+
+    i_start, i_type, i_end = analyze(inf)
+    l_start, l_type, l_end = analyze(lab)
+    n_inf = jnp.sum(i_start)
+    n_lab = jnp.sum(l_start)
+    correct = jnp.sum(i_start & l_start & (i_type == l_type) &
+                      (i_end == l_end))
+    precision = correct / jnp.maximum(n_inf, 1)
+    recall = correct / jnp.maximum(n_lab, 1)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-10)
+    ctx.set_output('Precision', precision.reshape(1).astype(jnp.float32))
+    ctx.set_output('Recall', recall.reshape(1).astype(jnp.float32))
+    ctx.set_output('F1-Score', f1.reshape(1).astype(jnp.float32))
+    ctx.set_output('NumInferChunks', n_inf.reshape(1).astype(jnp.int32))
+    ctx.set_output('NumLabelChunks', n_lab.reshape(1).astype(jnp.int32))
+    ctx.set_output('NumCorrectChunks',
+                   correct.reshape(1).astype(jnp.int32))

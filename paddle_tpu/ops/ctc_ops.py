@@ -1,0 +1,183 @@
+"""CTC loss, greedy decoding, edit distance.
+
+Parity: paddle/fluid/operators/{warpctc_op,ctc_align_op,
+edit_distance_op}.* — the reference binds Baidu's warp-ctc CUDA library;
+here CTC is the standard log-semiring forward recursion as a masked
+lax.scan (differentiable by JAX autodiff, MXU/VPU friendly).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from ..lod import SequenceTensor
+
+_NEG = -1e30
+
+
+def _logsumexp2(a, b):
+    m = jnp.maximum(a, b)
+    m_safe = jnp.where(m <= _NEG / 2, 0.0, m)
+    out = m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe))
+    return jnp.where(m <= _NEG / 2, _NEG, out)
+
+
+def _logsumexp3(a, b, c):
+    return _logsumexp2(_logsumexp2(a, b), c)
+
+
+@register_kernel('warpctc')
+def _warpctc(ctx):
+    """CTC negative log-likelihood per sequence -> Loss [B, 1].
+
+    Logits: SequenceTensor [B, T, C] (pre-softmax activations, matching
+    warpctc_op which applies softmax internally). Label: SequenceTensor
+    [B, L(, 1)] int. blank index attr."""
+    logits = ctx.input('Logits')
+    label = ctx.input('Label')
+    if not isinstance(logits, SequenceTensor) or \
+            not isinstance(label, SequenceTensor):
+        raise TypeError("warpctc needs SequenceTensor logits + labels")
+    blank = int(ctx.attr('blank', 0))
+    norm_by_times = bool(ctx.attr('norm_by_times', False))
+
+    x = jnp.asarray(logits.data)                 # [B, T, C]
+    B, T, C = x.shape
+    in_lens = jnp.asarray(logits.lengths, jnp.int32)
+    lab = jnp.asarray(label.data)
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    lab = lab.astype(jnp.int32)                  # [B, L]
+    lab_lens = jnp.asarray(label.lengths, jnp.int32)
+    L = lab.shape[1]
+    S = 2 * L + 1                                # extended w/ blanks
+
+    logp = jax.nn.log_softmax(x, axis=-1)
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    pos_valid = (jnp.arange(S)[None, :] < (2 * lab_lens + 1)[:, None])
+    # can skip from s-2 to s if ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)),
+                     constant_values=-1)[:, :-2]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t, :], ext, axis=1)  # [B, S]
+
+    a0 = jnp.full((B, S), _NEG)
+    a0 = a0.at[:, 0].set(emit(0)[:, 0])
+    a0 = a0.at[:, 1].set(jnp.where(lab_lens > 0, emit(0)[:, 1], _NEG))
+
+    def step(alpha, t):
+        stay = alpha
+        prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                        constant_values=_NEG)[:, :-1]
+        prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                        constant_values=_NEG)[:, :-2]
+        prev2 = jnp.where(can_skip, prev2, _NEG)
+        a = _logsumexp3(stay, prev1, prev2) + emit(t)
+        a = jnp.where(pos_valid, a, _NEG)
+        keep = (t < in_lens)[:, None]
+        return jnp.where(keep, a, alpha), None
+
+    alphaT, _ = jax.lax.scan(step, a0, jnp.arange(1, T))
+    # final: sum of paths ending at last blank or last label
+    last_blank = 2 * lab_lens
+    last_label = jnp.maximum(2 * lab_lens - 1, 0)
+    fb = jnp.take_along_axis(alphaT, last_blank[:, None], axis=1)[:, 0]
+    fl = jnp.where(lab_lens > 0, jnp.take_along_axis(
+        alphaT, last_label[:, None], axis=1)[:, 0], _NEG)
+    nll = -_logsumexp2(fb, fl)
+    if norm_by_times:
+        nll = nll / jnp.maximum(in_lens.astype(nll.dtype), 1.0)
+    ctx.set_output('Loss', nll[:, None])
+    if ctx.output_names('WarpCTCGrad'):
+        ctx.set_output('WarpCTCGrad', jnp.zeros_like(x))
+
+
+@register_kernel('ctc_align')
+def _ctc_align(ctx):
+    """Greedy CTC collapse: argmax path -> merge repeats -> drop blanks.
+    Output ids stay left-packed in a static [B, T] buffer with updated
+    lengths. Parity: paddle/fluid/operators/ctc_align_op.h."""
+    inp = ctx.input('Input')
+    if not isinstance(inp, SequenceTensor):
+        raise TypeError("ctc_align needs a SequenceTensor input")
+    blank = int(ctx.attr('blank', 0))
+    merge = bool(ctx.attr('merge_repeated', True))
+    x = jnp.asarray(inp.data)
+    if x.ndim == 3 and x.shape[-1] > 1:  # probs [B, T, C] -> ids
+        ids = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    else:                                # token ids [B, T(, 1)]
+        ids = x.astype(jnp.int32)
+        if ids.ndim == 3:
+            ids = ids[..., 0]
+    B, T = ids.shape
+    lengths = jnp.asarray(inp.lengths, jnp.int32)
+    valid = (jnp.arange(T)[None, :] < lengths[:, None])
+    prev = jnp.pad(ids, ((0, 0), (1, 0)), constant_values=-1)[:, :-1]
+    keep = valid & (ids != blank)
+    if merge:
+        keep = keep & (ids != prev)
+    # left-pack kept ids: destination slot = cumsum(keep) - 1
+    dest = jnp.cumsum(keep, axis=1) - 1
+    new_len = jnp.maximum(dest[:, -1] + 1, 0).astype(jnp.int32)
+    out = jnp.zeros((B, T), jnp.int32)
+    bidx = jnp.arange(B)[:, None].repeat(T, 1)
+    out = out.at[bidx, jnp.where(keep, dest, T - 1)].set(
+        jnp.where(keep, ids, 0), mode='drop')
+    # rows where nothing kept: length 0
+    new_len = jnp.where(jnp.any(keep, axis=1), new_len, 0)
+    ctx.set_output('Output', SequenceTensor(out[..., None], new_len))
+
+
+@register_kernel('edit_distance')
+def _edit_distance(ctx):
+    """Levenshtein distance per (hyp, ref) pair -> [B, 1] float32.
+    Parity: paddle/fluid/operators/edit_distance_op.h (dynamic-programming
+    over a carried DP row inside lax.scan)."""
+    hyp = ctx.input('Hyps')
+    ref = ctx.input('Refs')
+    normalized = bool(ctx.attr('normalized', True))
+
+    def dense(st):
+        d = jnp.asarray(st.data)
+        if d.ndim == 3:
+            d = d[..., 0]
+        return d.astype(jnp.int32), jnp.asarray(st.lengths, jnp.int32)
+
+    h, hl = dense(hyp)
+    r, rl = dense(ref)
+    B, HT = h.shape
+    RT = r.shape[1]
+
+    def one(hs, hn, rs, rn):
+        # DP over ref positions; row carries distances for hyp prefix
+        row0 = jnp.arange(HT + 1, dtype=jnp.float32)
+        row0 = jnp.minimum(row0, hn.astype(jnp.float32))  # clamp pad
+
+        def step(row, j):
+            jn = (j + 1).astype(jnp.float32)
+            active_j = j < rn
+
+            def inner(carry, i):
+                prev_diag, out_prev = carry
+                up = row[i + 1]
+                sub = prev_diag + (hs[i] != rs[j])
+                val = jnp.minimum(jnp.minimum(up + 1, out_prev + 1), sub)
+                val = jnp.where(i < hn, val, out_prev)
+                return (up, val), val
+
+            (_, _), vals = jax.lax.scan(inner, (row[0], jn),
+                                        jnp.arange(HT))
+            new_row = jnp.concatenate([jn[None], vals])
+            return jnp.where(active_j, new_row, row), None
+
+        rowN, _ = jax.lax.scan(step, row0, jnp.arange(RT))
+        return rowN[hn]
+
+    dist = jax.vmap(one)(h, hl, r, rl).astype(jnp.float32)
+    if normalized:
+        dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    ctx.set_output('Out', dist[:, None])
+    ctx.set_output('SequenceNum', jnp.asarray([B], jnp.int32))

@@ -1,1 +1,497 @@
-"""Filled in by a later build phase this round."""
+"""Detection op kernels (SSD family) — static-shape, masked formulations.
+
+Parity: paddle/fluid/operators/{prior_box_op,box_coder_op,
+bipartite_match_op,target_assign_op,multiclass_nms_op,
+mine_hard_examples_op,detection_map_op,polygon_box_transform_op}.*
+
+The reference emits dynamically sized outputs (LoD'd match/NMS results);
+TPU kernels keep fixed box counts and mark invalid slots with -1 so every
+shape is static and the whole detection head stays inside one XLA program.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from .common import unwrap
+
+_NEG = -1e9
+
+
+# ---- prior box ------------------------------------------------------------------
+@register_kernel('prior_box')
+def _prior_box(ctx):
+    """SSD prior boxes. Output flattened [H*W*P, 4] (+ variances alike) so
+    multi_box_head can concat heads along axis 0.
+    Parity: paddle/fluid/operators/prior_box_op.h (ExpandAspectRatios +
+    per-cell box enumeration)."""
+    feat = unwrap(ctx.input('Input'))
+    image = unwrap(ctx.input('Image'))
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in ctx.attr('min_sizes')]
+    max_sizes = [float(s) for s in ctx.attr('max_sizes', [])]
+    ars = [float(a) for a in ctx.attr('aspect_ratios', [1.0])]
+    variances = [float(v) for v in ctx.attr('variances',
+                                            [0.1, 0.1, 0.2, 0.2])]
+    flip = bool(ctx.attr('flip', False))
+    clip = bool(ctx.attr('clip', False))
+    steps = ctx.attr('steps', [0.0, 0.0])
+    offset = float(ctx.attr('offset', 0.5))
+
+    step_w = float(steps[0]) or float(IW) / W
+    step_h = float(steps[1]) or float(IH) / H
+
+    expanded = [1.0]
+    for ar in ars:
+        if abs(ar - 1.0) < 1e-6:
+            continue
+        expanded.append(ar)
+        if flip:
+            expanded.append(1.0 / ar)
+
+    # per-cell (w, h) list, reference order: each min_size's aspect-ratio
+    # boxes immediately followed by its sqrt(min*max) box
+    # (prior_box_op.h interleaves max-size boxes per min_size)
+    whs = []
+    for i, m in enumerate(min_sizes):
+        for ar in expanded:
+            whs.append((m * (ar ** 0.5), m / (ar ** 0.5)))
+        if i < len(max_sizes):
+            s = (m * max_sizes[i]) ** 0.5
+            whs.append((s, s))
+    whs = jnp.asarray(whs, jnp.float32)  # [P, 2]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    centers = jnp.stack([cxg, cyg], -1)[:, :, None, :]      # [H, W, 1, 2]
+    half = (whs / 2.0)[None, None, :, :]                    # [1, 1, P, 2]
+    mins = (centers - half) / jnp.asarray([IW, IH], jnp.float32)
+    maxs = (centers + half) / jnp.asarray([IW, IH], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], -1)               # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    P = whs.shape[0]
+    boxes = boxes.reshape(H * W * P, 4)
+    var = jnp.tile(jnp.asarray(variances, jnp.float32)[None, :],
+                   (H * W * P, 1))
+    ctx.set_output('Boxes', boxes)
+    ctx.set_output('Variances', var)
+
+
+# ---- box coder ------------------------------------------------------------------
+def _to_center(b):
+    w = b[..., 2] - b[..., 0]
+    h = b[..., 3] - b[..., 1]
+    cx = b[..., 0] + w / 2
+    cy = b[..., 1] + h / 2
+    return cx, cy, w, h
+
+
+def _encode_center_size(target, prior, var):
+    """Center-size encoding of target vs prior boxes, shapes broadcast;
+    shared by box_coder and ssd_loss_fused (box_coder_op.h EncodeCenterSize)."""
+    tcx, tcy, tw, th = _to_center(target)
+    pcx, pcy, pw, ph = _to_center(prior)
+    return jnp.stack([
+        (tcx - pcx) / pw / var[..., 0],
+        (tcy - pcy) / ph / var[..., 1],
+        jnp.log(jnp.maximum(tw / pw, 1e-10)) / var[..., 2],
+        jnp.log(jnp.maximum(th / ph, 1e-10)) / var[..., 3]], -1)
+
+
+@register_kernel('box_coder')
+def _box_coder(ctx):
+    """encode: out[n, m] = encode(target n, prior m) -> [N, M, 4]
+    decode: loc [(B,) M, 4] + prior [M, 4] -> same shape as loc.
+    Parity: paddle/fluid/operators/box_coder_op.h."""
+    prior = unwrap(ctx.input('PriorBox'))
+    pvar = ctx.input('PriorBoxVar')
+    pvar = unwrap(pvar) if pvar is not None else jnp.asarray(
+        [1.0, 1.0, 1.0, 1.0], jnp.float32)
+    target = unwrap(ctx.input('TargetBox'))
+    code_type = (ctx.attr('code_type', 'encode_center_size') or '').lower()
+    pcx, pcy, pw, ph = _to_center(prior)
+    if pvar.ndim == 1:
+        pvar = jnp.broadcast_to(pvar, prior.shape)
+    if 'encode' in code_type:
+        out = _encode_center_size(target[:, None, :], prior[None, :, :],
+                                  pvar[None, :, :])
+    else:
+        t = target
+        shape = [1] * (t.ndim - 2) + [prior.shape[0]]
+        pcx_, pcy_ = pcx.reshape(shape), pcy.reshape(shape)
+        pw_, ph_ = pw.reshape(shape), ph.reshape(shape)
+        v = pvar.reshape(shape + [4])
+        ocx = v[..., 0] * t[..., 0] * pw_ + pcx_
+        ocy = v[..., 1] * t[..., 1] * ph_ + pcy_
+        ow = jnp.exp(v[..., 2] * t[..., 2]) * pw_
+        oh = jnp.exp(v[..., 3] * t[..., 3]) * ph_
+        out = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                         ocx + ow / 2, ocy + oh / 2], -1)
+    ctx.set_output('OutputBox', out)
+
+
+# ---- bipartite match ------------------------------------------------------------
+def _bipartite_one(dist):
+    """Greedy global-argmax bipartite matching on [G, P].
+    Returns (col_to_row [P] int32 with -1 unmatched, col dist [P])."""
+    G, P = dist.shape
+
+    def step(_, carry):
+        d, c2r, c2d = carry
+        flat = jnp.argmax(d)
+        g, p = flat // P, flat % P
+        best = d[g, p]
+        valid = best > _NEG / 2
+        c2r = jnp.where(valid, c2r.at[p].set(g.astype(jnp.int32)), c2r)
+        c2d = jnp.where(valid, c2d.at[p].set(best), c2d)
+        d = jnp.where(valid, d.at[g, :].set(_NEG).at[:, p].set(_NEG), d)
+        return d, c2r, c2d
+
+    c2r = jnp.full((P,), -1, jnp.int32)
+    c2d = jnp.zeros((P,), dist.dtype)
+    _, c2r, c2d = jax.lax.fori_loop(0, min(G, P), step,
+                                    (dist, c2r, c2d))
+    return c2r, c2d
+
+
+@register_kernel('bipartite_match')
+def _bipartite_match(ctx):
+    dist = unwrap(ctx.input('DistMat'))
+    match_type = ctx.attr('match_type', 'bipartite')
+    thr = float(ctx.attr('dist_threshold', 0.5))
+    squeeze = dist.ndim == 2
+    if squeeze:
+        dist = dist[None]
+    c2r, c2d = jax.vmap(_bipartite_one)(dist)
+    if match_type == 'per_prediction':
+        # also match any unmatched col whose best row dist >= threshold
+        best_row = jnp.argmax(dist, axis=1).astype(jnp.int32)  # [B, P]
+        best_val = jnp.max(dist, axis=1)
+        extra = (c2r < 0) & (best_val >= thr)
+        c2r = jnp.where(extra, best_row, c2r)
+        c2d = jnp.where(extra, best_val, c2d)
+    ctx.set_output('ColToRowMatchIndices', c2r)
+    ctx.set_output('ColToRowMatchDist', c2d)
+
+
+@register_kernel('target_assign')
+def _target_assign(ctx):
+    """out[n, p] = X[n, match[n, p]] (mismatch_value where match < 0).
+    Parity: paddle/fluid/operators/target_assign_op.h."""
+    x = unwrap(ctx.input('X'))
+    match = unwrap(ctx.input('MatchIndices'))
+    mismatch = ctx.attr('mismatch_value', 0)
+    if x.ndim == 2:                      # [G, K] shared across batch
+        x = jnp.broadcast_to(x[None], (match.shape[0],) + x.shape)
+    idx = jnp.maximum(match, 0)[..., None]
+    out = jnp.take_along_axis(x, jnp.broadcast_to(
+        idx, match.shape + (x.shape[-1],)), axis=1)
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, out.dtype))
+    weight = matched.astype(jnp.float32)
+    neg = ctx.input('NegIndices')
+    if neg is not None:
+        nidx = unwrap(neg)
+        valid = nidx >= 0
+        scat = jnp.where(valid, nidx, 0)
+        negsel = jax.vmap(
+            lambda s, v: jnp.zeros((match.shape[1],), bool)
+            .at[s].max(v))(scat, valid)
+        weight = jnp.maximum(weight, negsel[..., None].astype(jnp.float32))
+    ctx.set_output('Out', out)
+    ctx.set_output('OutWeight', weight)
+
+
+# ---- NMS ------------------------------------------------------------------------
+def _pairwise_iou(boxes):
+    """[M, 4] -> [M, M] IoU (computed once per image, shared by classes)."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+        jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                               1e-10)
+
+
+def _nms_class(scores, full_iou, nms_thr, top_k, score_thr):
+    """scores [M] + shared IoU [M, M] -> keep mask [M] after greedy NMS
+    over the top_k candidates."""
+    M = scores.shape[0]
+    k = min(top_k, M) if top_k > 0 else M
+    order = jnp.argsort(-scores)
+    cand = order[:k]
+    cscores = scores[cand]
+    iou = full_iou[jnp.ix_(cand, cand)]
+
+    def step(i, keep):
+        # suppress i if it overlaps a kept, higher-scoring candidate
+        sup = jnp.any(jnp.where(jnp.arange(k) < i,
+                                (iou[i] > nms_thr) & keep, False))
+        return keep.at[i].set(~sup & keep[i])
+
+    keep = cscores > score_thr
+    keep = jax.lax.fori_loop(0, k, step, keep)
+    mask = jnp.zeros((M,), bool).at[cand].set(keep)
+    return mask
+
+
+@register_kernel('multiclass_nms')
+def _multiclass_nms(ctx):
+    """Scores [N, C, M], BBoxes [N, M, 4] -> Out [N, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2), empty slots = -1.
+    Parity: paddle/fluid/operators/multiclass_nms_op.cc with the dynamic
+    LoD output replaced by fixed keep_top_k slots."""
+    scores = unwrap(ctx.input('Scores'))
+    boxes = unwrap(ctx.input('BBoxes'))
+    bg = int(ctx.attr('background_label', 0))
+    nms_thr = float(ctx.attr('nms_threshold', 0.3))
+    top_k = int(ctx.attr('nms_top_k', 400))
+    keep_top_k = int(ctx.attr('keep_top_k', 200))
+    score_thr = float(ctx.attr('score_threshold', 0.01))
+    N, C, M = scores.shape
+
+    def one(sc, bx):
+        full_iou = _pairwise_iou(bx)
+        masks = []
+        for c in range(C):
+            if c == bg:
+                masks.append(jnp.zeros((M,), bool))
+            else:
+                masks.append(_nms_class(sc[c], full_iou, nms_thr, top_k,
+                                        score_thr))
+        mask = jnp.stack(masks)                      # [C, M]
+        flat_scores = jnp.where(mask, sc, _NEG).reshape(-1)
+        # keep_top_k == -1 means keep everything (multiclass_nms_op.cc)
+        k = C * M if keep_top_k < 0 else min(keep_top_k, C * M)
+        vals, idx = jax.lax.top_k(flat_scores, k)
+        labels = (idx // M).astype(jnp.float32)
+        bidx = idx % M
+        out = jnp.concatenate([labels[:, None], vals[:, None], bx[bidx]],
+                              -1)
+        invalid = vals <= _NEG / 2
+        out = jnp.where(invalid[:, None], -1.0, out)
+        if 0 <= k < keep_top_k:
+            out = jnp.pad(out, ((0, keep_top_k - k), (0, 0)),
+                          constant_values=-1.0)
+        return out
+
+    ctx.set_output('Out', jax.vmap(one)(scores, boxes))
+
+
+# ---- hard example mining --------------------------------------------------------
+@register_kernel('mine_hard_examples')
+def _mine_hard_examples(ctx):
+    """max_negative mining. NegIndices [N, P] holds selected negative prior
+    indices (sorted by loss desc), -1 padded.
+    Parity: paddle/fluid/operators/mine_hard_examples_op.cc."""
+    cls_loss = unwrap(ctx.input('ClsLoss'))
+    loc_loss = ctx.input('LocLoss')
+    match = unwrap(ctx.input('MatchIndices'))
+    dist = unwrap(ctx.input('MatchDist'))
+    ratio = float(ctx.attr('neg_pos_ratio', 1.0))
+    thr = float(ctx.attr('neg_dist_threshold', 0.5))
+    sample_size = int(ctx.attr('sample_size', -1) or -1)
+    loss = cls_loss + (unwrap(loc_loss) if loc_loss is not None else 0.0)
+    if loss.ndim == 3:
+        loss = loss[..., 0]
+    N, P = match.shape
+    num_pos = jnp.sum(match >= 0, axis=1)                       # [N]
+    num_neg = jnp.minimum((num_pos * ratio).astype(jnp.int32), P)
+    if sample_size > 0:
+        num_neg = jnp.minimum(num_neg, sample_size)
+    cand = (match < 0) & (dist < thr)
+    masked = jnp.where(cand, loss, _NEG)
+    order = jnp.argsort(-masked, axis=1).astype(jnp.int32)      # [N, P]
+    rank = jnp.arange(P)[None, :]
+    ordered_valid = jnp.take_along_axis(cand, order, axis=1)
+    sel = (rank < num_neg[:, None]) & ordered_valid
+    neg = jnp.where(sel, order, -1)
+    ctx.set_output('NegIndices', neg)
+    ctx.set_output('UpdatedMatchIndices', match)
+
+
+# ---- fused SSD loss -------------------------------------------------------------
+@register_kernel('ssd_loss_fused')
+def _ssd_loss_fused(ctx):
+    """Matched-prior smooth-L1 + mined softmax cross-entropy, one fused
+    XLA computation. Parity: the op pipeline built by the reference's
+    layers/detection.py::ssd_loss (box_coder + target_assign +
+    mine_hard_examples + smooth_l1 + softmax_with_cross_entropy)."""
+    loc = unwrap(ctx.input('Location'))          # [N, P, 4]
+    conf = unwrap(ctx.input('Confidence'))       # [N, P, C]
+    gt_box = unwrap(ctx.input('GTBox'))          # [G, 4] or [N, G, 4]
+    gt_label = unwrap(ctx.input('GTLabel'))      # [G] / [N, G]
+    prior = unwrap(ctx.input('PriorBox'))        # [P, 4]
+    match = unwrap(ctx.input('MatchIndices'))    # [N, P]
+    bg = int(ctx.attr('background_label', 0))
+    ratio = float(ctx.attr('neg_pos_ratio', 3.0))
+    loc_w = float(ctx.attr('loc_loss_weight', 1.0))
+    conf_w = float(ctx.attr('conf_loss_weight', 1.0))
+    normalize = bool(ctx.attr('normalize', True))
+
+    N, P = match.shape
+    if gt_box.ndim == 2:
+        gt_box = jnp.broadcast_to(gt_box[None], (N,) + gt_box.shape)
+    gt_label = gt_label.reshape(N, -1) if gt_label.ndim > 1 else \
+        jnp.broadcast_to(gt_label[None], (N, gt_label.shape[0]))
+
+    idx = jnp.maximum(match, 0)
+    matched_gt = jnp.take_along_axis(
+        gt_box, jnp.broadcast_to(idx[..., None], match.shape + (4,)),
+        axis=1)                                  # [N, P, 4]
+    pos = (match >= 0).astype(jnp.float32)
+
+    # encode matched gt against priors (the loc regression target);
+    # SSD default variances, as the ssd_loss layer does not thread
+    # prior_box_var into the fused op
+    var = jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32)
+    tgt = _encode_center_size(matched_gt, prior[None], var)
+
+    d = jnp.abs(loc - tgt)
+    sl1 = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5).sum(-1)    # [N, P]
+    loc_loss = (sl1 * pos).sum(1)
+
+    labels = jnp.take_along_axis(gt_label, idx, axis=1)
+    labels = jnp.where(match >= 0, labels, bg).astype(jnp.int32)
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    xent = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+
+    num_pos = pos.sum(1)
+    num_neg = jnp.minimum((num_pos * ratio).astype(jnp.int32), P)
+    neg_cand = jnp.where(match < 0, xent, _NEG)
+    order = jnp.argsort(-neg_cand, axis=1)
+    rank_of = jnp.argsort(order, axis=1)
+    neg_sel = (rank_of < num_neg[:, None]) & (match < 0)
+    conf_loss = (xent * (pos + neg_sel.astype(jnp.float32))).sum(1)
+
+    total = loc_w * loc_loss + conf_w * conf_loss
+    if normalize:
+        total = total / jnp.maximum(num_pos, 1.0)
+    ctx.set_output('Loss', total[:, None])
+
+
+# ---- detection mAP --------------------------------------------------------------
+@register_kernel('detection_map')
+def _detection_map(ctx):
+    """Simplified single-batch mAP (integral AP). DetectRes [D, 6]
+    (label, score, box), Label [G, 5+] (label, box, ...). Invalid rows
+    have label < 0. Parity (simplified — no difficult handling, one
+    image set per call): paddle/fluid/operators/detection_map_op.h."""
+    det = unwrap(ctx.input('DetectRes'))
+    gt = unwrap(ctx.input('Label'))
+    thr = float(ctx.attr('overlap_threshold', 0.3))
+    class_num = int(ctx.attr('class_num'))
+    if det.ndim == 3:
+        det = det.reshape(-1, det.shape[-1])
+    if gt.ndim == 3:
+        gt = gt.reshape(-1, gt.shape[-1])
+    gt_label = gt[:, 0]
+    gt_box = gt[:, 1:5]
+    d_label, d_score, d_box = det[:, 0], det[:, 1], det[:, 2:6]
+
+    lt = jnp.maximum(d_box[:, None, :2], gt_box[None, :, :2])
+    rb = jnp.minimum(d_box[:, None, 2:], gt_box[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    a1 = jnp.maximum(d_box[:, 2] - d_box[:, 0], 0) * \
+        jnp.maximum(d_box[:, 3] - d_box[:, 1], 0)
+    a2 = jnp.maximum(gt_box[:, 2] - gt_box[:, 0], 0) * \
+        jnp.maximum(gt_box[:, 3] - gt_box[:, 1], 0)
+    iou = inter / jnp.maximum(a1[:, None] + a2[None, :] - inter, 1e-10)
+
+    aps = []
+    present = []
+    for c in range(class_num):
+        dmask = (d_label == c)
+        gmask = (gt_label == c)
+        n_gt = gmask.sum()
+        ok = (iou >= thr) & gmask[None, :]
+        order = jnp.argsort(-jnp.where(dmask, d_score, _NEG))
+
+        def step(i, carry):
+            used, tp = carry
+            di = order[i]
+            hits = ok[di] & ~used
+            hit = jnp.any(hits) & dmask[di]
+            first = jnp.argmax(hits)
+            used = jnp.where(hit, used.at[first].set(True), used)
+            tp = tp.at[i].set(hit)
+            return used, tp
+
+        used0 = jnp.zeros(gt_box.shape[0], bool)
+        tp0 = jnp.zeros(det.shape[0], bool)
+        _, tp = jax.lax.fori_loop(0, det.shape[0], step, (used0, tp0))
+        valid = jnp.take(dmask, order)
+        tp_c = jnp.cumsum(tp.astype(jnp.float32))
+        fp_c = jnp.cumsum((valid & ~tp).astype(jnp.float32))
+        recall = tp_c / jnp.maximum(n_gt, 1)
+        precision = tp_c / jnp.maximum(tp_c + fp_c, 1e-10)
+        # integral AP: sum precision deltas where recall increases
+        d_recall = jnp.diff(recall, prepend=0.0)
+        ap = jnp.sum(precision * d_recall)
+        aps.append(ap)
+        present.append((n_gt > 0).astype(jnp.float32))
+    aps = jnp.stack(aps)
+    present = jnp.stack(present)
+    mAP = jnp.sum(aps * present) / jnp.maximum(jnp.sum(present), 1.0)
+    ctx.set_output('MAP', mAP.reshape(1))
+
+
+@register_kernel('polygon_box_transform')
+def _polygon_box_transform(ctx):
+    """Parity: paddle/fluid/operators/polygon_box_transform_op.cc —
+    out = 4*grid_coord - in (x for even channels, y for odd)."""
+    x = unwrap(ctx.input('Input'))
+    N, C, H, W = x.shape
+    col = jnp.broadcast_to(jnp.arange(W, dtype=x.dtype), (H, W))
+    row = jnp.broadcast_to(jnp.arange(H, dtype=x.dtype)[:, None], (H, W))
+    grid = jnp.stack([col, row] * (C // 2), 0)  # [C, H, W] alternating
+    ctx.set_output('Output', 4.0 * grid[None] - x)
+
+
+@register_kernel('roi_pool')
+def _roi_pool(ctx):
+    """ROI max pooling. ROIs: [R, 4] (x1, y1, x2, y2; batch 0) or [R, 5]
+    (batch_id first). Parity: paddle/fluid/operators/roi_pool_op.h —
+    masked-max over bin extents instead of per-bin pointer walks."""
+    x = unwrap(ctx.input('X'))                   # [N, C, H, W]
+    rois = unwrap(ctx.input('ROIs'))
+    ph = int(ctx.attr('pooled_height', 1))
+    pw = int(ctx.attr('pooled_width', 1))
+    scale = float(ctx.attr('spatial_scale', 1.0))
+    N, C, H, W = x.shape
+    if rois.shape[-1] == 5:
+        batch_ids = rois[:, 0].astype(jnp.int32)
+        rois = rois[:, 1:]
+    else:
+        batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
+    r = jnp.round(rois * scale)
+    x1, y1 = r[:, 0], r[:, 1]
+    x2, y2 = jnp.maximum(r[:, 2], x1 + 1), jnp.maximum(r[:, 3], y1 + 1)
+    bin_h = (y2 - y1) / ph
+    bin_w = (x2 - x1) / pw
+
+    hh = jnp.arange(H, dtype=jnp.float32)
+    ww = jnp.arange(W, dtype=jnp.float32)
+
+    def one(bid, xx1, yy1, bh, bw):
+        feat = x[bid]                            # [C, H, W]
+        outs = []
+        for i in range(ph):
+            hs, he = yy1 + i * bh, yy1 + (i + 1) * bh
+            hmask = (hh >= jnp.floor(hs)) & (hh < jnp.ceil(he))
+            for j in range(pw):
+                ws, we = xx1 + j * bw, xx1 + (j + 1) * bw
+                wmask = (ww >= jnp.floor(ws)) & (ww < jnp.ceil(we))
+                m = hmask[:, None] & wmask[None, :]
+                v = jnp.max(jnp.where(m[None], feat, _NEG), axis=(1, 2))
+                v = jnp.where(jnp.any(m), v, 0.0)
+                outs.append(v)
+        return jnp.stack(outs, -1).reshape(C, ph, pw)
+
+    out = jax.vmap(one)(batch_ids, x1, y1, bin_h, bin_w)
+    ctx.set_output('Out', out)

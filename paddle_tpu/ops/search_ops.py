@@ -1,0 +1,106 @@
+"""Beam search ops — static-width beams on dense [B*K, ...] rows.
+
+Parity: paddle/fluid/operators/{beam_search_op,beam_search_decode_op}.cc.
+The reference keeps candidates in 2-level LoD tensors (batch -> beams)
+whose widths shrink as beams finish; the TPU design keeps a FIXED beam
+width K: row r = batch (r // K), beam slot (r % K). Finished beams
+(pre_id == end_id) emit end_id with a frozen score, so every shape is
+static and the whole decode loop compiles into one lax.while_loop.
+
+Parent pointers are a first-class output here (slot 'parent_idx');
+the reference recovers parentage from LoD offsets instead.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_kernel
+from ..lod import SequenceTensor
+
+_NEG = -1e9
+
+
+def _rows(v):
+    d = v.data if isinstance(v, SequenceTensor) else v
+    return jnp.asarray(d)
+
+
+@register_kernel('beam_search')
+def _beam_search(ctx):
+    pre_ids = _rows(ctx.input('pre_ids')).reshape(-1)          # [B*K]
+    ids = _rows(ctx.input('ids'))                              # [B*K, C]
+    scores = _rows(ctx.input('scores'))                        # [B*K, C]
+    if ids.ndim == 3:
+        ids = ids[..., 0]
+    if scores.ndim == 3:
+        scores = scores[..., 0]
+    K = int(ctx.attr('beam_size'))
+    end_id = int(ctx.attr('end_id'))
+    BK, C = ids.shape
+    B = BK // K
+
+    finished = (pre_ids == end_id)
+    # finished beams contribute exactly one candidate: (end_id, score
+    # frozen at the beam's accumulated value, stored in scores[:, 0])
+    ids = jnp.where(finished[:, None], end_id, ids)
+    frozen = jnp.where(jnp.arange(C)[None, :] == 0,
+                       scores[:, 0][:, None],
+                       jnp.full_like(scores, _NEG))
+    scores = jnp.where(finished[:, None], frozen, scores)
+
+    flat_scores = scores.reshape(B, K * C)
+    top_scores, flat_idx = jax.lax.top_k(flat_scores, K)       # [B, K]
+    # parent as a GLOBAL row index (batch offset included) so the decode
+    # backtrack can follow it directly across the [B*K] row space
+    parent = (flat_idx // C).astype(jnp.int32) + \
+        (jnp.arange(B, dtype=jnp.int32) * K)[:, None]
+    tok = jnp.take_along_axis(ids.reshape(B, K * C), flat_idx,
+                              axis=1).astype(jnp.int32)
+    ctx.set_output('selected_ids', tok.reshape(BK, 1))
+    ctx.set_output('selected_scores', top_scores.reshape(BK, 1))
+    if ctx.output_names('parent_idx'):
+        ctx.set_output('parent_idx', parent.reshape(BK, 1))
+
+
+@register_kernel('beam_search_decode')
+def _beam_search_decode(ctx):
+    """Backtrack tensor arrays of (ids, scores, parents) written once per
+    decode step. SentenceIds: SequenceTensor [B*K, cap] — beam r holds the
+    full token path of (batch r//K, slot r%K); SentenceScores carries each
+    beam's final accumulated score per position."""
+    ids_arr = ctx.input('Ids')
+    scores_arr = ctx.input('Scores')
+    parents_arr = ctx.input('Parents')
+    if not (isinstance(ids_arr, dict) and 'buf' in ids_arr):
+        raise TypeError("beam_search_decode expects tensor arrays "
+                        "(array_write the step outputs)")
+    if parents_arr is None:
+        raise ValueError("beam_search_decode needs the Parents array "
+                         "(pass parent_idx from layers.beam_search)")
+    ids_buf = ids_arr['buf'][..., 0] if ids_arr['buf'].ndim == 3 \
+        else ids_arr['buf']                                    # [cap, BK]
+    par_buf = parents_arr['buf'][..., 0] \
+        if parents_arr['buf'].ndim == 3 else parents_arr['buf']
+    sc_buf = scores_arr['buf'][..., 0] \
+        if scores_arr['buf'].ndim == 3 else scores_arr['buf']
+    n = ids_arr['len']
+    cap, BK = ids_buf.shape
+
+    # walk backwards: slot r follows its parent chain; steps >= n frozen
+    def back(slot, t):
+        active = t < n
+        tok = jnp.take_along_axis(ids_buf[t], slot, axis=0)
+        par = jnp.take_along_axis(par_buf[t], slot, axis=0)
+        new_slot = jnp.where(active, par.astype(jnp.int32), slot)
+        tok = jnp.where(active, tok, 0)
+        return new_slot, tok
+
+    # final beams are identity slots within each batch group
+    slot0 = jnp.arange(BK, dtype=jnp.int32)
+    _, toks_rev = jax.lax.scan(back, slot0,
+                               jnp.arange(cap - 1, -1, -1))
+    toks = jnp.flip(jnp.swapaxes(toks_rev, 0, 1), axis=1)      # [BK, cap]
+    lengths = jnp.full((BK,), 1, jnp.int32) * n.astype(jnp.int32)
+    final_scores = sc_buf[jnp.maximum(n - 1, 0)]               # [BK]
+    ctx.set_output('SentenceIds', SequenceTensor(toks, lengths))
+    ctx.set_output('SentenceScores', SequenceTensor(
+        jnp.broadcast_to(final_scores[:, None], toks.shape), lengths))

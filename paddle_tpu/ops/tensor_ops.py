@@ -249,8 +249,9 @@ def _reverse(ctx):
 
 @register_kernel('increment')
 def _increment(ctx):
-    x = unwrap(ctx.input('X'))
-    ctx.set_output('Out', x + ctx.attr('step', 1.0))
+    x = jnp.asarray(unwrap(ctx.input('X')))
+    step = ctx.attr('step', 1.0)
+    ctx.set_output('Out', x + jnp.asarray(step).astype(x.dtype))
 
 
 @register_kernel('is_empty')

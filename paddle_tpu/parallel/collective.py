@@ -1,0 +1,54 @@
+"""Functional collective API over mesh axes.
+
+Parity: the reference exposes collectives implicitly through NCCL-backed
+ops inserted by ParallelExecutor / distribute_transpiler
+(paddle/fluid/platform/nccl_helper.h). Here they are thin, explicit
+wrappers over jax.lax collectives for use inside shard_map'ed model code
+(ring attention, ZeRO gathers, pipeline sends). Under plain jit SPMD you
+normally don't call these — XLA inserts the collectives from shardings.
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ['all_reduce', 'all_gather', 'reduce_scatter', 'broadcast',
+           'ring_permute', 'barrier', 'axis_index', 'axis_size']
+
+
+def all_reduce(x, axis_name='dp', op='sum'):
+    fn = {'sum': jax.lax.psum, 'max': jax.lax.pmax, 'min': jax.lax.pmin,
+          'mean': jax.lax.pmean, 'avg': jax.lax.pmean}[op]
+    return fn(x, axis_name)
+
+
+def all_gather(x, axis_name='dp', axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name='dp', axis=0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def broadcast(x, axis_name='dp', root=0):
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)),
+                        axis_name)
+
+
+def ring_permute(x, axis_name='sp', offset=1):
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def barrier(axis_name='dp'):
+    """A psum over a unit — forces cross-device synchronization."""
+    return jax.lax.psum(jnp.ones(()), axis_name)
+
+
+def axis_index(axis_name='dp'):
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name='dp'):
+    return jax.lax.psum(1, axis_name)

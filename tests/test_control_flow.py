@@ -1,0 +1,194 @@
+"""Control-flow layers: While, tensor arrays, Switch, IfElse, StaticRNN,
+DynamicRNN (SURVEY.md §4; parity:
+python/paddle/fluid/tests/unittests/test_while_op.py,
+test_recurrent_op.py, test_dyn_rnn.py, test_switch.py).
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.lod import create_lod_tensor
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_while_sum_of_squares():
+    # sum i^2 for i in [0, 10) computed on-device via lax.while_loop
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype='float32', value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                           value=10)
+        acc = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                         value=0)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            sq = fluid.layers.elementwise_mul(i, i)
+            new_acc = fluid.layers.elementwise_add(acc, sq)
+            fluid.layers.assign(new_acc, acc)
+            fluid.layers.increment(x=i, value=1.0, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+    out, = _exe().run(main, feed={}, fetch_list=[acc])
+    assert float(out[0]) == sum(k * k for k in range(10))
+
+
+def test_while_with_array_write_read():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype='int32', value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype='int32',
+                                           value=5)
+        x = fluid.layers.fill_constant(shape=[3], dtype='float32', value=1)
+        arr = fluid.layers.array_write(x, i)  # arr[0] = ones, pre-loop
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            prev = fluid.layers.array_read(arr, i)
+            nxt = fluid.layers.scale(prev, scale=2.0)
+            fluid.layers.increment(x=i, value=1.0, in_place=True)
+            fluid.layers.array_write(nxt, i, array=arr)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        last = fluid.layers.array_read(arr, i)
+        n = fluid.layers.array_length(arr)
+    last_v, n_v = _exe().run(main, feed={}, fetch_list=[last, n])
+    np.testing.assert_allclose(last_v, np.full(3, 32.0))
+    assert int(n_v[0]) == 6
+
+
+def test_switch_piecewise():
+    # Switch drives piecewise value selection (the LR-decay pattern)
+    for step_val, want in [(0.0, 0.1), (1.0, 0.01), (5.0, 0.001)]:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            step = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                              value=step_val)
+            one = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                             value=1.0)
+            two = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                             value=2.0)
+            lr = fluid.layers.tensor.create_global_var(
+                shape=[1], value=0.0, dtype='float32',
+                persistable=True, name='lr_%s' % step_val)
+            with fluid.layers.Switch() as switch:
+                with switch.case(fluid.layers.less_than(step, one)):
+                    fluid.layers.assign(fluid.layers.fill_constant(
+                        shape=[1], dtype='float32', value=0.1), lr)
+                with switch.case(fluid.layers.less_than(step, two)):
+                    fluid.layers.assign(fluid.layers.fill_constant(
+                        shape=[1], dtype='float32', value=0.01), lr)
+                with switch.default():
+                    fluid.layers.assign(fluid.layers.fill_constant(
+                        shape=[1], dtype='float32', value=0.001), lr)
+        exe = _exe()
+        exe.run(startup)
+        out, = exe.run(main, feed={}, fetch_list=[lr])
+        assert abs(float(out[0]) - want) < 1e-7, (step_val, out)
+
+
+def test_ifelse_masked_merge():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32')
+        zero = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                          value=0.0)
+        cond = fluid.layers.less_than(x=zero, y=x)  # x > 0, per-row
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(fluid.layers.scale(ie.input(x), scale=2.0))
+        with ie.false_block():
+            ie.output(fluid.layers.scale(ie.input(x), scale=-1.0))
+        out = ie()[0]
+    xs = np.array([[1.0], [-2.0], [3.0], [-4.0]], np.float32)
+    got, = _exe().run(main, feed={'x': xs}, fetch_list=[out])
+    want = np.where(xs > 0, xs * 2.0, -xs)
+    np.testing.assert_allclose(np.asarray(got), want)
+
+
+def test_static_rnn_cumsum():
+    T, B, D = 4, 3, 2
+    xs = np.random.RandomState(0).randn(T, B, D).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[B, D], dtype='float32')
+        # feed is [T, B, D]; data() prepends batch dim -> treat T as batch
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            # batch_ref is the outside [T, B, D] input; its dim 1 is batch
+            mem = rnn.memory(shape=[-1, D], batch_ref=x, init_value=0.0)
+            acc = fluid.layers.elementwise_add(mem, x_t)
+            rnn.update_memory(mem, acc)
+            rnn.step_output(acc)
+        out = rnn()
+    got, = _exe().run(main, feed={'x': xs}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.cumsum(xs, axis=0),
+                               rtol=1e-5)
+
+
+def test_dynamic_rnn_masked_cumsum():
+    lens = [3, 1, 4]
+    D = 2
+    rng = np.random.RandomState(1)
+    data = rng.randn(sum(lens), D).astype('float32')
+    st = create_lod_tensor(data, [lens])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[D], dtype='float32',
+                              lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x)
+            mem = drnn.memory(shape=[D], value=0.0)
+            acc = fluid.layers.elementwise_add(mem, x_t)
+            drnn.update_memory(mem, acc)
+            drnn.output(acc)
+        out = drnn()
+        pooled = fluid.layers.sequence_pool(out, pool_type='last')
+    got, = _exe().run(main, feed={'x': st}, fetch_list=[pooled])
+    # last state of each sequence == sum over its rows
+    off = np.concatenate([[0], np.cumsum(lens)])
+    want = np.stack([data[off[i]:off[i + 1]].sum(0)
+                     for i in range(len(lens))])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_array_ops_outside_loop():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x0 = fluid.layers.fill_constant(shape=[2], dtype='float32', value=3)
+        x1 = fluid.layers.fill_constant(shape=[2], dtype='float32', value=7)
+        i0 = fluid.layers.fill_constant(shape=[1], dtype='int32', value=0)
+        i1 = fluid.layers.fill_constant(shape=[1], dtype='int32', value=1)
+        arr = fluid.layers.array_write(x0, i0)
+        fluid.layers.array_write(x1, i1, array=arr)
+        r0 = fluid.layers.array_read(arr, i0)
+        r1 = fluid.layers.array_read(arr, i1)
+        n = fluid.layers.array_length(arr)
+    r0v, r1v, nv = _exe().run(main, feed={}, fetch_list=[r0, r1, n])
+    np.testing.assert_allclose(r0v, [3, 3])
+    np.testing.assert_allclose(r1v, [7, 7])
+    assert int(nv[0]) == 2
+
+
+def test_lod_rank_table_array_round_trip():
+    lens = [2, 4, 1]
+    D = 3
+    data = np.arange(sum(lens) * D, dtype='float32').reshape(-1, D)
+    st = create_lod_tensor(data, [lens])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[D], dtype='float32',
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        mx = fluid.layers.max_sequence_len(table)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        pooled = fluid.layers.sequence_pool(back, pool_type='sum')
+    mx_v, pooled_v = _exe().run(main, feed={'x': st},
+                                fetch_list=[mx, pooled])
+    assert int(mx_v[0]) == 4
+    off = np.concatenate([[0], np.cumsum(lens)])
+    want = np.stack([data[off[i]:off[i + 1]].sum(0) for i in range(3)])
+    np.testing.assert_allclose(np.asarray(pooled_v), want, rtol=1e-5)

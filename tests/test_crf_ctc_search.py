@@ -1,0 +1,254 @@
+"""CRF / CTC / beam-search ops vs brute-force numpy references
+(SURVEY.md §2.2; parity: tests/unittests/test_{linear_chain_crf,
+crf_decoding,warpctc,edit_distance,chunk_eval}_op.py)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.lod import create_lod_tensor
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def _brute_crf(x, trans, lens):
+    """Enumerate all tag paths: returns (logZ, best_path) per sequence."""
+    start, end, w = trans[0], trans[1], trans[2:]
+    S = x.shape[-1]
+    outs = []
+    for b in range(x.shape[0]):
+        T = lens[b]
+        best, best_p, logZ_terms = -1e30, None, []
+        for path in itertools.product(range(S), repeat=T):
+            s = start[path[0]] + end[path[-1]] + \
+                sum(x[b, t, path[t]] for t in range(T)) + \
+                sum(w[path[t - 1], path[t]] for t in range(1, T))
+            logZ_terms.append(s)
+            if s > best:
+                best, best_p = s, path
+        m = np.max(logZ_terms)
+        logZ = m + np.log(np.sum(np.exp(np.asarray(logZ_terms) - m)))
+        outs.append((logZ, best_p))
+    return outs
+
+
+def test_linear_chain_crf_and_decoding():
+    rng = np.random.RandomState(0)
+    S = 3
+    lens = [3, 2]
+    em_rows = rng.randn(sum(lens), S).astype('float32')
+    trans_np = rng.randn(S + 2, S).astype('float32') * 0.5
+    labels = np.array([[1], [0], [2], [2], [1]], np.int64)
+
+    st = create_lod_tensor(em_rows, [lens])
+    lab = create_lod_tensor(labels, [lens])
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        em = fluid.layers.data(name='em', shape=[S], dtype='float32',
+                               lod_level=1)
+        lb = fluid.layers.data(name='lb', shape=[1], dtype='int64',
+                               lod_level=1)
+        crf_cost = fluid.layers.linear_chain_crf(
+            input=em, label=lb,
+            param_attr=fluid.ParamAttr(name='crfw'))
+        decode = fluid.layers.crf_decoding(
+            input=em, param_attr=fluid.ParamAttr(name='crfw'))
+    exe = _exe()
+    exe.run(startup)
+    import paddle_tpu.executor as pexec
+    pexec.global_scope().set_var('crfw', trans_np)
+    cost_v, path_v = exe.run(main, feed={'em': st, 'lb': lab},
+                             fetch_list=[crf_cost, decode])
+
+    x = np.asarray(st.data)
+    ref = _brute_crf(x, trans_np, lens)
+    off = np.concatenate([[0], np.cumsum(lens)])
+    for b, (logZ, best_p) in enumerate(ref):
+        T = lens[b]
+        gold = labels[off[b]:off[b + 1], 0]
+        score = trans_np[0, gold[0]] + trans_np[1, gold[-1]] + \
+            sum(x[b, t, gold[t]] for t in range(T)) + \
+            sum(trans_np[2 + gold[t - 1], gold[t]] for t in range(1, T))
+        want_nll = logZ - score
+        np.testing.assert_allclose(np.asarray(cost_v)[b, 0], want_nll,
+                                   rtol=1e-4, atol=1e-4)
+        got_path = np.asarray(path_v.data)[b, :T, 0]
+        assert list(got_path) == list(best_p), (b, got_path, best_p)
+
+
+def test_crf_converges_on_toy_tagging():
+    # end-to-end: emissions + CRF trained until the gold path wins
+    rng = np.random.RandomState(1)
+    S, D = 3, 4
+    lens = [4, 3, 5]
+    feats = rng.randn(sum(lens), D).astype('float32')
+    gold = (np.arange(sum(lens)) % S).astype('int64')[:, None]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[D], dtype='float32',
+                              lod_level=1)
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64',
+                              lod_level=1)
+        em = fluid.layers.fc(input=x, size=S)
+        cost = fluid.layers.linear_chain_crf(
+            input=em, label=y, param_attr=fluid.ParamAttr(name='crfw2'))
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+    exe = _exe()
+    exe.run(startup)
+    st, lab = create_lod_tensor(feats, [lens]), \
+        create_lod_tensor(gold, [lens])
+    losses = [float(np.asarray(exe.run(
+        main, feed={'x': st, 'y': lab}, fetch_list=[avg])[0]).mean())
+        for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def _brute_ctc(logits, labels, blank):
+    """Sum probability over all alignments via DP (numpy, log space)."""
+    T, C = logits.shape
+    p = logits - np.log(np.sum(np.exp(logits), -1, keepdims=True))
+    L = len(labels)
+    ext = [blank]
+    for l in labels:
+        ext += [l, blank]
+    S = len(ext)
+    a = np.full((T, S), -1e30)
+    a[0, 0] = p[0, ext[0]]
+    if S > 1:
+        a[0, 1] = p[0, ext[1]]
+    for t in range(1, T):
+        for s in range(S):
+            cands = [a[t - 1, s]]
+            if s >= 1:
+                cands.append(a[t - 1, s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                cands.append(a[t - 1, s - 2])
+            m = np.max(cands)
+            a[t, s] = m + np.log(np.sum(np.exp(np.asarray(cands) - m))) \
+                + p[t, ext[s]]
+    last = [a[T - 1, S - 1]]
+    if S > 1:
+        last.append(a[T - 1, S - 2])
+    m = np.max(last)
+    return -(m + np.log(np.sum(np.exp(np.asarray(last) - m))))
+
+
+def test_warpctc_matches_dp():
+    rng = np.random.RandomState(0)
+    C = 5
+    in_lens = [6, 4]
+    lab_lens = [2, 3]
+    logits = rng.randn(sum(in_lens), C).astype('float32')
+    labels = np.array([[1], [2], [3], [1], [4]], np.int64)
+
+    st = create_lod_tensor(logits, [in_lens])
+    lab = create_lod_tensor(labels, [lab_lens])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lg = fluid.layers.data(name='lg', shape=[C], dtype='float32',
+                               lod_level=1)
+        lb = fluid.layers.data(name='lb', shape=[1], dtype='int64',
+                               lod_level=1)
+        loss = fluid.layers.warpctc(input=lg, label=lb, blank=0)
+    out, = _exe().run(main, feed={'lg': st, 'lb': lab},
+                      fetch_list=[loss])
+    off_x = np.concatenate([[0], np.cumsum(in_lens)])
+    off_l = np.concatenate([[0], np.cumsum(lab_lens)])
+    for b in range(2):
+        want = _brute_ctc(logits[off_x[b]:off_x[b + 1]],
+                          labels[off_l[b]:off_l[b + 1], 0], blank=0)
+        np.testing.assert_allclose(np.asarray(out)[b, 0], want,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_greedy_decoder():
+    # argmax path b,b,blank,c,c,blank,a -> b,c,a
+    T, C = 7, 4
+    probs = np.zeros((T, C), np.float32)
+    for t, c in enumerate([2, 2, 0, 3, 3, 0, 1]):
+        probs[t, c] = 5.0
+    st = create_lod_tensor(probs, [[T]])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[C], dtype='float32',
+                              lod_level=1)
+        out = fluid.layers.ctc_greedy_decoder(input=x, blank=0)
+    res, = _exe().run(main, feed={'x': st}, fetch_list=[out])
+    L = int(np.asarray(res.lengths)[0])
+    assert list(np.asarray(res.data)[0, :L, 0]) == [2, 3, 1]
+
+
+def test_edit_distance():
+    # kitten -> sitting = 3 (as int sequences)
+    kitten = [10, 8, 19, 19, 4, 13]
+    sitting = [18, 8, 19, 19, 8, 13, 6]
+    hyp = create_lod_tensor(np.asarray(kitten, np.int64)[:, None],
+                            [[len(kitten)]])
+    ref = create_lod_tensor(np.asarray(sitting, np.int64)[:, None],
+                            [[len(sitting)]])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        h = fluid.layers.data(name='h', shape=[1], dtype='int64',
+                              lod_level=1)
+        r = fluid.layers.data(name='r', shape=[1], dtype='int64',
+                              lod_level=1)
+        d, n = fluid.layers.edit_distance(h, r, normalized=False)
+    dv, nv = _exe().run(main, feed={'h': hyp, 'r': ref},
+                        fetch_list=[d, n])
+    assert float(np.asarray(dv)[0, 0]) == 3.0
+    assert int(np.asarray(nv)[0]) == 1
+
+
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types. ids: type*2 + tag(B=0, I=1); O = 4
+    #          B0 I0 O  B1 I1 I1   (gold: chunks [0-1 type0], [3-5 type1])
+    label = [0, 1, 4, 2, 3, 3]
+    #          B0 I0 O  B1 O  O    (pred: [0-1 type0] correct, [3 type1] wrong extent)
+    inference = [0, 1, 4, 2, 4, 4]
+    lab = create_lod_tensor(np.asarray(label, np.int64)[:, None],
+                            [[len(label)]])
+    inf = create_lod_tensor(np.asarray(inference, np.int64)[:, None],
+                            [[len(inference)]])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        iv = fluid.layers.data(name='i', shape=[1], dtype='int64',
+                               lod_level=1)
+        lv = fluid.layers.data(name='l', shape=[1], dtype='int64',
+                               lod_level=1)
+        outs = fluid.layers.chunk_eval(iv, lv, chunk_scheme='IOB',
+                                       num_chunk_types=2)
+    p, r, f1, ni, nl, nc = _exe().run(main, feed={'i': inf, 'l': lab},
+                                      fetch_list=list(outs))
+    assert int(np.asarray(ni)[0]) == 2
+    assert int(np.asarray(nl)[0]) == 2
+    assert int(np.asarray(nc)[0]) == 1
+    np.testing.assert_allclose(np.asarray(p)[0], 0.5)
+    np.testing.assert_allclose(np.asarray(r)[0], 0.5)
+
+
+def test_beam_search_step_and_decode():
+    # B=1, K=2, C=2 candidates/beam, 2 steps, end_id=9
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_kernel
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre = fluid.layers.data(name='pre', shape=[1], dtype='int64')
+        ids = fluid.layers.data(name='ids', shape=[2], dtype='int64')
+        sc = fluid.layers.data(name='sc', shape=[2], dtype='float32')
+        sel_ids, sel_sc = fluid.layers.beam_search(
+            pre, ids, sc, beam_size=2, end_id=9)
+    feed = {
+        'pre': np.array([[1], [2]], np.int64),
+        # beam 0 candidates (5: -0.1), (6: -3); beam 1 (7: -0.5), (8: -4)
+        'ids': np.array([[5, 6], [7, 8]], np.int64),
+        'sc': np.array([[-0.1, -3.0], [-0.5, -4.0]], np.float32),
+    }
+    ids_v, sc_v = _exe().run(main, feed=feed, fetch_list=[sel_ids, sel_sc])
+    assert list(np.asarray(ids_v).reshape(-1)) == [5, 7]
+    np.testing.assert_allclose(np.asarray(sc_v).reshape(-1),
+                               [-0.1, -0.5])

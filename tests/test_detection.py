@@ -1,0 +1,156 @@
+"""Detection ops vs numpy references (SURVEY.md §2.2; parity:
+python/paddle/fluid/tests/unittests/test_{prior_box,box_coder,
+bipartite_match,target_assign,multiclass_nms,detection_map}_op.py).
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def _run(build):
+    main, startup = fluid.Program(), fluid.Program()
+    feed = {}
+    with fluid.program_guard(main, startup):
+        fetches = build(feed)
+    return _exe().run(main, feed=feed, fetch_list=list(fetches))
+
+
+def test_prior_box_counts_and_range():
+    def build(feed):
+        feat = fluid.layers.data(name='feat', shape=[8, 4, 4],
+                                 dtype='float32')
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        feed['feat'] = np.zeros((1, 8, 4, 4), np.float32)
+        feed['img'] = np.zeros((1, 3, 32, 32), np.float32)
+        box, var = fluid.layers.detection.prior_box(
+            feat, img, min_sizes=[4.0], max_sizes=[8.0],
+            aspect_ratios=[1.0, 2.0], flip=True, clip=True)
+        return box, var
+    box, var = _run(build)
+    # P = len(min)*len(expanded=[1,2,.5]) + len(max) = 4 per cell
+    assert box.shape == (4 * 4 * 4, 4)
+    assert var.shape == box.shape
+    assert (box >= 0).all() and (box <= 1).all()
+    np.testing.assert_allclose(var[0], [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
+    # first cell center at ((0+.5)*8, (0+.5)*8) = (4, 4); ar=1 min box
+    np.testing.assert_allclose(
+        box[0], [(4 - 2) / 32., (4 - 2) / 32., (4 + 2) / 32.,
+                 (4 + 2) / 32.], rtol=1e-5)
+
+
+def test_box_coder_encode_decode_round_trip():
+    rng = np.random.RandomState(0)
+    prior = np.abs(rng.rand(5, 4)).astype('float32')
+    prior[:, 2:] = prior[:, :2] + 0.5 + prior[:, 2:]
+    gt = np.abs(rng.rand(3, 4)).astype('float32')
+    gt[:, 2:] = gt[:, :2] + 0.4 + gt[:, 2:]
+    pvar = np.tile([0.1, 0.1, 0.2, 0.2], (5, 1)).astype('float32')
+
+    def build(feed):
+        p = fluid.layers.data(name='p', shape=[4], dtype='float32')
+        pv = fluid.layers.data(name='pv', shape=[4], dtype='float32')
+        t = fluid.layers.data(name='t', shape=[4], dtype='float32')
+        feed.update(p=prior, pv=pvar, t=gt)
+        enc = fluid.layers.detection.box_coder(
+            p, pv, t, code_type='encode_center_size')
+        dec = fluid.layers.detection.box_coder(
+            p, pv, enc, code_type='decode_center_size')
+        return enc, dec
+    enc, dec = _run(build)
+    assert enc.shape == (3, 5, 4)
+    # decode(encode(gt)) == gt for every (gt, prior) pair
+    want = np.broadcast_to(gt[:, None, :], (3, 5, 4))
+    np.testing.assert_allclose(dec, want, rtol=1e-4, atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.8, 0.7, 0.2]], np.float32)
+
+    def build(feed):
+        d = fluid.layers.data(name='d', shape=[3], dtype='float32')
+        feed['d'] = dist
+        idx, dv = fluid.layers.detection.bipartite_match(d)
+        return idx, dv
+    idx, dv = _run(build)
+    idx, dv = np.asarray(idx).reshape(-1), np.asarray(dv).reshape(-1)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; col 2 unmatched
+    assert list(idx) == [0, 1, -1]
+    np.testing.assert_allclose(dv[:2], [0.9, 0.7], rtol=1e-6)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # two nearly identical boxes + one distinct; NMS keeps 2 of class 1
+    boxes = np.array([[[0., 0., 1., 1.],
+                       [0.01, 0.01, 1.01, 1.01],
+                       [5., 5., 6., 6.]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 scores per box
+
+    def build(feed):
+        s = fluid.layers.data(name='s', shape=[2, 3], dtype='float32')
+        b = fluid.layers.data(name='b', shape=[3, 4], dtype='float32')
+        feed.update(s=scores, b=boxes)
+        helper = fluid.layers.detection.LayerHelper('nms_test')
+        out = helper.create_tmp_variable(dtype='float32')
+        helper.append_op(
+            type='multiclass_nms',
+            inputs={'Scores': s, 'BBoxes': b},
+            outputs={'Out': out},
+            attrs={'background_label': 0, 'nms_threshold': 0.5,
+                   'nms_top_k': 10, 'keep_top_k': 5,
+                   'score_threshold': 0.01, 'nms_eta': 1.0})
+        return (out,)
+    out, = _run(build)
+    out = np.asarray(out)[0]
+    valid = out[out[:, 0] >= 0]
+    assert valid.shape[0] == 2           # overlap suppressed
+    np.testing.assert_allclose(sorted(valid[:, 1], reverse=True),
+                               [0.9, 0.7], rtol=1e-6)
+
+
+def test_ssd_loss_runs_and_is_positive():
+    rng = np.random.RandomState(0)
+    P, G, C = 8, 2, 4
+    prior = np.linspace(0.05, 0.9, P * 4).reshape(P, 4).astype('float32')
+    prior[:, 2:] = prior[:, :2] + 0.2
+    gt_box = prior[[1, 5]] + 0.01
+    gt_label = np.array([1, 2], np.int32)
+    loc = rng.randn(2, P, 4).astype('float32') * 0.1
+    conf = rng.randn(2, P, C).astype('float32')
+
+    def build(feed):
+        lv = fluid.layers.data(name='loc', shape=[P, 4], dtype='float32')
+        cv = fluid.layers.data(name='conf', shape=[P, C], dtype='float32')
+        gb = fluid.layers.data(name='gb', shape=[4], dtype='float32')
+        gl = fluid.layers.data(name='gl', shape=[1], dtype='int32')
+        pb = fluid.layers.data(name='pb', shape=[4], dtype='float32')
+        feed.update(loc=loc, conf=conf, gb=gt_box, gl=gt_label, pb=prior)
+        loss = fluid.layers.detection.ssd_loss(lv, cv, gb, gl, pb)
+        return (loss,)
+    loss, = _run(build)
+    loss = np.asarray(loss)
+    assert loss.shape == (2, 1)
+    assert np.isfinite(loss).all() and (loss > 0).all()
+
+
+def test_detection_map_perfect_predictions():
+    gt = np.array([[1, 0.1, 0.1, 0.4, 0.4],
+                   [2, 0.5, 0.5, 0.9, 0.9]], np.float32)
+    det = np.array([[1, 0.95, 0.1, 0.1, 0.4, 0.4],
+                    [2, 0.9, 0.5, 0.5, 0.9, 0.9]], np.float32)
+
+    def build(feed):
+        d = fluid.layers.data(name='det', shape=[6], dtype='float32')
+        g = fluid.layers.data(name='gt', shape=[5], dtype='float32')
+        feed.update(det=det, gt=gt)
+        m = fluid.layers.detection.detection_map(d, g, class_num=3,
+                                                 overlap_threshold=0.5)
+        return (m,)
+    m, = _run(build)
+    np.testing.assert_allclose(np.asarray(m), [1.0], rtol=1e-5)

@@ -1,0 +1,121 @@
+"""Parallel stack: collectives under shard_map, ParallelExecutor on an
+8-device CPU mesh matching single-device results, collective op kernels
+(SURVEY.md §4 test_parallel)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.parallel import collective
+from paddle_tpu.parallel.mesh import get_mesh, set_mesh
+
+
+@pytest.fixture
+def mesh8():
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return Mesh(np.asarray(devs[:8]), ('dp',))
+
+
+def test_collective_functions(mesh8):
+    x = np.arange(8, dtype=np.float32)
+
+    def body(xs):
+        s = collective.all_reduce(xs, 'dp')
+        g = collective.all_gather(xs, 'dp')
+        r = collective.ring_permute(xs, 'dp', offset=1)
+        i = collective.axis_index('dp').reshape(1)
+        return s, g, r, i
+
+    f = shard_map(body, mesh=mesh8, in_specs=P('dp'),
+                  out_specs=(P('dp'), P('dp'), P('dp'), P('dp')))
+    s, g, r, i = f(x)
+    np.testing.assert_allclose(np.asarray(s), np.full(8, x.sum()))
+    # each shard gathers the full vector -> tiled back = 8 copies
+    assert np.asarray(g).shape == (64,)
+    np.testing.assert_allclose(np.asarray(r),
+                               np.roll(x, 1))  # ring shift
+    np.testing.assert_allclose(np.asarray(i), np.arange(8))
+
+
+def test_reduce_scatter(mesh8):
+    x = np.tile(np.arange(8, dtype=np.float32), (8, 1))  # [8, 8] rows equal
+
+    def body(xs):
+        # xs is one row [1, 8]; scatter-sum along axis 0 after reshape
+        return collective.reduce_scatter(xs.reshape(8), 'dp')
+
+    f = shard_map(body, mesh=mesh8, in_specs=P('dp', None),
+                  out_specs=P('dp'))
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(8, dtype=np.float32) * 8)
+
+
+def test_collective_op_kernels_identity_single_device():
+    # outside a mapped context the collective ops are the identity
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        block = main.global_block()
+        outs = []
+        for op_type in ('allreduce', 'broadcast', 'all_gather',
+                        'reduce_scatter', 'ppermute'):
+            out = block.create_var(name='%s_out' % op_type,
+                                   dtype='float32')
+            block.append_op(type=op_type, inputs={'X': [x]},
+                            outputs={'Out': [out]},
+                            attrs={'axis_name': 'dp'})
+            outs.append(out)
+    xs = np.random.RandomState(0).randn(2, 4).astype('float32')
+    res = fluid.Executor(fluid.CPUPlace()).run(main, feed={'x': xs},
+                                               fetch_list=outs)
+    for r in res:
+        np.testing.assert_allclose(np.asarray(r), xs)
+
+
+def test_parallel_executor_matches_single_device(mesh8):
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h = fluid.layers.fc(input=x, size=16, act='relu')
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype('float32')
+    ys = (xs.sum(1, keepdims=True) * 0.5).astype('float32')
+
+    # single-device run
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        single = [float(np.asarray(exe.run(
+            main, feed={'x': xs, 'y': ys}, fetch_list=[loss])[0]).mean())
+            for _ in range(5)]
+
+    # data-parallel run over 8 devices
+    main, startup, loss = build()
+    set_mesh(mesh8)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        pexe = fluid.ParallelExecutor(use_cuda=False,
+                                      loss_name=loss.name,
+                                      main_program=main, mesh=mesh8)
+        par = [float(np.asarray(pexe.run(
+            [loss], feed={'x': xs, 'y': ys})[0]).mean())
+            for _ in range(5)]
+    set_mesh(None)
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
+    assert par[-1] < par[0]  # it actually trains
