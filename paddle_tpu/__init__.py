@@ -47,6 +47,15 @@ from . import reader  # noqa
 from . import dataset  # noqa
 from .reader import batch  # noqa
 from . import parallel  # noqa
+from . import trainer  # noqa
+from .trainer import Trainer  # noqa
+from . import inferencer  # noqa
+from .inferencer import Inferencer  # noqa
+from . import debugger  # noqa
+from . import debugger as debuger  # noqa  (reference spelling)
+from . import graphviz  # noqa
+from . import net_drawer  # noqa
+from . import concurrency  # noqa
 from .parallel.parallel_executor import ParallelExecutor  # noqa
 from .parallel.transpiler import (DistributeTranspiler,  # noqa
                                   InferenceTranspiler,

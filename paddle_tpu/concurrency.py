@@ -1,0 +1,144 @@
+"""Go-style channels / select.
+
+Parity: python/paddle/fluid/concurrency.py (Go, make_channel,
+channel_send/recv/close, Select). The reference schedules goroutine
+sub-blocks on the C++ threaded executor; on the XLA path a traced program
+is single-dispatch, so channels here are HOST-side primitives for
+pipelining readers/trainers around the device step (the same role the
+reference's channels play in its CSP examples), built on queue.Queue.
+``Go`` runs its body eagerly on a thread pool at run time.
+"""
+import contextlib
+import queue
+import threading
+
+__all__ = ['Go', 'make_channel', 'channel_send', 'channel_recv',
+           'channel_close', 'Select']
+
+
+class Channel(object):
+    """Typed bounded channel. capacity=0 -> synchronous handoff."""
+
+    def __init__(self, dtype, capacity=0):
+        self.dtype = dtype
+        self._q = queue.Queue(maxsize=capacity if capacity > 0 else 1)
+        self._closed = threading.Event()
+        self._sync = capacity == 0
+
+    def send(self, value):
+        if self._closed.is_set():
+            return False
+        self._q.put(value)
+        return True
+
+    def recv(self):
+        while True:
+            try:
+                return True, self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return False, None
+
+    def close(self):
+        self._closed.set()
+
+    @property
+    def closed(self):
+        return self._closed.is_set() and self._q.empty()
+
+
+def make_channel(dtype, capacity=0):
+    return Channel(dtype, capacity)
+
+
+def channel_send(channel, value, is_copy=False):
+    if not isinstance(channel, Channel):
+        raise TypeError("channel_send needs a Channel")
+    return channel.send(value)
+
+
+def channel_recv(channel, return_value=None):
+    if not isinstance(channel, Channel):
+        raise TypeError("channel_recv needs a Channel")
+    ok, value = channel.recv()
+    return value, ok
+
+
+def channel_close(channel):
+    channel.close()
+
+
+class Go(object):
+    """`with Go(): body()` — the body closure runs on a daemon thread
+    (the host-side analogue of the reference's go_op sub-block)."""
+
+    _threads = []
+
+    def __init__(self, name=None):
+        self.name = name
+        self._fns = []
+
+    def __enter__(self):
+        return self
+
+    def run(self, fn, *args, **kwargs):
+        self._fns.append((fn, args, kwargs))
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        for fn, args, kwargs in self._fns:
+            t = threading.Thread(target=fn, args=args, kwargs=kwargs,
+                                 daemon=True)
+            t.start()
+            Go._threads.append(t)
+        return True
+
+
+class Select(object):
+    """Poll several channel actions; run the first ready case.
+    Parity (host-side): concurrency.py::Select."""
+
+    def __init__(self, name=None):
+        self._cases = []
+        self._default = None
+
+    @contextlib.contextmanager
+    def case(self, channel_action_fn, channel, value=None, is_copy=False):
+        body = []
+        yield body.append
+        self._cases.append((channel_action_fn, channel, value, body))
+
+    @contextlib.contextmanager
+    def default(self):
+        body = []
+        yield body.append
+        self._default = body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        return True
+
+    def run(self):
+        while True:
+            for action, ch, value, body in self._cases:
+                if action is channel_send:
+                    if not ch._q.full():
+                        action(ch, value)
+                        for fn in body:
+                            fn()
+                        return True
+                else:
+                    if not ch._q.empty() or ch._closed.is_set():
+                        _, ok = action(ch)
+                        for fn in body:
+                            fn()
+                        return ok
+            if self._default is not None:
+                for fn in self._default:
+                    fn()
+                return True

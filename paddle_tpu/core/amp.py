@@ -1,0 +1,50 @@
+"""Automatic mixed precision for the MXU path.
+
+The reference's fp16 story is per-kernel CUDA half support
+(paddle/fluid/operators/*_op.cu float16 registrations); the TPU-native
+equivalent is bf16 compute on the MXU with f32 accumulation and f32
+master weights: matmul/conv kernels cast their operands to bfloat16 and
+request ``preferred_element_type=float32``, so XLA emits bf16 MXU ops
+with f32 accumulators. Gradients flow through the casts and arrive f32;
+optimizer state stays f32 throughout.
+
+Enabled by default on TPU backends, off on CPU (tests compare against
+f64-ish numpy references). Override with PADDLE_TPU_AMP=0/1.
+"""
+import os
+
+_STATE = {'mode': None}
+
+
+def amp_enabled():
+    if _STATE['mode'] is None:
+        env = os.environ.get('PADDLE_TPU_AMP', 'auto').lower()
+        if env in ('auto', ''):
+            import jax
+            _STATE['mode'] = jax.default_backend() not in ('cpu',)
+        else:
+            _STATE['mode'] = env not in ('0', 'off', 'false', 'no')
+    return _STATE['mode']
+
+
+def set_amp(on):
+    """Force AMP on/off (None -> re-derive from env/backend)."""
+    _STATE['mode'] = on
+
+
+def mxu_compute(fn, *operands):
+    """Run ``fn(*operands)`` on the MXU in bf16 under AMP.
+
+    Operands are cast f32 -> bf16 and the result is cast back to f32, so
+    the surrounding graph (BN stats, losses, optimizer) stays f32. The
+    TPU MXU accumulates partial products in f32 internally regardless of
+    the bf16 I/O dtype, and JAX's conv/dot grad rules stay uniform-dtyped
+    (mixed-dtype preferred_element_type breaks them).
+    """
+    import jax.numpy as jnp
+    if not amp_enabled():
+        return fn(*operands)
+    cast = [o.astype(jnp.bfloat16) if o.dtype == jnp.float32 else o
+            for o in operands]
+    out = fn(*cast)
+    return out.astype(jnp.float32) if out.dtype == jnp.bfloat16 else out

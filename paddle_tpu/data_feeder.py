@@ -94,3 +94,14 @@ class DataFeeder(object):
         for each_name, each_converter in zip(self.feed_names, converters):
             ret_dict[each_name] = each_converter.done()
         return ret_dict
+
+    def decorate_reader(self, reader, multi_devices=False,
+                        num_places=None, drop_last=True):
+        """Wrap a batch reader so it yields ready feed dicts.
+        Parity: data_feeder.py::DataFeeder.decorate_reader (the
+        multi-device split is unnecessary here — the SPMD executor shards
+        the full batch over the mesh)."""
+        def __reader_creator__():
+            for item in reader():
+                yield self.feed(item)
+        return __reader_creator__

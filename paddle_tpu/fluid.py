@@ -26,6 +26,14 @@ from .parallel.transpiler import (DistributeTranspiler,  # noqa
 from .clip import (ErrorClipByValue, GradientClipByValue,  # noqa
                    GradientClipByNorm, GradientClipByGlobalNorm)
 from .initializer import init_on_cpu  # noqa
+from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,  # noqa
+                      BeginStepEvent, EndStepEvent)
+from .inferencer import Inferencer  # noqa
+from . import debugger  # noqa
+from . import debugger as debuger  # noqa
+from . import graphviz  # noqa
+from . import net_drawer  # noqa
+from . import concurrency  # noqa
 from .recordio_writer import (convert_reader_to_recordio_file,  # noqa
                               convert_reader_to_recordio_files)
 LoDTensor = SequenceTensor

@@ -1,0 +1,56 @@
+"""High-level Inferencer API.
+
+Parity: python/paddle/fluid/inferencer.py. The jitted-program cache in
+Executor makes repeated infer() calls compile once per feed signature.
+"""
+import contextlib
+
+from . import framework
+from . import executor
+from . import io
+from . import unique_name
+from .trainer import check_and_get_place
+
+__all__ = ['Inferencer']
+
+
+class Inferencer(object):
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.param_path = param_path
+        self.scope = executor.Scope()
+        self.parallel = parallel
+        self.place = check_and_get_place(place)
+
+        self.inference_program = framework.Program()
+        with framework.program_guard(self.inference_program):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+
+        with self._prog_and_scope_guard():
+            io.load_params(executor.Executor(self.place), param_path)
+
+        if parallel:
+            from .parallel.parallel_executor import ParallelExecutor
+            with self._prog_and_scope_guard():
+                self.exe = ParallelExecutor(
+                    use_cuda=False, main_program=self.inference_program)
+        else:
+            self.exe = executor.Executor(self.place)
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with executor.scope_guard(self.scope):
+            if self.parallel:
+                return self.exe.run([self.predict_var], feed=inputs,
+                                    return_numpy=return_numpy)
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        with framework.program_guard(main_program=self.inference_program):
+            with executor.scope_guard(self.scope):
+                yield

@@ -113,6 +113,10 @@ def array_write(x, i, array=None):
     helper = LayerHelper('array_write', **{})
     if array is None:
         array = create_array(x.dtype)
+    # propagate the element shape so downstream reads keep build-time
+    # shape info (the runtime buffer is [cap, *elem])
+    if not getattr(array, 'shape', None):
+        array.shape = tuple(x.shape)
     helper.append_op(type='write_to_array',
                      inputs={'X': [x], 'I': [i]},
                      outputs={'Out': [array]})
@@ -121,7 +125,8 @@ def array_write(x, i, array=None):
 
 def array_read(array, i):
     helper = LayerHelper('array_read', **{})
-    out = helper.create_tmp_variable(dtype=array.dtype)
+    out = helper.create_tmp_variable(dtype=array.dtype,
+                                     shape=getattr(array, 'shape', ()))
     helper.append_op(type='read_from_array',
                      inputs={'X': [array], 'I': [i]},
                      outputs={'Out': [out]})
@@ -642,9 +647,11 @@ class DynamicRNN(object):
         if x.lod_level < 1:
             raise ValueError("dynamic rnn input must be a sequence "
                              "(lod_level >= 1)")
+        # build-time LoD shapes are packed [total, D]; the per-step view
+        # keeps the feature dims with a free batch dim
         ipt = self.helper.create_variable(
             name=unique_name.generate('dyn_rnn_step_in'), dtype=x.dtype,
-            shape=(x.shape[0],) + tuple(x.shape[2:]))
+            shape=(-1,) + tuple(x.shape[1:]))
         self.inputs.append(x)
         self.step_inputs.append(ipt)
         return ipt

@@ -24,6 +24,7 @@ __all__ = [
     'row_conv', 'multiplex', 'layer_norm', 'softmax_with_cross_entropy',
     'smooth_l1', 'one_hot', 'autoincreased_step_counter', 'reshape',
     'lod_reset', 'lrn', 'pad', 'label_smooth', 'roi_pool', 'dice_loss',
+    'expand',
     'bilinear_interp', 'gather', 'squeeze', 'unsqueeze',
 ]
 
@@ -643,6 +644,18 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
                      outputs={"Out": smooth_label},
                      attrs={"epsilon": float(epsilon)})
     return smooth_label
+
+
+def expand(x, expand_times, name=None):
+    """Tile x along each dim. Parity: paddle/fluid/operators/expand_op.cc."""
+    helper = LayerHelper('expand', **{})
+    shape = tuple(-1 if s < 0 else s * t
+                  for s, t in zip(x.shape, expand_times))
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=shape)
+    helper.append_op(type='expand', inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'expand_times': list(expand_times)})
+    return out
 
 
 def roi_pool(input, rois, pooled_height=1, pooled_width=1,

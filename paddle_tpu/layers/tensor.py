@@ -58,7 +58,8 @@ def concat(input, axis=0, name=None):
         total = 0
         ok = True
         for v in input:
-            s = v.shape[axis] if axis < len(v.shape) else -1
+            vs = list(v.shape or ())
+            s = vs[axis] if -len(vs) <= axis < len(vs) else -1
             if s < 0:
                 ok = False
                 break

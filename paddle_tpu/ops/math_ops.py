@@ -219,7 +219,8 @@ def _mul(ctx):
     xs, ys = x.shape, y.shape
     x2 = x.reshape((_prod(xs[:xd]), _prod(xs[xd:])))
     y2 = y.reshape((_prod(ys[:yd]), _prod(ys[yd:])))
-    out = x2 @ y2
+    from ..core.amp import mxu_compute
+    out = mxu_compute(jnp.matmul, x2, y2)
     out = out.reshape(tuple(xs[:xd]) + tuple(ys[yd:]))
     ctx.set_output('Out', rewrap(x_in, out) if is_seq else out)
 
@@ -246,7 +247,8 @@ def _matmul(ctx):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y)
+    from ..core.amp import mxu_compute
+    out = mxu_compute(jnp.matmul, x, y)
     if alpha != 1.0:
         out = out * alpha
     ctx.set_output('Out', out)
@@ -277,7 +279,20 @@ _reduce('reduce_prod', jnp.prod)
 
 @register_kernel('mean')
 def _mean(ctx):
-    x = unwrap(ctx.input('X'))
+    x_in = ctx.input('X')
+    x = unwrap(x_in)
+    from ..lod import SequenceTensor
+    if isinstance(x_in, SequenceTensor):
+        # average over REAL tokens only (reference means over the packed
+        # [total, ...] rows, which has no padding)
+        T = x.shape[1]
+        m = (jnp.arange(T)[None, :] <
+             jnp.asarray(x_in.lengths)[:, None])
+        m = m.reshape(m.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+        denom = jnp.maximum(jnp.sum(m), 1.0) * _prod(x.shape[2:])
+        ctx.set_output('Out',
+                       (jnp.sum(x * m) / denom).reshape((1,)))
+        return
     ctx.set_output('Out', jnp.mean(x).reshape((1,)))
 
 

@@ -31,11 +31,13 @@ def _conv2d(ctx):
     groups = ctx.attr('groups', 1) or 1
     if ctx.op.type == 'depthwise_conv2d':
         groups = x.shape[1]
-    out = jax.lax.conv_general_dilated(
-        x, w, window_strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    from ..core.amp import mxu_compute
+    out = mxu_compute(
+        lambda a, b: jax.lax.conv_general_dilated(
+            a, b, window_strides=strides,
+            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW')), x, w)
     ctx.set_output('Output', out)
 
 
@@ -172,7 +174,8 @@ def _softmax(ctx):
 
 @register_kernel('cross_entropy')
 def _cross_entropy(ctx):
-    x = unwrap(ctx.input('X'))
+    x_in = ctx.input('X')
+    x = unwrap(x_in)
     label = unwrap(ctx.input('Label'))
     eps = 1e-8
     if ctx.attr('soft_label', False):
@@ -183,6 +186,19 @@ def _cross_entropy(ctx):
             idx = idx.reshape(idx.shape[:-1])
         p = jnp.take_along_axis(x, idx[..., None], axis=-1)
         loss = -jnp.log(p + eps)
+    from ..lod import SequenceTensor
+    if isinstance(x_in, SequenceTensor):
+        # padded time steps carry zero probs; zero their loss so reduced
+        # costs see only real tokens (the reference never has padding —
+        # its LoD layout is packed)
+        T = loss.shape[1]
+        m = (jnp.arange(T)[None, :] <
+             jnp.asarray(x_in.lengths)[:, None])
+        loss = loss * m.reshape(m.shape + (1,) * (loss.ndim - 2))\
+            .astype(loss.dtype)
+        ctx.set_output('Y', SequenceTensor(loss, x_in.lengths,
+                                           x_in.sub_lengths))
+        return
     ctx.set_output('Y', loss)
 
 

@@ -62,8 +62,20 @@ def _cast(ctx):
 
 @register_kernel('concat')
 def _concat(ctx):
-    xs = [unwrap(v) for v in ctx.inputs('X')]
-    ctx.set_output('Out', jnp.concatenate(xs, axis=ctx.attr('axis', 0)))
+    ins = ctx.inputs('X')
+    xs = [unwrap(v) for v in ins]
+    axis = ctx.attr('axis', 0)
+    from ..lod import SequenceTensor
+    seq = next((v for v in ins if isinstance(v, SequenceTensor)), None)
+    if seq is not None:
+        # fluid axes address the packed [total, D] layout; our runtime is
+        # padded [B, T, D], so feature axes (>= 1) shift right by one
+        rt_axis = axis + 1 if axis >= 1 else axis
+        out = jnp.concatenate(xs, axis=rt_axis)
+        ctx.set_output('Out', SequenceTensor(out, seq.lengths,
+                                             seq.sub_lengths))
+    else:
+        ctx.set_output('Out', jnp.concatenate(xs, axis=axis))
 
 
 @register_kernel('split')
@@ -291,3 +303,11 @@ def _print(ctx):
 @register_kernel('fetch')
 def _feed_fetch(ctx):
     ctx.set_output('Out', ctx.input('X'))
+
+
+@register_kernel('expand')
+def _expand(ctx):
+    """Parity: paddle/fluid/operators/expand_op.h (tile per dim)."""
+    x = ctx.input('X')
+    times = [int(t) for t in ctx.attr('expand_times')]
+    ctx.set_output('Out', rewrap(x, jnp.tile(unwrap(x), times)))

@@ -1,0 +1,229 @@
+"""High-level Trainer API.
+
+Parity: python/paddle/fluid/trainer.py (Trainer, Begin/End Epoch/Step
+events, build_feed_var_list). TPU design notes: `parallel=True` maps to
+the pjit-SPMD ParallelExecutor (mesh data parallelism) instead of the
+reference's per-GPU program clones; the pserver/NCCL2 env-var transpile
+path maps onto DistributeTranspiler's collective lowering.
+"""
+import contextlib
+import os
+
+from . import framework
+from . import executor
+from . import io
+from . import optimizer as opt_module
+from . import data_feeder
+from . import unique_name
+from .core.places import TPUPlace, CPUPlace
+from .parallel import parallel_executor
+
+__all__ = ['Trainer', 'BeginEpochEvent', 'EndEpochEvent',
+           'BeginStepEvent', 'EndStepEvent', 'check_and_get_place']
+
+
+class BeginEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent(object):
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+def check_and_get_place(place):
+    """Default to the TPU when available (parity: trainer.py::
+    check_and_get_place prefers CUDA)."""
+    if place is None:
+        import jax
+        try:
+            if jax.devices()[0].platform not in ('cpu',):
+                return TPUPlace(0)
+        except Exception:
+            pass
+        return CPUPlace()
+    return place
+
+
+class Trainer(object):
+    """train_func() builds the forward and returns [loss, *metrics] under
+    this trainer's fresh programs; the optimizer is appended here."""
+
+    def __init__(self, train_func, optimizer, param_path=None, place=None,
+                 parallel=False):
+        self.__stop = False
+        self.parallel = parallel
+        if not isinstance(optimizer, opt_module.Optimizer):
+            raise TypeError(
+                "The optimizer should be an instance of Optimizer")
+
+        self.scope = executor.Scope()
+        self.startup_program = framework.Program()
+        self.train_program = framework.Program()
+
+        # fresh numbering so a paired Inferencer (which also guards)
+        # rebuilds the same parameter names regardless of prior builds
+        with framework.program_guard(self.train_program,
+                                     self.startup_program), \
+                unique_name.guard():
+            program_func_outs = train_func()
+            self.train_func_outputs = program_func_outs if isinstance(
+                program_func_outs, list) else [program_func_outs]
+            self.test_program = self.train_program.clone(for_test=True)
+            loss = self.train_func_outputs[0]
+            optimizer.minimize(loss)
+
+        self.place = check_and_get_place(place)
+        self._dist_transpile_if_necessary()
+
+        with self._prog_and_scope_guard():
+            exe = executor.Executor(self.place)
+            exe.run(self.startup_program)
+        if param_path:
+            with self._prog_and_scope_guard():
+                io.load_persistables(executor.Executor(self.place),
+                                     dirname=param_path)
+
+    def _dist_transpile_if_necessary(self):
+        """Parity: trainer.py::_dist_transpile_if_necessary. The pserver
+        role is absorbed by the collective design (SURVEY §3.5): both
+        TRAINER and PSERVER roles run the transpiled collective program."""
+        if "PADDLE_TRAINING_ROLE" not in os.environ:
+            return
+        from .parallel.transpiler import DistributeTranspiler
+        trainers = int(os.getenv("PADDLE_TRAINERS", "1"))
+        trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        with self._prog_and_scope_guard():
+            t = DistributeTranspiler()
+            t.transpile(trainer_id, pservers=os.getenv(
+                "PADDLE_PSERVER_IPS", ""), trainers=trainers)
+            self.train_program = t.get_trainer_program()
+
+    def stop(self):
+        self.__stop = True
+
+    def train(self, num_epochs, event_handler, reader=None,
+              feed_order=None):
+        if self.parallel:
+            self._train_by_parallel_executor(num_epochs, event_handler,
+                                             reader, feed_order)
+        else:
+            self._train_by_executor(num_epochs, event_handler, reader,
+                                    feed_order)
+
+    def test(self, reader, feed_order):
+        return self._test_by_executor(reader, feed_order,
+                                      self.train_func_outputs)
+
+    def save_params(self, param_path):
+        with self._prog_and_scope_guard():
+            exe = executor.Executor(self.place)
+            io.save_persistables(exe, dirname=param_path)
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        with framework.program_guard(main_program=self.train_program,
+                                     startup_program=self.startup_program):
+            with executor.scope_guard(self.scope):
+                yield
+
+    def _feeder(self, program, feed_order):
+        feed_var_list = build_feed_var_list(program, feed_order)
+        return data_feeder.DataFeeder(feed_list=feed_var_list,
+                                      place=self.place)
+
+    def _train_by_executor(self, num_epochs, event_handler, reader,
+                           feed_order):
+        with self._prog_and_scope_guard():
+            feeder = self._feeder(self.train_program, feed_order)
+            exe = executor.Executor(self.place)
+            self._train_loop(event_handler, exe, num_epochs, reader,
+                             feeder)
+
+    def _train_by_parallel_executor(self, num_epochs, event_handler,
+                                    reader, feed_order):
+        with self._prog_and_scope_guard():
+            pe = self._get_or_create_parallel_executor()
+            feeder = self._feeder(self.train_program, feed_order)
+            self._train_loop(event_handler, pe, num_epochs, reader,
+                             feeder)
+
+    def _train_loop(self, event_handler, exe, num_epochs, reader, feeder):
+        fetch_names = [v.name for v in self.train_func_outputs]
+        for epoch_id in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, data in enumerate(reader()):
+                if self.__stop:
+                    return
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                feed = feeder.feed(data)
+                if isinstance(exe, parallel_executor.ParallelExecutor):
+                    metrics = exe.run(fetch_names, feed=feed) \
+                        if begin.fetch_metrics else exe.run([], feed=feed)
+                else:
+                    metrics = exe.run(
+                        feed=feed,
+                        fetch_list=fetch_names if begin.fetch_metrics
+                        else [])
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+            event_handler(EndEpochEvent(epoch_id))
+
+    def _test_by_executor(self, reader, feed_order, fetch_list):
+        with executor.scope_guard(self.scope):
+            feeder = self._feeder(self.test_program, feed_order)
+            exe = executor.Executor(self.place)
+            accumulated = len(fetch_list) * [0]
+            count = 0
+            for data in reader():
+                outs = exe.run(program=self.test_program,
+                               feed=feeder.feed(data),
+                               fetch_list=[v.name for v in fetch_list])
+                accumulated = [x[0] + x[1][0]
+                               for x in zip(accumulated, outs)]
+                count += 1
+            return [x / count for x in accumulated]
+
+    def _get_parallel_executor(self):
+        return getattr(self, 'parallel_executor', None)
+
+    def _get_or_create_parallel_executor(self):
+        if self._get_parallel_executor() is None:
+            self.parallel_executor = parallel_executor.ParallelExecutor(
+                use_cuda=False, main_program=self.train_program,
+                loss_name=self.train_func_outputs[0].name)
+        return self._get_parallel_executor()
+
+
+def build_feed_var_list(program, feed_order):
+    if not isinstance(program, framework.Program):
+        raise TypeError("The 'program' should be an object of Program")
+    if feed_order is None:
+        feed_order = [op.outputs['Out'][0]
+                      for op in program.global_block().ops
+                      if op.type == 'feed']
+    if isinstance(feed_order, list):
+        return [program.global_block().var(name) for name in feed_order]
+    if not isinstance(feed_order, dict):
+        raise TypeError(
+            "The 'feed_order' should be either None, list or dict.")
+    if sorted(feed_order.values()) != list(range(len(feed_order))):
+        raise ValueError("The values of 'feed_order' should be a "
+                         "permutation of [0, len(feed_order))")
+    sorted_pairs = sorted(feed_order.items(), key=lambda item: item[1])
+    return [program.global_block().var(name) for name, _ in sorted_pairs]

@@ -1,0 +1,383 @@
+"""Miniature end-to-end convergence tests for every book model
+(SURVEY.md §2.6; parity: python/paddle/fluid/tests/book/*). Each test
+builds the reference script's network shape, trains a few minibatches,
+and asserts the loss moves. Book 01 lives in test_fit_a_line.py and
+book 06 in test_book_sentiment.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def _train(main, startup, feeder, reader, loss, iters=12, exe=None):
+    exe = exe or fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    it = reader()
+    for _ in range(iters):
+        try:
+            data = next(it)
+        except StopIteration:
+            it = reader()
+            data = next(it)
+        out, = exe.run(main, feed=feeder.feed(data), fetch_list=[loss])
+        losses.append(float(np.asarray(out).mean()))
+    assert all(np.isfinite(l) for l in losses), losses
+    return losses, exe
+
+
+def test_book02_recognize_digits_conv():
+    """Parity: book/test_recognize_digits.py (conv variant)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[1, 28, 28],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        conv_pool_1 = fluid.nets.simple_img_conv_pool(
+            input=img, filter_size=5, num_filters=8, pool_size=2,
+            pool_stride=2, act="relu")
+        conv_pool_2 = fluid.nets.simple_img_conv_pool(
+            input=conv_pool_1, filter_size=5, num_filters=16, pool_size=2,
+            pool_stride=2, act="relu")
+        prediction = fluid.layers.fc(input=conv_pool_2, size=10,
+                                     act='softmax')
+        cost = fluid.layers.cross_entropy(input=prediction, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=prediction, label=label)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    reader = paddle.batch(paddle.dataset.mnist.train(), batch_size=32)
+    place = fluid.CPUPlace()
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label],
+                              program=main)
+    losses, _ = _train(main, startup, feeder, reader, avg_cost, iters=15)
+    assert losses[-1] < losses[0], losses
+
+
+def test_book03_image_classification_resnet_cifar():
+    """Parity: book/test_image_classification.py (resnet variant,
+    shrunken depth)."""
+    from paddle_tpu.models import resnet
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
+                                   dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        predict = resnet.resnet_cifar10(images, class_dim=10, depth=8)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.005).minimize(avg_cost)
+
+    reader = paddle.batch(paddle.dataset.cifar.train10(), batch_size=16)
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                              feed_list=[images, label], program=main)
+    losses, _ = _train(main, startup, feeder, reader, avg_cost, iters=10)
+    assert losses[-1] < losses[0] * 1.05, losses
+
+
+def test_book03_vgg_builds():
+    from paddle_tpu.models import vgg
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
+                                   dtype='float32')
+        predict = vgg.vgg16_bn_drop(images, class_dim=10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out, = exe.run(main, feed={
+        'pixel': np.random.RandomState(0).randn(2, 3, 32, 32)
+        .astype('float32')}, fetch_list=[predict])
+    assert np.asarray(out).shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(out).sum(1), 1.0, rtol=1e-4)
+
+
+def test_book04_word2vec():
+    """Parity: book/test_word2vec.py (N-gram LM)."""
+    N = 5
+    word_dict = paddle.dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+    EMBED_SIZE, HIDDEN_SIZE = 16, 32
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = [fluid.layers.data(name='word_%d' % i, shape=[1],
+                                   dtype='int64') for i in range(N - 1)]
+        next_word = fluid.layers.data(name='nextw', shape=[1],
+                                      dtype='int64')
+        embeds = [fluid.layers.embedding(
+            input=w, size=[dict_size, EMBED_SIZE],
+            param_attr=fluid.ParamAttr(name='shared_w'))
+            for w in words]
+        concat = fluid.layers.concat(input=embeds, axis=1)
+        hidden1 = fluid.layers.fc(input=concat, size=HIDDEN_SIZE,
+                                  act='sigmoid')
+        predict = fluid.layers.fc(input=hidden1, size=dict_size,
+                                  act='softmax')
+        cost = fluid.layers.cross_entropy(input=predict, label=next_word)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    # the zero-egress imikolov fallback is near-random text, so prove
+    # learning by overfitting a small fixed subset (the reference test
+    # similarly only checks the loss trend, not perplexity)
+    import itertools
+    fixed = list(itertools.islice(
+        paddle.dataset.imikolov.train(word_dict, N)(), 128))
+
+    def reader():
+        yield from (fixed[i:i + 64] for i in range(0, 128, 64))
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                              feed_list=words + [next_word],
+                              program=main)
+    losses, _ = _train(main, startup, feeder, reader, avg_cost, iters=40)
+    assert losses[-1] < losses[0], losses
+
+
+def test_book05_recommender_system():
+    """Parity: book/test_recommender_system.py (user/movie towers +
+    cosine similarity regression on ratings)."""
+    main, startup = fluid.Program(), fluid.Program()
+    ML = paddle.dataset.movielens
+    with fluid.program_guard(main, startup):
+        uid = fluid.layers.data(name='user_id', shape=[1], dtype='int64')
+        gender = fluid.layers.data(name='gender_id', shape=[1],
+                                   dtype='int64')
+        age = fluid.layers.data(name='age_id', shape=[1], dtype='int64')
+        job = fluid.layers.data(name='job_id', shape=[1], dtype='int64')
+        mov = fluid.layers.data(name='movie_id', shape=[1], dtype='int64')
+        cat = fluid.layers.data(name='category_id', shape=[1],
+                                dtype='int64', lod_level=1)
+        title = fluid.layers.data(name='movie_title', shape=[1],
+                                  dtype='int64', lod_level=1)
+        score = fluid.layers.data(name='score', shape=[1],
+                                  dtype='float32')
+
+        def emb_fc(x, vocab, dim=8):
+            e = fluid.layers.embedding(input=x, size=[vocab, dim],
+                                       is_sparse=True)
+            return fluid.layers.fc(input=e, size=16)
+
+        usr = fluid.layers.concat([
+            emb_fc(uid, ML.max_user_id() + 1),
+            emb_fc(gender, 2),
+            emb_fc(age, len(ML.age_table)),
+            emb_fc(job, ML.max_job_id() + 1)], axis=1)
+        usr_feat = fluid.layers.fc(input=usr, size=32, act='tanh')
+
+        mov_emb = emb_fc(mov, ML.max_movie_id() + 1)
+        cat_emb = fluid.layers.embedding(
+            input=cat, size=[len(ML.movie_categories()), 8],
+            is_sparse=True)
+        cat_pool = fluid.layers.sequence_pool(input=cat_emb,
+                                              pool_type="sum")
+        title_emb = fluid.layers.embedding(
+            input=title, size=[len(ML.get_movie_title_dict()), 8],
+            is_sparse=True)
+        title_conv = fluid.nets.sequence_conv_pool(
+            input=title_emb, num_filters=16, filter_size=3,
+            act="tanh", pool_type="sum")
+        mov_combined = fluid.layers.concat(
+            [mov_emb, cat_pool, title_conv], axis=1)
+        mov_feat = fluid.layers.fc(input=mov_combined, size=32,
+                                   act='tanh')
+
+        inference = fluid.layers.cos_sim(X=usr_feat, Y=mov_feat)
+        scale_infer = fluid.layers.scale(x=inference, scale=5.0)
+        cost = fluid.layers.square_error_cost(input=scale_infer,
+                                              label=score)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+
+    reader = paddle.batch(ML.train(), batch_size=32)
+    feeder = fluid.DataFeeder(
+        place=fluid.CPUPlace(),
+        feed_list=[uid, gender, age, job, mov, cat, title, score],
+        program=main)
+    losses, _ = _train(main, startup, feeder, reader, avg_cost, iters=12)
+    assert losses[-1] < losses[0], losses
+
+
+def test_book07_label_semantic_roles_mini():
+    """Parity: book/test_label_semantic_roles.py — embeddings + stacked
+    bidirectional LSTM + linear-chain CRF (narrow widths)."""
+    word_dict, verb_dict, label_dict = paddle.dataset.conll05.get_dict()
+    word_dict_len = len(word_dict)
+    label_dict_len = len(label_dict)
+    pred_len = len(verb_dict)
+    EMB, HID = 8, 16
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        word = fluid.layers.data(name='word_data', shape=[1],
+                                 dtype='int64', lod_level=1)
+        predicate = fluid.layers.data(name='verb_data', shape=[1],
+                                      dtype='int64', lod_level=1)
+        mark = fluid.layers.data(name='mark_data', shape=[1],
+                                 dtype='int64', lod_level=1)
+        target = fluid.layers.data(name='target', shape=[1],
+                                   dtype='int64', lod_level=1)
+        word_emb = fluid.layers.embedding(input=word,
+                                          size=[word_dict_len, EMB])
+        pred_emb = fluid.layers.embedding(input=predicate,
+                                          size=[pred_len, EMB])
+        mark_emb = fluid.layers.embedding(input=mark, size=[2, EMB])
+        feat = fluid.layers.concat(
+            [word_emb, pred_emb, mark_emb], axis=-1)
+        hidden_fw = fluid.layers.fc(input=feat, size=HID * 4)
+        lstm_fw, _ = fluid.layers.dynamic_lstm(
+            input=hidden_fw, size=HID * 4)
+        hidden_bw = fluid.layers.fc(input=feat, size=HID * 4)
+        lstm_bw, _ = fluid.layers.dynamic_lstm(
+            input=hidden_bw, size=HID * 4, is_reverse=True)
+        merged = fluid.layers.concat([lstm_fw, lstm_bw], axis=-1)
+        emission = fluid.layers.fc(input=merged, size=label_dict_len)
+        crf_cost = fluid.layers.linear_chain_crf(
+            input=emission, label=target,
+            param_attr=fluid.ParamAttr(name='crfw_srl'))
+        avg_cost = fluid.layers.mean(crf_cost)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(avg_cost)
+
+    # overfit two fixed batches (synthetic conll05 text has no real
+    # structure to generalize from; the reference test also only tracks
+    # the cost trend)
+    import itertools
+
+    def to_fields(sample):
+        return (sample[0], sample[1], sample[-2], sample[-1])
+    base = paddle.batch(
+        paddle.reader.map_readers(to_fields,
+                                  paddle.dataset.conll05.test()),
+        batch_size=8)
+    fixed = list(itertools.islice(base(), 2))
+
+    def reader():
+        yield from fixed
+
+    feeder = fluid.DataFeeder(
+        place=fluid.CPUPlace(),
+        feed_list=[word, predicate, mark, target], program=main)
+    losses, _ = _train(main, startup, feeder, reader, avg_cost, iters=24)
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_book08_machine_translation_train_and_decode():
+    """Parity: book/test_machine_translation.py — seq2seq with attention
+    via DynamicRNN train + static-beam decode."""
+    dict_size = 30
+    word_dim, hidden_dim = 8, 16
+    beam_size, max_length = 3, 8
+
+    def encoder(src_word_idx):
+        src_embedding = fluid.layers.embedding(
+            input=src_word_idx, size=[dict_size, word_dim])
+        fc1 = fluid.layers.fc(input=src_embedding, size=hidden_dim * 4,
+                              act='tanh')
+        lstm_hidden0, _ = fluid.layers.dynamic_lstm(
+            input=fc1, size=hidden_dim * 4)
+        return fluid.layers.sequence_pool(input=lstm_hidden0,
+                                          pool_type='last')
+
+    import paddle_tpu.unique_name as unique_name
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        src = fluid.layers.data(name='src_word_id', shape=[1],
+                                dtype='int64', lod_level=1)
+        trg = fluid.layers.data(name='target_language_word', shape=[1],
+                                dtype='int64', lod_level=1)
+        lbl = fluid.layers.data(name='target_language_next_word',
+                                shape=[1], dtype='int64', lod_level=1)
+        encoded = encoder(src)
+        trg_emb = fluid.layers.embedding(input=trg,
+                                         size=[dict_size, word_dim])
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            cur = drnn.step_input(trg_emb)
+            mem = drnn.memory(init=encoded)
+            decoder_inputs = fluid.layers.concat([cur, mem], axis=-1)
+            out = fluid.layers.fc(input=decoder_inputs,
+                                  size=hidden_dim, act='tanh')
+            prob = fluid.layers.fc(input=out, size=dict_size,
+                                   act='softmax')
+            drnn.update_memory(mem, out)
+            drnn.output(prob)
+        rnn_out = drnn()
+        cost = fluid.layers.cross_entropy(input=rnn_out, label=lbl)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+
+    reader = paddle.batch(paddle.dataset.wmt14.train(dict_size),
+                          batch_size=8)
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                              feed_list=[src, trg, lbl], program=main)
+    losses, exe = _train(main, startup, feeder, reader, avg_cost,
+                         iters=10)
+    assert losses[-1] < losses[0], losses
+
+    # ---- static-beam greedy-ish decode over the trained parameters
+    infer, istart = fluid.Program(), fluid.Program()
+    # restart unique-name numbering so infer params bind to the trained
+    # ones (the reference book rebuilds the net the same way)
+    with fluid.program_guard(infer, istart), unique_name.guard():
+        src_i = fluid.layers.data(name='src_word_id', shape=[1],
+                                  dtype='int64', lod_level=1)
+        enc = encoder(src_i)
+        # expand encoder state to beam rows: [B, H] -> [B*K, H]
+        enc_beam = fluid.layers.expand_as_beams(enc, beam_size) \
+            if hasattr(fluid.layers, 'expand_as_beams') else \
+            fluid.layers.reshape(
+                fluid.layers.expand(
+                    fluid.layers.unsqueeze(enc, axes=[1]),
+                    expand_times=[1, beam_size, 1]),
+                shape=[-1, hidden_dim])
+        i = fluid.layers.fill_constant(shape=[1], dtype='int32', value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype='int32',
+                                           value=max_length)
+        init_ids = fluid.layers.fill_constant_batch_size_like(
+            enc_beam, shape=[-1, 1], dtype='int64', value=0)
+        init_scores = fluid.layers.fill_constant_batch_size_like(
+            enc_beam, shape=[-1, 1], dtype='float32', value=0.0)
+        ids_arr = fluid.layers.array_write(init_ids, i)
+        sc_arr = fluid.layers.array_write(init_scores, i)
+        par_arr = fluid.layers.array_write(
+            fluid.layers.cast(init_ids, 'int32'), i)
+        state = fluid.layers.array_write(enc_beam, i)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            pre_ids = fluid.layers.array_read(ids_arr, i)
+            pre_sc = fluid.layers.array_read(sc_arr, i)
+            pre_state = fluid.layers.array_read(state, i)
+            cur_emb = fluid.layers.embedding(
+                input=pre_ids, size=[dict_size, word_dim])
+            cur_emb = fluid.layers.reshape(cur_emb,
+                                           shape=[-1, word_dim])
+            dec_in = fluid.layers.concat([cur_emb, pre_state], axis=-1)
+            out = fluid.layers.fc(input=dec_in, size=hidden_dim,
+                                  act='tanh')
+            prob = fluid.layers.fc(input=out, size=dict_size,
+                                   act='softmax')
+            topk_scores, topk_idx = fluid.layers.topk(prob, k=beam_size)
+            accu = fluid.layers.elementwise_add(
+                fluid.layers.log(topk_scores), pre_sc)
+            sel_ids, sel_sc = fluid.layers.beam_search(
+                pre_ids, topk_idx, accu, beam_size=beam_size, end_id=1)
+            fluid.layers.increment(x=i, value=1.0, in_place=True)
+            fluid.layers.array_write(sel_ids, i, array=ids_arr)
+            fluid.layers.array_write(sel_sc, i, array=sc_arr)
+            fluid.layers.array_write(sel_ids.parent_idx, i,
+                                     array=par_arr)
+            fluid.layers.array_write(out, i, array=state)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        sent_ids, sent_scores = fluid.layers.beam_search_decode(
+            ids_arr, sc_arr, parents=par_arr)
+
+    from paddle_tpu.lod import create_lod_tensor
+    src_data = create_lod_tensor(
+        np.asarray([[3], [4], [5], [6]], np.int64), [[4]])
+    out_ids, out_sc = exe.run(infer, feed={'src_word_id': src_data},
+                              fetch_list=[sent_ids, sent_scores])
+    toks = np.asarray(out_ids.data)
+    assert toks.shape[0] == beam_size  # one batch x K beams
+    assert np.isfinite(np.asarray(out_sc.data)).all()

@@ -1,0 +1,109 @@
+"""Trainer/Inferencer high-level API, debugger, concurrency
+(SURVEY.md §2.7; parity: fluid tests using the Trainer API, e.g.
+tests/book/high-level-api variants)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def _reader(n=64, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, 4).astype('float32')
+    ys = (xs @ np.array([1.0, -2.0, 3.0, 0.5], np.float32))[:, None] + 0.1
+
+    def r():
+        for i in range(0, n, batch):
+            yield list(zip(xs[i:i + batch], ys[i:i + batch]))
+    return r
+
+
+def _train_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1, act=None,
+                           param_attr=fluid.ParamAttr(name='w_trainer'))
+    return fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+
+
+def test_trainer_and_inferencer(tmp_path):
+    events = {'epochs': 0, 'losses': []}
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndEpochEvent):
+            events['epochs'] += 1
+        elif isinstance(ev, fluid.EndStepEvent):
+            events['losses'].append(float(np.asarray(ev.metrics[0])[0]))
+
+    trainer = fluid.Trainer(train_func=_train_func,
+                            optimizer=fluid.optimizer.SGD(
+                                learning_rate=0.05),
+                            place=fluid.CPUPlace())
+    trainer.train(num_epochs=8, event_handler=handler,
+                  reader=_reader(), feed_order=['x', 'y'])
+    assert events['epochs'] == 8
+    assert events['losses'][-1] < events['losses'][0] * 0.5
+
+    # test() averages metrics over the reader without touching params
+    test_loss = trainer.test(reader=_reader(seed=1),
+                             feed_order=['x', 'y'])
+    assert np.isfinite(test_loss[0])
+
+    param_dir = str(tmp_path / "params")
+    trainer.save_params(param_dir)
+
+    def infer_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        return fluid.layers.fc(input=x, size=1, act=None,
+                               param_attr=fluid.ParamAttr(
+                                   name='w_trainer'))
+
+    inferencer = fluid.Inferencer(infer_func=infer_func,
+                                  param_path=param_dir,
+                                  place=fluid.CPUPlace())
+    xs = np.random.RandomState(2).randn(5, 4).astype('float32')
+    out = inferencer.infer({'x': xs})
+    assert np.asarray(out[0]).shape == (5, 1)
+
+
+def test_debugger_and_net_drawer(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2, act='relu')
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    code = fluid.debugger.pprint_program_codes(main)
+    assert 'mul' in code and 'relu' in code
+    assert 'backward' not in code  # grads hidden by default
+    code_bw = fluid.debugger.pprint_program_codes(main,
+                                                  show_backward=True)
+    assert len(code_bw) >= len(code)
+    dot_path = str(tmp_path / "block.dot")
+    fluid.debugger.draw_block_graphviz(main.global_block(),
+                                      path=dot_path)
+    text = open(dot_path).read()
+    assert text.startswith('digraph') and 'relu' in text
+    g = fluid.net_drawer.draw_graph(startup, main,
+                                    path=str(tmp_path / "net.dot"))
+    assert 'digraph' in str(g)
+
+
+def test_concurrency_channels():
+    ch = fluid.concurrency.make_channel(dtype='float32', capacity=4)
+    results = []
+
+    with fluid.concurrency.Go() as go:
+        def producer():
+            for i in range(5):
+                fluid.concurrency.channel_send(ch, float(i))
+            fluid.concurrency.channel_close(ch)
+        go.run(producer)
+
+    while True:
+        v, ok = fluid.concurrency.channel_recv(ch)
+        if not ok:
+            break
+        results.append(v)
+    assert results == [0.0, 1.0, 2.0, 3.0, 4.0]
