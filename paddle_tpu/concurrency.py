@@ -9,6 +9,7 @@ reference's channels play in its CSP examples), built on queue.Queue.
 ``Go`` runs its body eagerly on a thread pool at run time.
 """
 import contextlib
+import time
 import queue
 import threading
 
@@ -26,10 +27,16 @@ class Channel(object):
         self._sync = capacity == 0
 
     def send(self, value):
-        if self._closed.is_set():
-            return False
-        self._q.put(value)
-        return True
+        # Poll with a timeout so a close() while we're blocked on a full
+        # queue wakes us up instead of deadlocking the producer thread.
+        while True:
+            if self._closed.is_set():
+                return False
+            try:
+                self._q.put(value, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
 
     def recv(self):
         while True:
@@ -72,11 +79,10 @@ class Go(object):
     """`with Go(): body()` — the body closure runs on a daemon thread
     (the host-side analogue of the reference's go_op sub-block)."""
 
-    _threads = []
-
     def __init__(self, name=None):
         self.name = name
         self._fns = []
+        self._threads = []
 
     def __enter__(self):
         return self
@@ -91,7 +97,7 @@ class Go(object):
             t = threading.Thread(target=fn, args=args, kwargs=kwargs,
                                  daemon=True)
             t.start()
-            Go._threads.append(t)
+            self._threads.append(t)
         return True
 
 
@@ -142,3 +148,5 @@ class Select(object):
                 for fn in self._default:
                     fn()
                 return True
+            # nothing ready and no default: back off instead of busy-spin
+            time.sleep(0.001)

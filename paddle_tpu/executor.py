@@ -123,6 +123,10 @@ class Executor(object):
                     data.astype(dt), np.asarray(val.lengths, np.int32),
                     None if val.sub_lengths is None
                     else np.asarray(val.sub_lengths, np.int32))
+            elif isinstance(val, jax.Array):
+                # Device-resident feed: never round-trip through the host.
+                dt = runtime_dtype(var.dtype if var else val.dtype)
+                out[name] = val if str(val.dtype) == dt else val.astype(dt)
             else:
                 arr = np.asarray(val)
                 dt = runtime_dtype(var.dtype if var else arr.dtype)

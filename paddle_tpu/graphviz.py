@@ -108,8 +108,9 @@ class GraphPreviewGenerator(object):
             fillcolor="yellow" if highlight else "lightgrey")
 
     def add_op(self, opType, **kwargs):
-        return self.graph.node("<<B>%s</B>>" % opType, prefix="op",
-                               shape="ellipse")
+        # plain label: crepr() double-quotes, so HTML-like <...> markup
+        # would render literally
+        return self.graph.node(opType, prefix="op", shape="ellipse")
 
     def add_arg(self, name, highlight=False):
         return self.graph.node(name, prefix="arg", shape="box",

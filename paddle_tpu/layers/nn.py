@@ -26,6 +26,10 @@ __all__ = [
     'lod_reset', 'lrn', 'pad', 'label_smooth', 'roi_pool', 'dice_loss',
     'expand',
     'bilinear_interp', 'gather', 'squeeze', 'unsqueeze',
+    'prelu', 'maxout', 'log_loss', 'huber_loss', 'rank_loss',
+    'margin_rank_loss', 'hinge_loss', 'modified_huber_loss', 'unpool',
+    'spp', 'max_pool2d_with_index', 'squared_l2_distance',
+    'squared_l2_norm', 'l1_norm',
 ]
 
 
@@ -1129,3 +1133,187 @@ def beam_search_decode(ids, scores, parents=None, name=None):
                      outputs={"SentenceIds": sentence_ids,
                               "SentenceScores": sentence_scores})
     return sentence_ids, sentence_scores
+
+
+# ---- long-tail losses / pooling variants (ops/misc_ops.py kernels) ------------
+def _simple_loss(op_type, inputs, dtype, shape=None, attrs=None,
+                 extra_outs=()):
+    helper = LayerHelper(op_type, **{})
+    out = helper.create_tmp_variable(dtype=dtype, shape=shape)
+    outputs = {'Out': [out]}
+    for slot in extra_outs:
+        outputs[slot] = [helper.create_tmp_variable(dtype=dtype)]
+    helper.append_op(type=op_type, inputs=inputs, outputs=outputs,
+                     attrs=attrs or {})
+    return out
+
+
+def hinge_loss(input, label):
+    """Parity: hinge_loss_op.cc — L = max(0, 1 - input*(2*label-1))."""
+    helper = LayerHelper('hinge_loss', **{})
+    out = helper.create_tmp_variable(dtype=input.dtype, shape=input.shape)
+    helper.append_op(type='hinge_loss',
+                     inputs={'Logits': [input], 'Labels': [label]},
+                     outputs={'Loss': [out]})
+    return out
+
+
+def huber_loss(input, label, delta=1.0):
+    """Parity: huber_loss_op.cc."""
+    return _simple_loss('huber_loss', {'X': [input], 'Y': [label]},
+                        input.dtype, input.shape, {'delta': float(delta)},
+                        extra_outs=('Residual',))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Parity: log_loss_op.cc."""
+    helper = LayerHelper('log_loss', name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype, shape=input.shape)
+    helper.append_op(type='log_loss',
+                     inputs={'Predicted': [input], 'Labels': [label]},
+                     outputs={'Loss': [out]},
+                     attrs={'epsilon': float(epsilon)})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    """Parity: rank_loss_op.cc (RankNet pairwise loss)."""
+    helper = LayerHelper('rank_loss', name=name)
+    out = helper.create_tmp_variable(dtype=left.dtype, shape=left.shape)
+    helper.append_op(type='rank_loss',
+                     inputs={'Label': [label], 'Left': [left],
+                             'Right': [right]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """Parity: margin_rank_loss_op.cc — relu(-label*(left-right)+margin)."""
+    helper = LayerHelper('margin_rank_loss', name=name)
+    out = helper.create_tmp_variable(dtype=left.dtype, shape=left.shape)
+    act = helper.create_tmp_variable(dtype=left.dtype)
+    helper.append_op(type='margin_rank_loss',
+                     inputs={'Label': [label], 'X1': [left], 'X2': [right]},
+                     outputs={'Out': [out], 'Activated': [act]},
+                     attrs={'margin': float(margin)})
+    return out
+
+
+def modified_huber_loss(input, label):
+    """Parity: modified_huber_loss_op.cc."""
+    return _simple_loss('modified_huber_loss',
+                        {'X': [input], 'Y': [label]},
+                        input.dtype, input.shape,
+                        extra_outs=('IntermediateVal',))
+
+
+def squared_l2_distance(x, y):
+    """Parity: squared_l2_distance_op.cc — rowwise ||x-y||^2, shape [N,1]."""
+    return _simple_loss('squared_l2_distance', {'X': [x], 'Y': [y]},
+                        x.dtype, (x.shape[0], 1),
+                        extra_outs=('sub_result',))
+
+
+def squared_l2_norm(x):
+    """Parity: squared_l2_norm_op.cc — sum(x^2), shape [1]."""
+    return _simple_loss('squared_l2_norm', {'X': [x]}, x.dtype, (1,))
+
+
+def l1_norm(x):
+    """Parity: l1_norm_op.cc — sum(|x|), shape [1]."""
+    return _simple_loss('l1_norm', {'X': [x]}, x.dtype, (1,))
+
+
+def prelu(x, mode='all', param_attr=None, name=None):
+    """Parity: prelu_op.cc. mode: 'all' one alpha; 'channel' per-channel."""
+    helper = LayerHelper('prelu', param_attr=param_attr, name=name)
+    if mode == 'channel' and len(x.shape) > 1:
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = [1]
+    from ..initializer import Constant
+    alpha = helper.create_parameter(attr=helper.param_attr,
+                                    shape=alpha_shape, dtype=x.dtype,
+                                    is_bias=False,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+    helper.append_op(type='prelu',
+                     inputs={'X': [x], 'Alpha': [alpha]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def maxout(x, groups, name=None):
+    """Parity: maxout_op.cc — NCHW, C_out = C // groups."""
+    helper = LayerHelper('maxout', name=name)
+    n, c, h, w = x.shape
+    out = helper.create_tmp_variable(dtype=x.dtype,
+                                     shape=(n, c // groups, h, w))
+    helper.append_op(type='maxout', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'groups': groups})
+    return out
+
+
+def max_pool2d_with_index(x, pool_size, pool_stride=1, pool_padding=0,
+                          global_pooling=False, name=None):
+    """Parity: pool_with_index_op.cc — returns (out, mask of argmax h*W+w)."""
+    helper = LayerHelper('max_pool2d_with_index', name=name)
+    ksize = [pool_size, pool_size] if isinstance(pool_size, int) \
+        else list(pool_size)
+    strides = [pool_stride, pool_stride] if isinstance(pool_stride, int) \
+        else list(pool_stride)
+    paddings = [pool_padding, pool_padding] \
+        if isinstance(pool_padding, int) else list(pool_padding)
+    n, c, h, w = x.shape
+    if global_pooling:
+        ho = wo = 1
+    else:
+        ho = _conv_out(h, ksize[0], paddings[0], strides[0])
+        wo = _conv_out(w, ksize[1], paddings[1], strides[1])
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=(n, c, ho, wo))
+    mask = helper.create_tmp_variable(dtype='int32', shape=(n, c, ho, wo),
+                                      stop_gradient=True)
+    helper.append_op(type='max_pool2d_with_index',
+                     inputs={'X': [x]},
+                     outputs={'Out': [out], 'Mask': [mask]},
+                     attrs={'ksize': ksize, 'strides': strides,
+                            'paddings': paddings,
+                            'global_pooling': global_pooling})
+    return out, mask
+
+
+def unpool(x, indices, pool_size, pool_stride=1, pool_padding=0, name=None):
+    """Parity: unpool_op.cc — max-unpool via recorded indices."""
+    helper = LayerHelper('unpool', name=name)
+    ksize = [pool_size, pool_size] if isinstance(pool_size, int) \
+        else list(pool_size)
+    strides = [pool_stride, pool_stride] if isinstance(pool_stride, int) \
+        else list(pool_stride)
+    paddings = [pool_padding, pool_padding] \
+        if isinstance(pool_padding, int) else list(pool_padding)
+    n, c, ho, wo = x.shape
+    out_h = (ho - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    out_w = (wo - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    out = helper.create_tmp_variable(dtype=x.dtype,
+                                     shape=(n, c, out_h, out_w))
+    helper.append_op(type='unpool',
+                     inputs={'X': [x], 'Indices': [indices]},
+                     outputs={'Out': [out]},
+                     attrs={'ksize': ksize, 'strides': strides,
+                            'paddings': paddings,
+                            'unpooling_type': 'max'})
+    return out
+
+
+def spp(x, pyramid_height, pool_type='max', name=None):
+    """Parity: spp_op.cc — spatial pyramid pooling to
+    [N, C * sum(4^level)]."""
+    helper = LayerHelper('spp', name=name)
+    n, c = x.shape[0], x.shape[1]
+    width = c * sum(4 ** l for l in range(pyramid_height))
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=(n, width))
+    helper.append_op(type='spp', inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'pyramid_height': pyramid_height,
+                            'pooling_type': pool_type})
+    return out

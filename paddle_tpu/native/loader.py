@@ -59,6 +59,9 @@ def _load():
         lib.loader_next.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.loader_next.argtypes = [ctypes.c_void_p,
                                     ctypes.POINTER(ctypes.c_uint64)]
+        lib.loader_error.restype = ctypes.c_int
+        lib.loader_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
         lib.loader_destroy.argtypes = [ctypes.c_void_p]
         _LIB = lib
     return _LIB
@@ -143,7 +146,11 @@ class PrefetchLoader(object):
             while True:
                 ptr = lib.loader_next(h, ctypes.byref(n))
                 if not ptr:
-                    return
+                    break
                 yield ctypes.string_at(ptr, n.value)
+            msg = ctypes.create_string_buffer(512)
+            if lib.loader_error(h, msg, len(msg)) > 0:
+                raise IOError("prefetch loader: %s"
+                              % msg.value.decode(errors='replace'))
         finally:
             lib.loader_destroy(h)

@@ -117,8 +117,16 @@ struct Loader {
   int passes;
   int active_workers = 0;
   std::vector<uint8_t> current;
+  int error_count = 0;       // guarded by err_mu
+  std::string first_error;   // guarded by err_mu
+  std::mutex err_mu;
 
   Loader(size_t cap, int passes) : queue(cap), passes(passes) {}
+
+  void record_error(const std::string& e) {
+    std::lock_guard<std::mutex> lk(err_mu);
+    if (error_count++ == 0) first_error = e;
+  }
 };
 
 bool read_one(FILE* f, std::vector<uint8_t>* out, std::string* err) {
@@ -154,6 +162,8 @@ void loader_worker(Loader* L) {
     r.f = fopen(path.c_str(), "rb");
     if (!r.f || !read_header(&r)) {
       if (r.f) fclose(r.f);
+      // a missing / non-recordio file is data loss, not a skip
+      L->record_error(path + ": cannot open or bad magic");
       continue;
     }
     std::vector<uint8_t> rec;
@@ -165,6 +175,7 @@ void loader_worker(Loader* L) {
       }
       rec.clear();
     }
+    if (!err.empty()) L->record_error(path + ": " + err);
     fclose(r.f);
   }
 out:
@@ -271,6 +282,20 @@ const uint8_t* loader_next(void* handle, uint64_t* len) {
   }
   *len = L->current.size();
   return L->current.data();
+}
+
+// Returns the number of per-file errors seen so far; copies the first
+// error message (NUL-terminated, truncated to buflen) into buf.
+int loader_error(void* handle, char* buf, int buflen) {
+  Loader* L = static_cast<Loader*>(handle);
+  std::lock_guard<std::mutex> lk(L->err_mu);
+  if (buf && buflen > 0) {
+    int n = (int)L->first_error.size();
+    if (n > buflen - 1) n = buflen - 1;
+    memcpy(buf, L->first_error.data(), n);
+    buf[n] = '\0';
+  }
+  return L->error_count;
 }
 
 void loader_destroy(void* handle) {

@@ -1,0 +1,273 @@
+"""Long-tail ops: extra losses, pooling variants, proximal optimizers.
+
+Parity: paddle/fluid/operators/{hinge_loss,huber_loss,log_loss,rank_loss,
+margin_rank_loss,modified_huber_loss,squared_l2_distance,squared_l2_norm,
+l1_norm,minus,fill,prelu,maxout,pool_with_index,unpool,spp,proximal_gd,
+proximal_adagrad}_op.* — elementwise formulas re-expressed as jnp traces
+(XLA fuses them), window ops via lax.reduce_window / patch extraction so
+they tile onto the TPU vector unit instead of the reference's per-pixel
+CPU/CUDA loops.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_kernel
+from .common import unwrap
+
+
+# ---- losses ---------------------------------------------------------------------
+@register_kernel('hinge_loss')
+def _hinge_loss(ctx):
+    """ref hinge_loss_op.h: L = max(0, 1 - x*(2y-1))."""
+    x = unwrap(ctx.input('Logits'))
+    y = unwrap(ctx.input('Labels'))
+    ctx.set_output('Loss', jnp.maximum(0.0, 1.0 - x * (2.0 * y - 1.0)))
+
+
+@register_kernel('huber_loss')
+def _huber_loss(ctx):
+    """ref huber_loss_op.h: r = y - x; L = 0.5 r^2 if |r|<=d else d(|r|-d/2)."""
+    x = unwrap(ctx.input('X'))
+    y = unwrap(ctx.input('Y'))
+    d = ctx.attr('delta', 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    ctx.set_output('Residual', r)
+    ctx.set_output('Out', loss)
+
+
+@register_kernel('log_loss')
+def _log_loss(ctx):
+    """ref log_loss_op.h: L = -y log(p+eps) - (1-y) log(1-p+eps)."""
+    p = unwrap(ctx.input('Predicted'))
+    y = unwrap(ctx.input('Labels'))
+    eps = ctx.attr('epsilon', 1e-4)
+    loss = -(y * jnp.log(p + eps)) - (1.0 - y) * jnp.log(1.0 - p + eps)
+    ctx.set_output('Loss', loss)
+
+
+@register_kernel('rank_loss')
+def _rank_loss(ctx):
+    """ref rank_loss_op.h: L = log(1 + exp(l-r)) - label*(l-r), stable form."""
+    label = unwrap(ctx.input('Label'))
+    left = unwrap(ctx.input('Left'))
+    right = unwrap(ctx.input('Right'))
+    d = left - right
+    ctx.set_output('Out', jnp.logaddexp(0.0, d) - label * d)
+
+
+@register_kernel('margin_rank_loss')
+def _margin_rank_loss(ctx):
+    """ref margin_rank_loss_op.h: L = relu(-label*(x1-x2) + margin)."""
+    label = unwrap(ctx.input('Label'))
+    x1 = unwrap(ctx.input('X1'))
+    x2 = unwrap(ctx.input('X2'))
+    margin = ctx.attr('margin', 0.0)
+    act = -label * (x1 - x2) + margin
+    ctx.set_output('Activated', (act > 0).astype(x1.dtype))
+    ctx.set_output('Out', jnp.maximum(act, 0.0))
+
+
+@register_kernel('modified_huber_loss')
+def _modified_huber_loss(ctx):
+    """ref modified_huber_loss_op.h: a = x*(2y-1);
+    L = -4a if a<-1; (1-a)^2 if -1<=a<1; 0 otherwise."""
+    x = unwrap(ctx.input('X'))
+    y = unwrap(ctx.input('Y'))
+    a = x * (2.0 * y - 1.0)
+    loss = jnp.where(a < -1.0, -4.0 * a,
+                     jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
+    ctx.set_output('IntermediateVal', a)
+    ctx.set_output('Out', loss)
+
+
+@register_kernel('squared_l2_distance')
+def _squared_l2_distance(ctx):
+    """ref squared_l2_distance_op.h: rows flattened; Out[i] = ||x_i - y_i||^2.
+    Y may have 1 row (broadcast)."""
+    x = unwrap(ctx.input('X'))
+    y = unwrap(ctx.input('Y'))
+    x2 = x.reshape(x.shape[0], -1)
+    y2 = y.reshape(y.shape[0], -1)
+    sub = x2 - y2
+    ctx.set_output('sub_result', sub)
+    ctx.set_output('Out', jnp.sum(jnp.square(sub), axis=1, keepdims=True))
+
+
+@register_kernel('squared_l2_norm')
+def _squared_l2_norm(ctx):
+    x = unwrap(ctx.input('X'))
+    ctx.set_output('Out', jnp.sum(jnp.square(x)).reshape(1))
+
+
+@register_kernel('l1_norm')
+def _l1_norm(ctx):
+    x = unwrap(ctx.input('X'))
+    ctx.set_output('Out', jnp.sum(jnp.abs(x)).reshape(1))
+
+
+@register_kernel('minus')
+def _minus(ctx):
+    ctx.set_output('Out', unwrap(ctx.input('X')) - unwrap(ctx.input('Y')))
+
+
+@register_kernel('fill')
+def _fill(ctx):
+    """ref fill_op.cc: Out = reshape(attr value list, attr shape)."""
+    from ..core.lowering import runtime_dtype
+    shape = ctx.attr('shape')
+    dt = runtime_dtype(ctx.attr('dtype', 'float32'))
+    val = np.asarray(ctx.attr('value'), dtype=dt)
+    ctx.set_output('Out', jnp.asarray(val).reshape(shape))
+
+
+# ---- prelu / maxout / pooling variants ------------------------------------------
+@register_kernel('prelu')
+def _prelu(ctx):
+    """ref prelu_op.cc: Out = x if x > 0 else alpha * x (alpha broadcasts)."""
+    x = unwrap(ctx.input('X'))
+    alpha = unwrap(ctx.input('Alpha'))
+    a = jnp.reshape(alpha, (-1,))
+    if a.shape[0] == 1:
+        a = a[0]
+    elif x.ndim > 1 and a.shape[0] == x.shape[1]:
+        # channel-shared alpha on NCHW
+        a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+    ctx.set_output('Out', jnp.where(x > 0, x, a * x))
+
+
+@register_kernel('maxout')
+def _maxout(ctx):
+    """ref math/maxouting.cc: NCHW, Out[:, c] = max over the group's feature
+    maps; C_out = C / groups."""
+    x = unwrap(ctx.input('X'))
+    g = ctx.attr('groups')
+    n, c, h, w = x.shape
+    ctx.set_output('Out', jnp.max(x.reshape(n, c // g, g, h, w), axis=2))
+
+
+def _pool_geometry(in_size, k, s, p, adaptive_bins=None):
+    if adaptive_bins is not None:
+        k = -(-in_size // adaptive_bins)
+        p = (k * adaptive_bins - in_size + 1) // 2
+        return k, k, p
+    return k, s, p
+
+
+@register_kernel('max_pool2d_with_index')
+def _max_pool2d_with_index(ctx):
+    """ref pool_with_index_op.* / math/pooling.cc MaxPool2dWithIndex:
+    Out = max over window, Mask = flat h*W+w index of the argmax.
+
+    TPU design: one patch extraction (conv_general_dilated_patches, which XLA
+    tiles) + argmax over the window axis — no per-pixel loops.
+    """
+    x = unwrap(ctx.input('X'))
+    kh, kw = ctx.attr('ksize')
+    sh, sw = ctx.attr('strides', [1, 1])
+    ph, pw = ctx.attr('paddings', [0, 0])
+    if ctx.attr('global_pooling', False):
+        kh, kw = x.shape[2], x.shape[3]
+        ph = pw = 0
+    n, c, h, w = x.shape
+    neg = jnp.finfo(x.dtype).min
+    patches = lax.conv_general_dilated_patches(
+        jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                constant_values=neg),
+        (kh, kw), (sh, sw), 'VALID',
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    ho, wo = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, kh * kw, ho, wo)
+    local = jnp.argmax(patches, axis=2)
+    out = jnp.max(patches, axis=2)
+    lh, lw = local // kw, local % kw
+    gh = jnp.arange(ho).reshape(1, 1, ho, 1) * sh - ph + lh
+    gw = jnp.arange(wo).reshape(1, 1, 1, wo) * sw - pw + lw
+    ctx.set_output('Out', out)
+    ctx.set_output('Mask', (gh * w + gw).astype(jnp.int32))
+
+
+@register_kernel('unpool')
+def _unpool(ctx):
+    """ref unpool_op.* / math/unpooling.cc: max-unpool — scatter each pooled
+    value back to its recorded flat h*W+w position in the larger map."""
+    x = unwrap(ctx.input('X'))
+    idx = unwrap(ctx.input('Indices')).astype(jnp.int32)
+    ksize = ctx.attr('ksize')
+    strides = ctx.attr('strides', [1, 1])
+    paddings = ctx.attr('paddings', [0, 0])
+    n, c, ho, wo = x.shape
+    out_h = (ho - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    out_w = (wo - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat_x = x.reshape(n * c, ho * wo)
+    flat_i = idx.reshape(n * c, ho * wo)
+    out = jnp.zeros((n * c, out_h * out_w), x.dtype)
+    rows = jnp.arange(n * c)[:, None]
+    out = out.at[rows, flat_i].set(flat_x)
+    ctx.set_output('Out', out.reshape(n, c, out_h, out_w))
+
+
+@register_kernel('spp')
+def _spp(ctx):
+    """ref spp_op.h: spatial pyramid pool — levels 0..pyramid_height-1 with
+    2^level bins each; adaptive kernel/stride/padding per level; outputs
+    flattened + concatenated to [N, C * sum(4^level)]."""
+    x = unwrap(ctx.input('X'))
+    height = ctx.attr('pyramid_height')
+    ptype = ctx.attr('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for level in range(height):
+        bins = 2 ** level
+        kh, sh_, ph = _pool_geometry(h, None, None, None, bins)
+        kw, sw_, pw = _pool_geometry(w, None, None, None, bins)
+        if ptype == 'max':
+            init, op = jnp.finfo(x.dtype).min, lax.max
+            padded = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                             constant_values=init)
+            pooled = lax.reduce_window(padded, init, op,
+                                       (1, 1, kh, kw), (1, 1, sh_, sw_),
+                                       'VALID')
+        else:
+            padded = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+            pooled = lax.reduce_window(padded, 0.0, lax.add,
+                                       (1, 1, kh, kw), (1, 1, sh_, sw_),
+                                       'VALID') / (kh * kw)
+        outs.append(pooled[:, :, :bins, :bins].reshape(n, -1))
+    ctx.set_output('Out', jnp.concatenate(outs, axis=1))
+
+
+# ---- proximal optimizers --------------------------------------------------------
+def _prox(prox_param, lr, l1, l2):
+    return (jnp.sign(prox_param)
+            * jnp.maximum(jnp.abs(prox_param) - lr * l1, 0.0)
+            / (1.0 + lr * l2))
+
+
+@register_kernel('proximal_gd')
+def _proximal_gd(ctx):
+    """ref proximal_gd_op.h: prox = p - lr*g;
+    p' = sign(prox) * max(|prox| - lr*l1, 0) / (1 + lr*l2)."""
+    p = unwrap(ctx.input('Param'))
+    g = unwrap(ctx.input('Grad'))
+    lr = unwrap(ctx.input('LearningRate')).reshape(())
+    l1, l2 = ctx.attr('l1', 0.0), ctx.attr('l2', 0.0)
+    ctx.set_output('ParamOut', _prox(p - lr * g, lr, l1, l2))
+
+
+@register_kernel('proximal_adagrad')
+def _proximal_adagrad(ctx):
+    """ref proximal_adagrad_op.h: m' = m + g^2; lr_t = lr/sqrt(m');
+    same shrinkage as proximal_gd with lr_t."""
+    p = unwrap(ctx.input('Param'))
+    g = unwrap(ctx.input('Grad'))
+    m = unwrap(ctx.input('Moment'))
+    lr = unwrap(ctx.input('LearningRate')).reshape(())
+    l1, l2 = ctx.attr('l1', 0.0), ctx.attr('l2', 0.0)
+    m_out = m + g * g
+    lr_t = lr / jnp.sqrt(m_out)
+    ctx.set_output('MomentOut', m_out)
+    ctx.set_output('ParamOut', _prox(p - lr_t * g, lr_t, l1, l2))

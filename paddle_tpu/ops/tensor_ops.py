@@ -70,6 +70,18 @@ def _concat(ctx):
     if seq is not None:
         # fluid axes address the packed [total, D] layout; our runtime is
         # padded [B, T, D], so feature axes (>= 1) shift right by one
+        if axis == 0 and all(isinstance(v, SequenceTensor) for v in ins):
+            # batch concat: pad every input to the common max T, then
+            # stack batches AND their lengths (reference row-concat on
+            # the LoD axis keeps per-sequence lengths of every input)
+            max_t = max(int(x.shape[1]) for x in xs)
+            xs = [jnp.pad(x, [(0, 0), (0, max_t - x.shape[1])] +
+                          [(0, 0)] * (x.ndim - 2)) for x in xs]
+            out = jnp.concatenate(xs, axis=0)
+            lengths = jnp.concatenate(
+                [jnp.asarray(v.lengths) for v in ins])
+            ctx.set_output('Out', SequenceTensor(out, lengths))
+            return
         rt_axis = axis + 1 if axis >= 1 else axis
         out = jnp.concatenate(xs, axis=rt_axis)
         ctx.set_output('Out', SequenceTensor(out, seq.lengths,

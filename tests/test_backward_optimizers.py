@@ -1,0 +1,139 @@
+"""Every optimizer converges on a quadratic; grad clip + regularizer effects
+(SURVEY.md §4; parity: tests/unittests/test_{sgd,momentum,adam,adamax,
+adagrad,decayed_adagrad,rmsprop,adadelta,ftrl}_op.py + test_regularizer /
+test_gradient_clip)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _quadratic_losses(opt_factory, steps=60):
+    """min ||W x - b||^2 from fixed data; returns loss trajectory."""
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 4).astype('float32')
+    tgt = xs @ rng.randn(4, 1).astype('float32')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        t = fluid.layers.data(name='t', shape=[1], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1, bias_attr=False)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(input=y, label=t))
+        opt_factory().minimize(loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(steps):
+            l, = exe.run(main, feed={'x': xs, 't': tgt},
+                         fetch_list=[loss])
+            losses.append(float(l))
+    return losses
+
+
+OPTIMIZERS = [
+    ('sgd', lambda: fluid.optimizer.SGD(learning_rate=0.05)),
+    ('momentum', lambda: fluid.optimizer.Momentum(learning_rate=0.02,
+                                                  momentum=0.9)),
+    ('adagrad', lambda: fluid.optimizer.Adagrad(learning_rate=0.3)),
+    ('adam', lambda: fluid.optimizer.Adam(learning_rate=0.1)),
+    ('adamax', lambda: fluid.optimizer.Adamax(learning_rate=0.1)),
+    ('decayed_adagrad',
+     lambda: fluid.optimizer.DecayedAdagrad(learning_rate=0.3)),
+    ('rmsprop', lambda: fluid.optimizer.RMSProp(learning_rate=0.05)),
+    ('adadelta', lambda: fluid.optimizer.Adadelta(learning_rate=1.0,
+                                                  epsilon=1e-2)),
+    ('ftrl', lambda: fluid.optimizer.Ftrl(learning_rate=0.3)),
+]
+
+
+@pytest.mark.parametrize('name,factory', OPTIMIZERS,
+                         ids=[n for n, _ in OPTIMIZERS])
+def test_optimizer_converges(name, factory):
+    losses = _quadratic_losses(factory)
+    assert np.isfinite(losses).all(), losses[-5:]
+    assert losses[-1] < losses[0] * 0.5, (name, losses[0], losses[-1])
+
+
+def test_l2_regularizer_shrinks_weights():
+    def run(reg):
+        rng = np.random.RandomState(0)
+        xs = rng.randn(8, 4).astype('float32')
+        tgt = np.zeros((8, 1), 'float32')
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+            t = fluid.layers.data(name='t', shape=[1], dtype='float32')
+            y = fluid.layers.fc(
+                input=x, size=1, bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    name='w_reg' if reg else 'w_noreg',
+                    regularizer=fluid.regularizer.L2Decay(0.5)
+                    if reg else None))
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(input=y, label=t))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(20):
+                exe.run(main, feed={'x': xs, 't': tgt}, fetch_list=[loss])
+            w = fluid.fetch_var(
+                'w_reg' if reg else 'w_noreg', scope)
+        return np.abs(w).sum()
+    assert run(True) < run(False)
+
+
+def test_global_norm_grad_clip_bounds_update():
+    rng = np.random.RandomState(0)
+    xs = (rng.randn(8, 4) * 100).astype('float32')  # huge grads
+    tgt = (rng.randn(8, 1) * 100).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        t = fluid.layers.data(name='t', shape=[1], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name='w_clip'))
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(input=y, label=t))
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0))
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w_before = fluid.fetch_var('w_clip', scope).copy()
+        exe.run(main, feed={'x': xs, 't': tgt}, fetch_list=[loss])
+        w_after = fluid.fetch_var('w_clip', scope)
+    # update magnitude == lr * clipped grad norm <= 1.0 (+ eps)
+    assert np.linalg.norm(w_after - w_before) <= 1.01
+
+
+def test_lr_scheduler_decays():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.reduce_mean(y)
+        lr = fluid.layers.exponential_decay(learning_rate=0.1,
+                                            decay_steps=1,
+                                            decay_rate=0.5,
+                                            staircase=True)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.ones((2, 2), 'float32')
+        vals = []
+        for _ in range(3):
+            v, = exe.run(main, feed={'x': xv}, fetch_list=[lr])
+            vals.append(float(np.ravel(v)[0]))
+    assert vals[0] > vals[1] > vals[2]

@@ -1,0 +1,86 @@
+"""Executor feed/fetch/cache/scope semantics (SURVEY.md §4; parity:
+tests/unittests/test_executor_and_mul.py and executor.py behavior)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.executor import Scope, global_scope, scope_guard, fetch_var
+
+
+def _build_mul():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=3)
+    return main, startup, y
+
+
+def test_feed_fetch_roundtrip():
+    main, startup, y = _build_mul()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.random.RandomState(0).randn(5, 4).astype('float32')
+    out, = exe.run(main, feed={'x': x}, fetch_list=[y])
+    assert out.shape == (5, 3)
+    # feeding by variable object in fetch_list or by name both work
+    out2, = exe.run(main, feed={'x': x}, fetch_list=[y.name])
+    np.testing.assert_allclose(out, out2)
+
+
+def test_executable_cache_hits_on_same_signature():
+    main, startup, y = _build_mul()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.zeros((2, 4), 'float32')
+    exe.run(main, feed={'x': x}, fetch_list=[y])
+    n = len(exe._cache)
+    exe.run(main, feed={'x': x + 1}, fetch_list=[y])
+    assert len(exe._cache) == n  # same shapes -> cache hit
+    exe.run(main, feed={'x': np.zeros((7, 4), 'float32')}, fetch_list=[y])
+    assert len(exe._cache) == n + 1  # new batch size -> new executable
+
+
+def test_persistables_survive_across_runs_and_fetch_var():
+    main, startup, y = _build_mul()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w_names = [v.name for v in main.global_block().vars.values()
+               if v.persistable]
+    assert w_names
+    w0 = fetch_var(w_names[0])
+    assert w0 is not None and np.isfinite(w0).all()
+
+
+def test_scope_isolation_and_guard():
+    main, startup, y = _build_mul()
+    exe = fluid.Executor(fluid.CPUPlace())
+    fresh = Scope()
+    with scope_guard(fresh):
+        exe.run(startup)
+        assert global_scope() is fresh
+        x = np.ones((1, 4), 'float32')
+        out, = exe.run(main, feed={'x': x}, fetch_list=[y])
+    # the fresh scope holds the params, not the (restored) global scope
+    names = set(fresh.keys())
+    assert any(n in names for n in
+               (v.name for v in main.global_block().vars.values()
+                if v.persistable))
+
+
+def test_device_resident_feed_accepted():
+    import jax.numpy as jnp
+    main, startup, y = _build_mul()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = jnp.ones((3, 4), jnp.float32)  # already on device: no host copy
+    out, = exe.run(main, feed={'x': x}, fetch_list=[y])
+    assert out.shape == (3, 3)
+
+
+def test_type_error_on_non_program():
+    exe = fluid.Executor(fluid.CPUPlace())
+    try:
+        exe.run("not a program", feed={}, fetch_list=[])
+    except TypeError:
+        pass
+    else:
+        raise AssertionError("expected TypeError")

@@ -1,0 +1,96 @@
+"""Program / Block / Variable IR (SURVEY.md §4; parity:
+tests/unittests/test_{program,operator_desc,variable,unique_name}.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.framework import (Program, default_main_program,
+                                  default_startup_program, program_guard)
+
+
+def _small_net(main, startup):
+    with program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.fc(input=x, size=8, act='relu')
+        y = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.reduce_mean(y)
+    return x, y, loss
+
+
+def test_program_guard_swaps_defaults():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        assert default_main_program() is main
+        assert default_startup_program() is startup
+        fluid.layers.data(name='a', shape=[2], dtype='float32')
+    assert default_main_program() is not main
+    assert 'a' in main.global_block().vars
+
+
+def test_clone_is_deep_and_stable():
+    main, startup = Program(), Program()
+    _small_net(main, startup)
+    n_ops = len(main.global_block().ops)
+    c = main.clone()
+    assert len(c.global_block().ops) == n_ops
+    assert c.fingerprint() == main.clone().fingerprint()
+    # mutating the clone must not touch the original
+    with program_guard(c, startup):
+        fluid.layers.data(name='extra', shape=[1], dtype='float32')
+    assert len(main.global_block().ops) == n_ops
+    assert 'extra' not in main.global_block().vars
+
+
+def test_clone_for_test_sets_is_test():
+    # reference semantics: clone(for_test=True) flips is_test (dropout/bn)
+    # — callers clone BEFORE minimize(), as the book scripts do.
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.dropout(fluid.layers.fc(input=x, size=8), 0.5)
+        loss = fluid.layers.reduce_mean(h)
+    test_prog = main.clone(for_test=True)
+    drop = [op for op in test_prog.global_block().ops
+            if op.type == 'dropout']
+    assert drop and drop[0].attrs['is_test'] is True
+    # the original is untouched
+    drop0 = [op for op in main.global_block().ops if op.type == 'dropout']
+    assert drop0[0].attrs['is_test'] is False
+
+
+def test_prune_keeps_only_ancestors():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        a = fluid.layers.fc(input=x, size=4)
+        b = fluid.layers.fc(input=x, size=4)  # dead branch for target a
+        t = fluid.layers.reduce_sum(a)
+    pruned = main.prune([t])
+    kept_outputs = set()
+    for op in pruned.global_block().ops:
+        kept_outputs.update(op.output_arg_names)
+    assert t.name in kept_outputs
+    assert b.name not in kept_outputs
+
+
+def test_unique_name_generates_distinct_and_guarded():
+    n1, n2 = unique_name.generate('fc'), unique_name.generate('fc')
+    assert n1 != n2
+    assert n1.startswith('fc')
+
+
+def test_variable_shape_dtype_and_ops_record_io():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[3, 5], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2)
+    assert tuple(x.shape[1:]) == (3, 5)
+    assert y.dtype in ('float32', np.float32)
+    mul_ops = [op for op in main.global_block().ops if op.type == 'mul']
+    assert mul_ops and x.name in mul_ops[0].input_arg_names
+
+
+def test_program_random_seed_roundtrip():
+    p = Program()
+    p.random_seed = 123
+    assert p.random_seed == 123

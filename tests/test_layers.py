@@ -1,0 +1,166 @@
+"""Every layer builds and runs forward (SURVEY.md §4; parity:
+tests/unittests/test_layers.py — builds each layer into a program and
+checks the op graph; we additionally execute the program)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _run(build, feeds, n_fetch=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    if not isinstance(fetches, (list, tuple)):
+        fetches = [fetches]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feeds, fetch_list=list(fetches))
+
+
+def test_fc_embedding_dropout_softmax():
+    x = np.random.RandomState(0).randn(4, 8).astype('float32')
+    ids = np.random.RandomState(1).randint(0, 10, (4, 1)).astype('int64')
+
+    def build():
+        xv = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        iv = fluid.layers.data(name='i', shape=[1], dtype='int64')
+        h = fluid.layers.fc(input=xv, size=6, act='tanh')
+        e = fluid.layers.embedding(input=iv, size=[10, 6])
+        d = fluid.layers.dropout(h, dropout_prob=0.3)
+        s = fluid.layers.softmax(h)
+        return [h, e, d, s]
+    h, e, d, s = _run(build, {'x': x, 'i': ids})
+    assert h.shape == (4, 6) and e.shape[-1] == 6
+    np.testing.assert_allclose(s.sum(-1), np.ones(4), rtol=1e-5)
+
+
+def test_conv_pool_bn_stack():
+    img = np.random.RandomState(0).randn(2, 3, 16, 16).astype('float32')
+
+    def build():
+        x = fluid.layers.data(name='img', shape=[3, 16, 16],
+                              dtype='float32')
+        c = fluid.layers.conv2d(input=x, num_filters=4, filter_size=3,
+                                padding=1, act='relu')
+        p = fluid.layers.pool2d(input=c, pool_size=2, pool_type='max',
+                                pool_stride=2)
+        b = fluid.layers.batch_norm(input=p)
+        return [c, p, b]
+    c, p, b = _run(build, {'img': img})
+    assert c.shape == (2, 4, 16, 16)
+    assert p.shape == (2, 4, 8, 8)
+    assert b.shape == (2, 4, 8, 8)
+
+
+def test_tensor_layers():
+    def build():
+        ones = fluid.layers.ones(shape=[2, 3], dtype='float32')
+        zeros = fluid.layers.zeros(shape=[2, 3], dtype='float32')
+        fc0 = fluid.layers.fill_constant(shape=[2, 3], dtype='float32',
+                                         value=2.5)
+        cat = fluid.layers.concat([ones, fc0], axis=0)
+        s = fluid.layers.sums([ones, fc0])
+        cast = fluid.layers.cast(ones, 'int32')
+        am = fluid.layers.argmax(fc0, axis=1)
+        return [ones, zeros, fc0, cat, s, cast, am]
+    o, z, f, cat, s, cast, am = _run(build, {})
+    np.testing.assert_allclose(o, np.ones((2, 3)))
+    np.testing.assert_allclose(z, np.zeros((2, 3)))
+    np.testing.assert_allclose(f, np.full((2, 3), 2.5))
+    assert cat.shape == (4, 3)
+    np.testing.assert_allclose(s, np.full((2, 3), 3.5))
+    assert cast.dtype == np.int32
+
+
+def test_generated_activation_layers():
+    x = np.random.RandomState(0).randn(3, 4).astype('float32')
+
+    def build():
+        xv = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        return [fluid.layers.sigmoid(xv), fluid.layers.tanh(xv),
+                fluid.layers.relu(xv), fluid.layers.sqrt(
+                    fluid.layers.abs(xv)),
+                fluid.layers.elementwise_add(x=xv, y=xv)]
+    sig, tanh, relu, sq, add = _run(build, {'x': x})
+    np.testing.assert_allclose(sig, 1 / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(tanh, np.tanh(x), rtol=1e-5)
+    np.testing.assert_allclose(relu, np.maximum(x, 0), rtol=1e-6)
+    np.testing.assert_allclose(add, x + x, rtol=1e-6)
+
+
+def test_reductions_and_shapes():
+    x = np.random.RandomState(0).randn(2, 3, 4).astype('float32')
+
+    def build():
+        xv = fluid.layers.data(name='x', shape=[3, 4], dtype='float32')
+        return [fluid.layers.reduce_sum(xv, dim=1),
+                fluid.layers.reduce_mean(xv),
+                fluid.layers.reduce_max(xv, dim=-1, keep_dim=True),
+                fluid.layers.transpose(xv, perm=[0, 2, 1]),
+                fluid.layers.reshape(x=xv, shape=[2, 12]),
+                ]
+    rs, rm, rmax, tr, rsh = _run(build, {'x': x})
+    np.testing.assert_allclose(rs, x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(rm, x.mean(), rtol=1e-5)
+    assert rmax.shape == (2, 3, 1)
+    assert tr.shape == (2, 4, 3)
+    assert rsh.shape == (2, 12)
+
+
+def test_losses_and_metrics():
+    rng = np.random.RandomState(0)
+    logits = rng.randn(6, 5).astype('float32')
+    label = rng.randint(0, 5, (6, 1)).astype('int64')
+
+    def build():
+        lv = fluid.layers.data(name='lg', shape=[5], dtype='float32')
+        yv = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        sm = fluid.layers.softmax(lv)
+        ce = fluid.layers.cross_entropy(input=sm, label=yv)
+        swce = fluid.layers.softmax_with_cross_entropy(logits=lv,
+                                                       label=yv)
+        acc = fluid.layers.accuracy(input=sm, label=yv)
+        return [ce, swce, acc]
+    ce, swce, acc = _run(build, {'lg': logits, 'y': label})
+    np.testing.assert_allclose(np.ravel(ce), np.ravel(swce), rtol=1e-4)
+    assert 0.0 <= float(np.ravel(acc)[0]) <= 1.0
+
+
+def test_nets_compositions():
+    img = np.random.RandomState(0).randn(2, 1, 12, 12).astype('float32')
+
+    def build():
+        x = fluid.layers.data(name='img', shape=[1, 12, 12],
+                              dtype='float32')
+        conv_pool = fluid.nets.simple_img_conv_pool(
+            input=x, num_filters=4, filter_size=3, pool_size=2,
+            pool_stride=2, act='relu')
+        return conv_pool
+    out, = _run(build, {'img': img})
+    assert out.shape[0] == 2 and out.shape[1] == 4
+
+
+def test_scaled_dot_product_attention():
+    rng = np.random.RandomState(0)
+    q = rng.randn(2, 4, 8).astype('float32')
+
+    def build():
+        qv = fluid.layers.data(name='q', shape=[4, 8], dtype='float32')
+        ctx = fluid.nets.scaled_dot_product_attention(qv, qv, qv,
+                                                      num_heads=2)
+        return ctx
+    out, = _run(build, {'q': q})
+    assert out.shape == (2, 4, 8)
+
+
+def test_glu():
+    x = np.random.RandomState(0).randn(3, 8).astype('float32')
+
+    def build():
+        xv = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        return fluid.nets.glu(input=xv, dim=-1)
+    out, = _run(build, {'x': x})
+    a, b = x[:, :4], x[:, 4:]
+    np.testing.assert_allclose(out, a * (1 / (1 + np.exp(-b))), rtol=1e-5)

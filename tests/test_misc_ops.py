@@ -1,0 +1,225 @@
+"""Long-tail op kernels vs numpy references (SURVEY.md §2.2; parity:
+tests/unittests/test_{hinge_loss,huber_loss,log_loss,rank_loss,
+margin_rank_loss,modified_huber_loss,squared_l2_distance,squared_l2_norm,
+l1_norm,minus,prelu,maxout,pool2d_with_index,unpool,spp,proximal_gd,
+proximal_adagrad}_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    if not isinstance(fetches, (list, tuple)):
+        fetches = [fetches]
+    return exe.run(main, feed=feeds, fetch_list=list(fetches))
+
+
+def _data(name, shape, dtype='float32'):
+    return fluid.layers.data(name=name, shape=shape, dtype=dtype,
+                             append_batch_size=False)
+
+
+def test_hinge_and_log_loss():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 1).astype('float32')
+    y = (rng.rand(8, 1) > 0.5).astype('float32')
+    p = rng.rand(8, 1).astype('float32')
+
+    out = _run(lambda: [
+        fluid.layers.hinge_loss(_data('x', [8, 1]), _data('y', [8, 1])),
+        fluid.layers.log_loss(_data('p', [8, 1]), _data('y', [8, 1]),
+                              epsilon=1e-4),
+    ], {'x': x, 'y': y, 'p': p})
+    np.testing.assert_allclose(
+        out[0], np.maximum(0, 1 - x * (2 * y - 1)), rtol=1e-5)
+    ref = -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4)
+    np.testing.assert_allclose(out[1], ref, rtol=1e-5)
+
+
+def test_huber_variants():
+    rng = np.random.RandomState(1)
+    x = rng.randn(6, 1).astype('float32')
+    y = rng.randn(6, 1).astype('float32')
+    yb = (rng.rand(6, 1) > 0.5).astype('float32')
+
+    out = _run(lambda: [
+        fluid.layers.huber_loss(_data('x', [6, 1]), _data('y', [6, 1]),
+                                delta=1.0),
+        fluid.layers.modified_huber_loss(_data('x', [6, 1]),
+                                         _data('yb', [6, 1])),
+    ], {'x': x, 'y': y, 'yb': yb})
+
+    r = y - x
+    ref = np.where(np.abs(r) <= 1.0, 0.5 * r * r, np.abs(r) - 0.5)
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+    a = x * (2 * yb - 1)
+    ref2 = np.where(a < -1, -4 * a, np.where(a < 1, (1 - a) ** 2, 0.0))
+    np.testing.assert_allclose(out[1], ref2, rtol=1e-5)
+
+
+def test_rank_losses():
+    rng = np.random.RandomState(2)
+    l = rng.randn(5, 1).astype('float32')
+    r = rng.randn(5, 1).astype('float32')
+    lab = (rng.rand(5, 1) > 0.5).astype('float32')
+
+    out = _run(lambda: [
+        fluid.layers.rank_loss(_data('lab', [5, 1]), _data('l', [5, 1]),
+                               _data('r', [5, 1])),
+        fluid.layers.margin_rank_loss(_data('lab', [5, 1]),
+                                      _data('l', [5, 1]),
+                                      _data('r', [5, 1]), margin=0.2),
+    ], {'l': l, 'r': r, 'lab': lab})
+    d = l - r
+    np.testing.assert_allclose(out[0], np.log(1 + np.exp(d)) - lab * d,
+                               rtol=1e-4)
+    np.testing.assert_allclose(out[1], np.maximum(-lab * d + 0.2, 0),
+                               rtol=1e-5)
+
+
+def test_norms_and_distance():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 6).astype('float32')
+    y = rng.randn(4, 6).astype('float32')
+    out = _run(lambda: [
+        fluid.layers.squared_l2_distance(_data('x', [4, 6]),
+                                         _data('y', [4, 6])),
+        fluid.layers.squared_l2_norm(_data('x', [4, 6])),
+        fluid.layers.l1_norm(_data('x', [4, 6])),
+    ], {'x': x, 'y': y})
+    np.testing.assert_allclose(
+        out[0], np.sum((x - y) ** 2, 1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(out[1], [np.sum(x ** 2)], rtol=1e-5)
+    np.testing.assert_allclose(out[2], [np.sum(np.abs(x))], rtol=1e-5)
+
+
+def test_prelu_and_maxout():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 4, 3, 3).astype('float32')
+    out = _run(lambda: [
+        fluid.layers.prelu(_data('x', [2, 4, 3, 3]), mode='all'),
+        fluid.layers.maxout(_data('x', [2, 4, 3, 3]), groups=2),
+    ], {'x': x})
+    np.testing.assert_allclose(out[0], np.where(x > 0, x, 0.25 * x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[1], x.reshape(2, 2, 2, 3, 3).max(2),
+                               rtol=1e-6)
+
+
+def _np_maxpool_with_index(x, k, s, p):
+    n, c, h, w = x.shape
+    ho = (h + 2 * p - k) // s + 1
+    wo = (w + 2 * p - k) // s + 1
+    out = np.full((n, c, ho, wo), -np.inf, x.dtype)
+    mask = np.zeros((n, c, ho, wo), np.int32)
+    for i in range(ho):
+        for j in range(wo):
+            for dh in range(k):
+                for dw in range(k):
+                    hh, ww = i * s - p + dh, j * s - p + dw
+                    if 0 <= hh < h and 0 <= ww < w:
+                        v = x[:, :, hh, ww]
+                        upd = v > out[:, :, i, j]
+                        out[:, :, i, j] = np.where(upd, v, out[:, :, i, j])
+                        mask[:, :, i, j] = np.where(
+                            upd, hh * w + ww, mask[:, :, i, j])
+    return out, mask
+
+
+def test_max_pool2d_with_index_and_unpool():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 6, 6).astype('float32')
+    ref_out, ref_mask = _np_maxpool_with_index(x, 2, 2, 0)
+
+    out = _run(lambda: list(fluid.layers.max_pool2d_with_index(
+        _data('x', [2, 3, 6, 6]), pool_size=2, pool_stride=2)), {'x': x})
+    np.testing.assert_allclose(out[0], ref_out, rtol=1e-6)
+    np.testing.assert_array_equal(out[1], ref_mask)
+
+    def build():
+        xv = _data('x', [2, 3, 6, 6])
+        o, m = fluid.layers.max_pool2d_with_index(xv, pool_size=2,
+                                                  pool_stride=2)
+        return fluid.layers.unpool(o, m, pool_size=2, pool_stride=2)
+    up, = _run(build, {'x': x})
+    ref_up = np.zeros_like(x).reshape(2 * 3, 36)
+    ref_up[np.arange(6)[:, None], ref_mask.reshape(6, -1)] = \
+        ref_out.reshape(6, -1)
+    np.testing.assert_allclose(up, ref_up.reshape(x.shape), rtol=1e-6)
+
+
+def test_spp():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 7, 7).astype('float32')
+    out, = _run(lambda: fluid.layers.spp(_data('x', [2, 3, 7, 7]),
+                                         pyramid_height=2), {'x': x})
+    assert out.shape == (2, 3 * (1 + 4))
+    # level 0: global max
+    np.testing.assert_allclose(out[:, :3], x.max((2, 3)), rtol=1e-6)
+
+
+def test_proximal_optimizers_converge():
+    # proximal_gd with l1 drives small weights exactly to zero
+    import jax
+    import paddle_tpu
+    from paddle_tpu.core.registry import get_kernel
+
+    class Ctx:
+        def __init__(self, ins, outs, attrs):
+            self._i, self.outs, self._a = ins, outs, attrs
+
+        def input(self, slot, idx=0):
+            return self._i.get(slot)
+
+        def attr(self, name, default=None):
+            return self._a.get(name, default)
+
+        def set_output(self, slot, val, idx=0):
+            self.outs[slot] = val
+
+    p = np.array([0.5, -0.001, 0.3], 'float32')
+    g = np.array([0.1, 0.0, -0.1], 'float32')
+    lr = np.array([0.1], 'float32')
+    outs = {}
+    get_kernel('proximal_gd')(Ctx(
+        {'Param': p, 'Grad': g, 'LearningRate': lr}, outs,
+        {'l1': 0.05, 'l2': 0.0}))
+    pn = np.asarray(outs['ParamOut'])
+    assert pn[1] == 0.0  # shrunk to exactly zero by l1 prox
+    assert pn[0] < 0.5 and pn[2] > 0.3
+
+    outs = {}
+    get_kernel('proximal_adagrad')(Ctx(
+        {'Param': p, 'Grad': g, 'LearningRate': lr,
+         'Moment': np.full(3, 0.1, 'float32')}, outs,
+        {'l1': 0.0, 'l2': 0.0}))
+    assert np.isfinite(np.asarray(outs['ParamOut'])).all()
+
+
+def test_minus_and_fill():
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 2).astype('float32')
+    y = rng.randn(3, 2).astype('float32')
+
+    def build():
+        xv, yv = _data('x', [3, 2]), _data('y', [3, 2])
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper('minus', **{})
+        out = helper.create_tmp_variable(dtype='float32', shape=(3, 2))
+        helper.append_op(type='minus', inputs={'X': [xv], 'Y': [yv]},
+                         outputs={'Out': [out]})
+        fill_out = helper.create_tmp_variable(dtype='float32', shape=(2, 2))
+        helper.append_op(type='fill', inputs={},
+                         outputs={'Out': [fill_out]},
+                         attrs={'value': [1., 2., 3., 4.],
+                                'shape': [2, 2], 'dtype': 'float32'})
+        return [out, fill_out]
+    out = _run(build, {'x': x, 'y': y})
+    np.testing.assert_allclose(out[0], x - y, rtol=1e-6)
+    np.testing.assert_allclose(out[1], [[1, 2], [3, 4]])

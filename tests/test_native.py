@@ -72,3 +72,22 @@ def test_recordio_source_uses_native(tmp_path):
     rows = list(src)
     assert len(rows) == 5
     assert rows[0][0].shape == (4, 3)
+
+
+def test_prefetch_loader_raises_on_corrupt_file(tmp_path):
+    from paddle_tpu.native import loader
+    if not loader.available():
+        import pytest
+        pytest.skip("native lib unavailable")
+    good = str(tmp_path / 'good.recordio')
+    loader.write_records(good, [b'aaa', b'bbb'])
+    bad = str(tmp_path / 'bad.recordio')
+    data = bytearray(open(good, 'rb').read())
+    data[-2] ^= 0xFF  # corrupt last payload byte -> crc mismatch
+    open(bad, 'wb').write(bytes(data))
+    import pytest
+    with pytest.raises(IOError):
+        list(loader.PrefetchLoader([good, bad], n_threads=1))
+    with pytest.raises(IOError):
+        list(loader.PrefetchLoader([good, str(tmp_path / 'missing.rio')],
+                                   n_threads=1))

@@ -1,0 +1,128 @@
+"""RNN variants vs numpy scan: lstmp, peepholes, reverse (SURVEY.md §4;
+parity: tests/unittests/test_{lstmp,lstm}_op.py — complements
+test_sequence.py's plain lstm/gru checks)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.lod import create_lod_tensor
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstmp(x_rows, lens, w, wp, b, use_peep):
+    """Time scan matching ops/rnn_ops.py gate layout (c,i,f,o)."""
+    H = w.shape[1] // 4
+    P = wp.shape[1]
+    outs = []
+    offset = 0
+    for L in lens:
+        r = np.zeros(P)
+        c = np.zeros(H)
+        for t in range(L):
+            g = x_rows[offset + t] + r @ w + b[0, :4 * H]
+            gc, gi, gf, go = np.split(g, 4)
+            if use_peep:
+                gi = gi + c * b[0, 4 * H:5 * H]
+                gf = gf + c * b[0, 5 * H:6 * H]
+            i, f = _sigmoid(gi), _sigmoid(gf)
+            c = np.tanh(gc) * i + c * f
+            if use_peep:
+                go = go + c * b[0, 6 * H:7 * H]
+            o = _sigmoid(go)
+            h = o * np.tanh(c)
+            r = np.tanh(h @ wp)
+            outs.append(r.copy())
+        offset += L
+    return np.asarray(outs)
+
+
+def _run_lstmp(x_rows, lens, H, P, use_peep):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name='x', shape=[4 * H], dtype='float32',
+                               lod_level=1)
+        proj, cell = fluid.layers.dynamic_lstmp(
+            input=xv, size=4 * H, proj_size=P, use_peepholes=use_peep)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        lstmp_op = [op for op in main.global_block().ops
+                    if op.type == 'dynamic_lstmp'][0]
+        st = create_lod_tensor(x_rows.astype('float32'), [lens])
+        out, = exe.run(main, feed={'x': st}, fetch_list=[proj])
+        w = fluid.fetch_var(lstmp_op.inputs['Weight'][0], scope)
+        b = fluid.fetch_var(lstmp_op.inputs['Bias'][0], scope)
+        wp = fluid.fetch_var(lstmp_op.inputs['ProjWeight'][0], scope)
+    return out, w, wp, b
+
+
+def test_dynamic_lstmp_matches_numpy():
+    rng = np.random.RandomState(0)
+    H, P = 4, 3
+    lens = [3, 2]
+    x_rows = rng.randn(sum(lens), 4 * H).astype('float32') * 0.5
+    for use_peep in (False, True):
+        out, w, wp, b = _run_lstmp(x_rows, lens, H, P, use_peep)
+        ref = _np_lstmp(x_rows, lens, w, wp, b, use_peep)
+        got = np.concatenate([out.data[i, :lens[i]]
+                              for i in range(len(lens))])
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_reverse_lstm_reverses_scan():
+    rng = np.random.RandomState(1)
+    H = 3
+    lens = [4, 2]
+    x_rows = rng.randn(sum(lens), 4 * H).astype('float32') * 0.5
+
+    def run(rows, is_rev):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7  # same init both runs
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data(name='x', shape=[4 * H],
+                                   dtype='float32', lod_level=1)
+            h, c = fluid.layers.dynamic_lstm(
+                input=xv, size=4 * H, use_peepholes=False,
+                is_reverse=is_rev,
+                param_attr=fluid.ParamAttr(name='w_rev'),
+                bias_attr=fluid.ParamAttr(
+                    name='b_rev',
+                    initializer=fluid.initializer.Constant(0.1)))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            st = create_lod_tensor(rows, [lens])
+            out, = exe.run(main, feed={'x': st}, fetch_list=[h])
+        return out
+
+    # reversed scan over x == forward scan over per-sequence-reversed x,
+    # with outputs re-reversed (the reference's is_reverse contract)
+    rev = run(x_rows, True)
+    rows_rev = np.concatenate([x_rows[:4][::-1], x_rows[4:][::-1]])
+    fwd_on_rev = run(rows_rev, False)
+    for b, L in enumerate(lens):
+        np.testing.assert_allclose(rev.data[b, :L],
+                                   fwd_on_rev.data[b, :L][::-1],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_gru_unit_step_consistency():
+    rng = np.random.RandomState(2)
+    B, H = 2, 4
+    x = rng.randn(B, 3 * H).astype('float32') * 0.5
+    h0 = rng.randn(B, H).astype('float32') * 0.5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name='x', shape=[3 * H], dtype='float32')
+        hv = fluid.layers.data(name='h', shape=[H], dtype='float32')
+        out = fluid.layers.gru_unit(input=xv, hidden=hv, size=3 * H)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        h1, = exe.run(main, feed={'x': x, 'h': h0},
+                      fetch_list=[out[0]])
+    assert h1.shape == (B, H)
+    assert np.isfinite(h1).all()

@@ -290,3 +290,29 @@ def test_lstm_unit_and_gru_unit_layers():
                   fetch_list=[h, c, gh])
     assert res[0].shape == (2, 4) and res[1].shape == (2, 4)
     assert res[2].shape == (2, 4)
+
+
+def test_concat_axis0_merges_batches_and_lengths():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.lod import create_lod_tensor
+    a_rows = np.arange(6, dtype='float32').reshape(3, 2)
+    b_rows = np.arange(10, dtype='float32').reshape(5, 2) + 100
+    st_a = create_lod_tensor(a_rows, [[2, 1]])
+    st_b = create_lod_tensor(b_rows, [[4, 1]])
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        av = fluid.layers.data(name='a', shape=[2], dtype='float32',
+                               lod_level=1)
+        bv = fluid.layers.data(name='b', shape=[2], dtype='float32',
+                               lod_level=1)
+        cat = fluid.layers.concat([av, bv], axis=0)
+        pooled = fluid.layers.sequence_pool(cat, 'sum')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out, pool = exe.run(main, feed={'a': st_a, 'b': st_b},
+                        fetch_list=[cat, pooled])
+    assert list(out.lengths) == [2, 1, 4, 1]
+    ref = np.stack([a_rows[:2].sum(0), a_rows[2:3].sum(0),
+                    b_rows[:4].sum(0), b_rows[4:5].sum(0)])
+    np.testing.assert_allclose(pool, ref, rtol=1e-6)
