@@ -148,6 +148,34 @@ class Executor(object):
         names_out = sorted(names_out | set(names_in))
         return names_in, names_out
 
+    def _maybe_prune(self, program, fetch_names):
+        """Inference-style programs (no backward, no control flow) lower
+        only the ancestors of the fetches + persistable-state writes.
+
+        TPU rationale: the whole block becomes ONE XLA program, so dead
+        branches would otherwise be traced (and their feeds required) even
+        though XLA DCEs them post-compile. Training programs (backward
+        marker) and programs with sub-blocks are lowered whole.
+        """
+        if not fetch_names:
+            return program
+        block = program.global_block()
+        persist_outs = []
+        for op in block.ops:
+            if op.type in ('backward_marker', 'print'):
+                # training step / host side effects: lower the whole block
+                return program
+            if any(isinstance(v, framework.Block)
+                   for v in op.attrs.values()):
+                return program
+            for n in op.output_arg_names:
+                var = block._find_var_recursive(n)
+                if var is not None and var.persistable:
+                    persist_outs.append(n)
+        targets = list(fetch_names) + persist_outs
+        pruned = program.prune(targets)
+        return pruned
+
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name='feed', fetch_var_name='fetch', scope=None,
             return_numpy=True, use_program_cache=True):
@@ -176,7 +204,8 @@ class Executor(object):
                tuple(state_out_names))
         entry = self._cache.get(key)
         if entry is None:
-            fn = lower_block(program, program.global_block(),
+            lower_prog = self._maybe_prune(program, fetch_names)
+            fn = lower_block(lower_prog, lower_prog.global_block(),
                              sorted(feed.keys()), fetch_names,
                              state_in_names, state_out_names)
             jitted = jax.jit(fn, donate_argnums=(1,))

@@ -233,9 +233,17 @@ def _spp(ctx):
                                        'VALID')
         else:
             padded = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
-            pooled = lax.reduce_window(padded, 0.0, lax.add,
+            sums = lax.reduce_window(padded, 0.0, lax.add,
+                                     (1, 1, kh, kw), (1, 1, sh_, sw_),
+                                     'VALID')
+            # ref math/pooling.cc divides by the CLIPPED (in-image) window
+            # size, not kh*kw — count real pixels per bin the same way
+            ones = jnp.pad(jnp.ones((1, 1, h, w), x.dtype),
+                           ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+            counts = lax.reduce_window(ones, 0.0, lax.add,
                                        (1, 1, kh, kw), (1, 1, sh_, sw_),
-                                       'VALID') / (kh * kw)
+                                       'VALID')
+            pooled = sums / jnp.maximum(counts, 1.0)
         outs.append(pooled[:, :, :bins, :bins].reshape(n, -1))
     ctx.set_output('Out', jnp.concatenate(outs, axis=1))
 
@@ -271,3 +279,101 @@ def _proximal_adagrad(ctx):
     lr_t = lr / jnp.sqrt(m_out)
     ctx.set_output('MomentOut', m_out)
     ctx.set_output('ParamOut', _prox(p - lr_t * g, lr_t, l1, l2))
+
+
+# ---- metric ops -----------------------------------------------------------------
+@register_kernel('precision_recall')
+def _precision_recall(ctx):
+    """ref precision_recall_op.h: per-class TP/FP/TN/FN states + macro/micro
+    precision/recall/F1. One-hot scatter instead of the per-sample loop."""
+    idx = unwrap(ctx.input('Indices')).reshape(-1).astype(jnp.int32)
+    label = unwrap(ctx.input('Labels')).reshape(-1).astype(jnp.int32)
+    C = ctx.attr('class_number')
+    w = unwrap(ctx.input('Weights'))
+    w = (jnp.ones(idx.shape, jnp.float32) if w is None
+         else jnp.asarray(w).reshape(-1).astype(jnp.float32))
+    oh_idx = jax.nn.one_hot(idx, C, dtype=jnp.float32)
+    oh_lab = jax.nn.one_hot(label, C, dtype=jnp.float32)
+    match = (idx == label).astype(jnp.float32)[:, None]
+    tp = jnp.sum(w[:, None] * match * oh_idx, axis=0)
+    fp = jnp.sum(w[:, None] * (1 - match) * oh_idx, axis=0)
+    fn = jnp.sum(w[:, None] * (1 - match) * oh_lab, axis=0)
+    # TN: every sample adds w to all classes except its idx (and its label
+    # when mispredicted)
+    tn = (jnp.sum(w) - jnp.sum(w[:, None] * oh_idx, axis=0)
+          - jnp.sum(w[:, None] * (1 - match) * oh_lab, axis=0))
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+
+    prior = ctx.input('StatesInfo')
+    accum_states = batch_states if prior is None else \
+        batch_states + jnp.asarray(unwrap(prior)).astype(jnp.float32)
+
+    def metrics(states):
+        tp_, fp_, _, fn_ = (states[:, 0], states[:, 1], states[:, 2],
+                            states[:, 3])
+
+        def safe(n, d):
+            return jnp.where((n > 0) | (d > 0), n / jnp.maximum(n + d,
+                                                                1e-30), 1.0)
+
+        def f1(p, r):
+            return jnp.where((p > 0) | (r > 0),
+                             2 * p * r / jnp.maximum(p + r, 1e-30), 0.0)
+
+        mac_p = jnp.mean(safe(tp_, fp_))
+        mac_r = jnp.mean(safe(tp_, fn_))
+        mic_p = safe(tp_.sum(), fp_.sum())
+        mic_r = safe(tp_.sum(), fn_.sum())
+        return jnp.stack([mac_p, mac_r, f1(mac_p, mac_r),
+                          mic_p, mic_r, f1(mic_p, mic_r)])
+
+    ctx.set_output('BatchMetrics', metrics(batch_states))
+    ctx.set_output('AccumMetrics', metrics(accum_states))
+    ctx.set_output('AccumStatesInfo', accum_states)
+
+
+@register_kernel('positive_negative_pair')
+def _positive_negative_pair(ctx):
+    """ref positive_negative_pair_op.h: per-query pairwise order counts.
+    Pairs with equal labels are ignored; pair weight = mean of both docs'
+    weights; equal scores count as neutral AND negative (ref ternary)."""
+    score = unwrap(ctx.input('Score'))
+    label = unwrap(ctx.input('Label')).reshape(-1)
+    query = unwrap(ctx.input('QueryID')).reshape(-1)
+    col = ctx.attr('column', -1)
+    s = score[:, col].reshape(-1)
+    w_in = ctx.input('Weight')
+    w = (jnp.ones(s.shape, s.dtype) if w_in is None
+         else jnp.asarray(unwrap(w_in)).reshape(-1))
+    same_q = query[:, None] == query[None, :]
+    upper = jnp.triu(jnp.ones((s.shape[0], s.shape[0]), bool), k=1)
+    ld = label[:, None] - label[None, :]
+    sd = s[:, None] - s[None, :]
+    pw = 0.5 * (w[:, None] + w[None, :])
+    valid = same_q & upper & (ld != 0)
+    vw = jnp.where(valid, pw, 0.0)
+    pos = jnp.sum(jnp.where(sd * ld > 0, vw, 0.0))
+    neg = jnp.sum(jnp.where(sd * ld <= 0, vw, 0.0))
+    neu = jnp.sum(jnp.where(sd == 0, vw, 0.0))
+    for slot, val in (('PositivePair', pos), ('NegativePair', neg),
+                      ('NeutralPair', neu)):
+        acc = ctx.input('Accumulate%s' % slot[:-4] + 'Pair')
+        if acc is not None:
+            val = val + jnp.asarray(unwrap(acc)).reshape(())
+        ctx.set_output(slot, val.reshape(1))
+
+
+# ---- reference op-type aliases --------------------------------------------------
+# The reference registers the recurrent kernels as 'lstm'/'lstmp'/'gru'
+# (paddle/fluid/operators/{lstm,lstmp,gru}_op.cc); our layers append the
+# fluid layer names. Register both so reference-built ProgramDescs lower.
+def _alias(name, target):
+    from ..core import registry
+    if not registry.has_kernel(name):
+        register_kernel(name)(registry.get_kernel(target))
+
+
+_alias('lstm', 'dynamic_lstm')
+_alias('lstmp', 'dynamic_lstmp')
+_alias('gru', 'dynamic_gru')
+_alias('smooth_l1_loss', 'smooth_l1')

@@ -80,7 +80,16 @@ def _concat(ctx):
             out = jnp.concatenate(xs, axis=0)
             lengths = jnp.concatenate(
                 [jnp.asarray(v.lengths) for v in ins])
-            ctx.set_output('Out', SequenceTensor(out, lengths))
+            subs = None
+            if all(v.sub_lengths is not None for v in ins):
+                # level-2: sub_lengths are [B, padded_outer]; pad to the
+                # common outer length and stack batches like the data
+                max_o = max(int(v.sub_lengths.shape[1]) for v in ins)
+                subs = jnp.concatenate(
+                    [jnp.pad(jnp.asarray(v.sub_lengths),
+                             [(0, 0), (0, max_o - v.sub_lengths.shape[1])])
+                     for v in ins])
+            ctx.set_output('Out', SequenceTensor(out, lengths, subs))
             return
         rt_axis = axis + 1 if axis >= 1 else axis
         out = jnp.concatenate(xs, axis=rt_axis)

@@ -84,3 +84,25 @@ def test_type_error_on_non_program():
         pass
     else:
         raise AssertionError("expected TypeError")
+
+
+def test_inference_programs_prune_to_fetches():
+    # fetching a mid-graph var must not require feeds of dead branches
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        t = fluid.layers.data(name='t', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(input=pred, label=t))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xs = np.ones((2, 4), 'float32')
+        # no 't' feed: the loss branch is pruned away for this fetch set
+        out, = exe.run(main, feed={'x': xs}, fetch_list=[pred])
+        assert out.shape == (2, 1)
+        # fetching the loss still works when t IS fed
+        l, = exe.run(main, feed={'x': xs, 't': np.ones((2, 1), 'float32')},
+                     fetch_list=[loss])
+        assert np.isfinite(l).all()

@@ -24,6 +24,22 @@ def _data(name, shape, dtype='float32'):
     return fluid.layers.data(name=name, shape=shape, dtype=dtype,
                              append_batch_size=False)
 
+class Ctx:
+    """Minimal kernel-context mock for driving op kernels directly."""
+
+    def __init__(self, ins, attrs):
+        self._i, self.outs, self._a = ins, {}, attrs
+
+    def input(self, slot, idx=0):
+        return self._i.get(slot)
+
+    def attr(self, name, default=None):
+        return self._a.get(name, default)
+
+    def set_output(self, slot, val, idx=0):
+        self.outs[slot] = val
+
+
 
 def test_hinge_and_log_loss():
     rng = np.random.RandomState(0)
@@ -170,36 +186,21 @@ def test_proximal_optimizers_converge():
     import paddle_tpu
     from paddle_tpu.core.registry import get_kernel
 
-    class Ctx:
-        def __init__(self, ins, outs, attrs):
-            self._i, self.outs, self._a = ins, outs, attrs
-
-        def input(self, slot, idx=0):
-            return self._i.get(slot)
-
-        def attr(self, name, default=None):
-            return self._a.get(name, default)
-
-        def set_output(self, slot, val, idx=0):
-            self.outs[slot] = val
-
     p = np.array([0.5, -0.001, 0.3], 'float32')
     g = np.array([0.1, 0.0, -0.1], 'float32')
     lr = np.array([0.1], 'float32')
-    outs = {}
-    get_kernel('proximal_gd')(Ctx(
-        {'Param': p, 'Grad': g, 'LearningRate': lr}, outs,
-        {'l1': 0.05, 'l2': 0.0}))
-    pn = np.asarray(outs['ParamOut'])
+    c = Ctx({'Param': p, 'Grad': g, 'LearningRate': lr},
+            {'l1': 0.05, 'l2': 0.0})
+    get_kernel('proximal_gd')(c)
+    pn = np.asarray(c.outs['ParamOut'])
     assert pn[1] == 0.0  # shrunk to exactly zero by l1 prox
     assert pn[0] < 0.5 and pn[2] > 0.3
 
-    outs = {}
-    get_kernel('proximal_adagrad')(Ctx(
-        {'Param': p, 'Grad': g, 'LearningRate': lr,
-         'Moment': np.full(3, 0.1, 'float32')}, outs,
-        {'l1': 0.0, 'l2': 0.0}))
-    assert np.isfinite(np.asarray(outs['ParamOut'])).all()
+    c2 = Ctx({'Param': p, 'Grad': g, 'LearningRate': lr,
+              'Moment': np.full(3, 0.1, 'float32')},
+             {'l1': 0.0, 'l2': 0.0})
+    get_kernel('proximal_adagrad')(c2)
+    assert np.isfinite(np.asarray(c2.outs['ParamOut'])).all()
 
 
 def test_minus_and_fill():
@@ -223,3 +224,60 @@ def test_minus_and_fill():
     out = _run(build, {'x': x, 'y': y})
     np.testing.assert_allclose(out[0], x - y, rtol=1e-6)
     np.testing.assert_allclose(out[1], [[1, 2], [3, 4]])
+
+
+def test_precision_recall_kernel():
+    from paddle_tpu.core.registry import get_kernel
+
+    idx = np.array([0, 1, 2, 1], 'int32')
+    lab = np.array([0, 1, 1, 0], 'int32')
+    ctx = Ctx({'Indices': idx, 'Labels': lab}, {'class_number': 3})
+    get_kernel('precision_recall')(ctx)
+    states = np.asarray(ctx.outs['AccumStatesInfo'])  # [C, (TP,FP,TN,FN)]
+    # class0: TP=1 (s0); FN=1 (s3); class1: TP=1 (s1), FP=1 (s3); class2:
+    # FP=1 (s2); class1 FN=1 (s2)
+    np.testing.assert_allclose(states[:, 0], [1, 1, 0])  # TP
+    np.testing.assert_allclose(states[:, 1], [0, 1, 1])  # FP
+    np.testing.assert_allclose(states[:, 3], [1, 1, 0])  # FN
+    m = np.asarray(ctx.outs['BatchMetrics'])
+    # micro precision = total TP / (TP+FP) = 2/4
+    np.testing.assert_allclose(m[3], 0.5, rtol=1e-6)
+
+    # accumulation path adds prior states
+    ctx2 = Ctx({'Indices': idx, 'Labels': lab, 'StatesInfo': states},
+               {'class_number': 3})
+    get_kernel('precision_recall')(ctx2)
+    np.testing.assert_allclose(np.asarray(ctx2.outs['AccumStatesInfo']),
+                               2 * states)
+
+
+def test_positive_negative_pair_kernel():
+    from paddle_tpu.core.registry import get_kernel
+
+    # one query with 3 docs: scores [3,2,1], labels [2,1,0] -> all 3 pairs
+    # correctly ordered; second query with reversed pair -> negative
+    score = np.array([[3.], [2.], [1.], [1.], [2.]], 'float32')
+    label = np.array([[2.], [1.], [0.], [1.], [0.]], 'float32')
+    qid = np.array([[0], [0], [0], [7], [7]], 'int64')
+    ctx = Ctx({'Score': score, 'Label': label, 'QueryID': qid},
+              {'column': -1})
+    get_kernel('positive_negative_pair')(ctx)
+    assert float(ctx.outs['PositivePair'][0]) == 3.0
+    assert float(ctx.outs['NegativePair'][0]) == 1.0
+    assert float(ctx.outs['NeutralPair'][0]) == 0.0
+
+
+def test_reference_op_aliases_registered():
+    from paddle_tpu.core.registry import has_kernel
+    for name in ('lstm', 'lstmp', 'gru', 'smooth_l1_loss'):
+        assert has_kernel(name), name
+
+
+def test_spp_avg_uses_clipped_window():
+    # all-ones input must pool to exactly 1.0 in every bin, including
+    # border bins where adaptive padding clips the window
+    x = np.ones((1, 1, 7, 7), 'float32')
+    out, = _run(lambda: fluid.layers.spp(_data('x', [1, 1, 7, 7]),
+                                         pyramid_height=2,
+                                         pool_type='avg'), {'x': x})
+    np.testing.assert_allclose(out, np.ones_like(out), rtol=1e-6)
