@@ -108,20 +108,12 @@ def _layer_norm(x, g, b, eps=1e-5):
 
 
 def _causal_attention(q, k, v, q_off=0, k_off=0):
-    """Plain blockwise causal attention. q,k,v: [B, T, H, Dh] (bf16).
-    Offsets give the global positions of the local blocks."""
-    B, Tq, H, Dh = q.shape
-    Tk = k.shape[1]
-    scale = 1.0 / math.sqrt(Dh)
-    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
-                   preferred_element_type=jnp.float32) * scale
-    qpos = q_off + jnp.arange(Tq)
-    kpos = k_off + jnp.arange(Tk)
-    mask = qpos[:, None] >= kpos[None, :]
-    s = jnp.where(mask[None, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum('bhqk,bkhd->bqhd', p, v,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+    """Plain blockwise causal attention (ring-attention building block).
+    q,k,v: [B, T, H, Dh] (bf16); offsets give the global positions of the
+    local blocks. Math lives in ops/pallas_kernels.attention_reference."""
+    from ..ops.pallas_kernels import attention_reference
+    return attention_reference(q, k, v, causal=True, q_off=q_off,
+                               k_off=k_off)
 
 
 def ring_attention(q, k, v, axis_name='sp'):
@@ -188,7 +180,10 @@ def _block(x, lp, cfg, attn_fn):
 def forward(params, tokens, cfg, attn_fn=None, pos_offset=0):
     """tokens [B, T] int32 -> logits [B, T, vocab] f32."""
     if attn_fn is None:
-        attn_fn = lambda q, k, v: _causal_attention(q, k, v)
+        # Pallas flash-attention on TPU (ops/pallas_kernels.py); identical
+        # -math XLA fallback elsewhere / for non-block-aligned shapes.
+        from ..ops.pallas_kernels import flash_attention
+        attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
     dt = cfg.dtype
     x = params['embed'].astype(dt)[tokens]
     T = tokens.shape[1]

@@ -1,0 +1,250 @@
+"""Pallas TPU kernels for the hot paths: flash attention + fused LSTM cell.
+
+Parity intent: the reference accelerates attention/LSTM with cuDNN and
+hand-written CUDA (paddle/fluid/operators/{lstm_op,math/lstm_compute}.*,
+scaled_dot_product_attention composed from cuBLAS matmuls). The TPU
+equivalents are written in Pallas:
+
+- ``flash_attention``: blockwise online-softmax attention that never
+  materialises the [T, T] score matrix; q/k/v blocks stream HBM->VMEM and
+  the inner matmuls hit the MXU. Grid = (batch*heads, q-blocks).
+- ``fused_lstm_cell``: one kernel for the recurrent matmul + all four gate
+  nonlinearities + state update, so per-step HBM traffic is just the
+  carried state (XLA would otherwise split matmul and VPU work).
+
+Both carry a pure-jnp fallback (identical math) used off-TPU and for
+odd shapes; tests run the Pallas path with ``interpret=True`` on CPU.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is TPU-only at runtime but importable everywhere
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_NEG_INF = -1e30
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == 'tpu'
+    except Exception:
+        return False
+
+
+# ---- flash attention ------------------------------------------------------------
+def attention_reference(q, k, v, causal=True, q_off=0, k_off=0):
+    """Canonical masked-softmax attention, plain XLA. q,k,v: [B, T, H, D].
+
+    Single source of truth for the math: the Pallas kernel's parity tests,
+    flash_attention's off-TPU fallback, its custom-vjp backward, AND the
+    transformer model's blockwise/ring path (which passes q_off/k_off for
+    the global positions of local blocks) all call this."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[1])
+        kpos = k_off + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum('bhqk,bkhd->bqhd', p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal):
+    """One (batch*head, q-block) program: stream k/v blocks, online softmax."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)              # [block_q, D]
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    t_k = k_ref.shape[1]
+    n_kb = t_k // block_k
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        o, m, l = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(
+            k_ref[0], kb * block_k, block_k, axis=0).astype(jnp.float32)
+        v_blk = jax.lax.dynamic_slice_in_dim(
+            v_ref[0], kb * block_k, block_k, axis=0).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_new = o * alpha[:, None] + pv
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # only k blocks at or before this q block contribute
+        n_live = (jnp.minimum((qi + 1) * block_q, t_k)
+                  + block_k - 1) // block_k
+    else:
+        n_live = n_kb
+    o, m, l = jax.lax.fori_loop(0, n_live, body, (o0, m0, l0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_pallas_call(q, k, v, causal, block_q, block_k, interpret):
+    """Raw Pallas forward on [B, T, H, D]."""
+    B, T, H, D = q.shape
+    qn = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kn = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    vn = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    on = pl.pallas_call(
+        functools.partial(_flash_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qn, kn, vn)
+    return on.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_pallas_call(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return (_flash_pallas_call(q, k, v, causal, block_q, block_k,
+                               interpret), (q, k, v))
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    # Flash-style backward: recompute attention through the XLA reference
+    # (identical math) and transpose it — no [T, T] tensor is saved
+    # between fwd and bwd, only q/k/v.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                    interpret=None):
+    """Blockwise attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
+
+    Forward uses the Pallas kernel on TPU (or when ``interpret=True``);
+    backward recomputes through the XLA reference via custom_vjp, so the
+    training step differentiates cleanly. Off-TPU / non-block-aligned
+    shapes take the reference path outright.
+    """
+    T = q.shape[1]
+    if interpret is None:
+        interpret = False
+    use_pallas = _HAS_PALLAS and (interpret or _on_tpu())
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        use_pallas = False
+    if not use_pallas:
+        return attention_reference(q, k, v, causal)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+# ---- fused LSTM cell ------------------------------------------------------------
+def _lstm_cell_reference(xg, r_prev, c_prev, w):
+    """xg: [B, 4H] pre-projected input+bias; w: [H, 4H]; gate order
+    (candidate, input, forget, output) per ops/rnn_ops.py."""
+    g = xg + r_prev @ w
+    gc, gi, gf, go = jnp.split(g, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf)
+    c = jnp.tanh(gc) * i + c_prev * f
+    o = jax.nn.sigmoid(go)
+    return o * jnp.tanh(c), c
+
+
+def _lstm_cell_kernel(xg_ref, r_ref, c_ref, w_ref, h_out, c_out):
+    xg = xg_ref[:].astype(jnp.float32)
+    r = r_ref[:].astype(jnp.float32)
+    c_prev = c_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = xg + jax.lax.dot_general(r, w, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    hdim = c_prev.shape[-1]
+    gc = jax.lax.dynamic_slice_in_dim(g, 0, hdim, axis=1)
+    gi = jax.lax.dynamic_slice_in_dim(g, hdim, hdim, axis=1)
+    gf = jax.lax.dynamic_slice_in_dim(g, 2 * hdim, hdim, axis=1)
+    go = jax.lax.dynamic_slice_in_dim(g, 3 * hdim, hdim, axis=1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf)
+    c = jnp.tanh(gc) * i + c_prev * f
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    h_out[:] = h.astype(h_out.dtype)
+    c_out[:] = c.astype(c_out.dtype)
+
+
+def _lstm_cell_pallas(xg, r_prev, c_prev, w, interpret):
+    B, H = c_prev.shape
+    return pl.pallas_call(
+        _lstm_cell_kernel,
+        out_shape=(jax.ShapeDtypeStruct((B, H), r_prev.dtype),
+                   jax.ShapeDtypeStruct((B, H), c_prev.dtype)),
+        interpret=interpret,
+    )(xg, r_prev, c_prev, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _lstm_cell(xg, r_prev, c_prev, w, interpret):
+    return _lstm_cell_pallas(xg, r_prev, c_prev, w, interpret)
+
+
+def _lstm_cell_fwd(xg, r_prev, c_prev, w, interpret):
+    return (_lstm_cell_pallas(xg, r_prev, c_prev, w, interpret),
+            (xg, r_prev, c_prev, w))
+
+
+def _lstm_cell_bwd(interpret, res, g):
+    xg, r_prev, c_prev, w = res
+    _, vjp = jax.vjp(_lstm_cell_reference, xg, r_prev, c_prev, w)
+    return vjp(g)
+
+
+_lstm_cell.defvjp(_lstm_cell_fwd, _lstm_cell_bwd)
+
+
+def fused_lstm_cell(xg, r_prev, c_prev, w, interpret=None):
+    """One LSTM step: recurrent matmul + gates + state update in a single
+    kernel (differentiable: backward recomputes via the XLA reference).
+    xg: [B, 4H], r_prev/c_prev: [B, H], w: [H, 4H]. Called from
+    ops/rnn_ops.py::_lstm_scan for the default-activation non-peephole
+    path."""
+    if interpret is None:
+        interpret = False
+    use_pallas = _HAS_PALLAS and (interpret or _on_tpu())
+    if not use_pallas:
+        return _lstm_cell_reference(xg, r_prev, c_prev, w)
+    return _lstm_cell(xg, r_prev, c_prev, w, interpret)

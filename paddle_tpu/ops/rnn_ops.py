@@ -53,22 +53,34 @@ def _lstm_scan(x, lengths, w, b, h0, c0, use_peep, gact, cact, candact,
     xt = jnp.swapaxes(x, 0, 1) + gate_b           # [T, B, 4H]
     mask = _mask_t(lengths, T, x.dtype)
 
+    # Default-activation, non-peephole, non-projected cells take the fused
+    # Pallas kernel (ops/pallas_kernels.py): recurrent matmul + all gates
+    # in one kernel launch per step.
+    fused_ok = (not use_peep and proj is None
+                and gact is jax.nn.sigmoid and cact is jnp.tanh
+                and candact is jnp.tanh)
+
     def step(carry, inp):
         r_prev, c_prev = carry
         xg, m = inp
-        g = xg + r_prev @ w
-        gc, gi, gf, go = jnp.split(g, 4, axis=-1)  # (c, i, f, o)
-        if use_peep:
-            gi = gi + c_prev * w_ic
-            gf = gf + c_prev * w_fc
-        i = gact(gi)
-        f = gact(gf)
-        c = candact(gc) * i + c_prev * f
-        if use_peep:
-            go = go + c * w_oc
-        o = gact(go)
-        h = o * cact(c)
-        r = pact(h @ proj) if proj is not None else h
+        if fused_ok:
+            from .pallas_kernels import fused_lstm_cell
+            h, c = fused_lstm_cell(xg, r_prev, c_prev, w)
+            r = h
+        else:
+            g = xg + r_prev @ w
+            gc, gi, gf, go = jnp.split(g, 4, axis=-1)  # (c, i, f, o)
+            if use_peep:
+                gi = gi + c_prev * w_ic
+                gf = gf + c_prev * w_fc
+            i = gact(gi)
+            f = gact(gf)
+            c = candact(gc) * i + c_prev * f
+            if use_peep:
+                go = go + c * w_oc
+            o = gact(go)
+            h = o * cact(c)
+            r = pact(h @ proj) if proj is not None else h
         r = m * r + (1 - m) * r_prev
         c = m * c + (1 - m) * c_prev
         return (r, c), (r, c)

@@ -1,0 +1,24 @@
+"""benchmark/fluid harness smoke test (SURVEY.md §2.6; parity:
+benchmark/fluid/fluid_benchmark.py). Runs the harness main() in-process
+(same interpreter: the already-initialised CPU backend keeps it fast)."""
+import json
+import os
+import sys
+
+import pytest
+
+_DIR = os.path.abspath(os.path.join(os.path.dirname(__file__), '..',
+                                    'benchmark', 'fluid'))
+
+
+@pytest.mark.parametrize('model', ['mnist', 'stacked_dynamic_lstm'])
+def test_fluid_benchmark_cli(model, capsys, monkeypatch):
+    monkeypatch.syspath_prepend(_DIR)
+    import fluid_benchmark
+    monkeypatch.setattr(sys, 'argv', [
+        'fluid_benchmark.py', '--model', model, '--batch_size', '2',
+        '--iterations', '2', '--skip_batch_num', '1', '--device', 'CPU'])
+    fluid_benchmark.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec['model'] == model
+    assert rec['throughput'] > 0

@@ -1,0 +1,103 @@
+"""Pallas kernels vs their XLA-fallback math (SURVEY.md §2.2: fused LSTM
+cell + flash attention). On CPU the Pallas path runs with interpret=True,
+so the kernel bodies themselves are exercised."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops import pallas_kernels as pk
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_flash_attention_matches_reference(causal):
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 64, 2, 16
+    q = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+    ref = pk.attention_reference(q, k, v, causal=causal)
+    out = pk.flash_attention(q, k, v, causal=causal, block_q=32,
+                             block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_causality():
+    rng = np.random.RandomState(1)
+    B, T, H, D = 1, 32, 1, 8
+    q = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+    base = pk.flash_attention(q, k, v, causal=True, block_q=16,
+                              block_k=16, interpret=True)
+    # perturbing the FUTURE must not change past outputs
+    k2 = k.at[:, T // 2:].set(0.0)
+    v2 = v.at[:, T // 2:].set(9.0)
+    pert = pk.flash_attention(q, k2, v2, causal=True, block_q=16,
+                              block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(base[:, :T // 2]),
+                               np.asarray(pert[:, :T // 2]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_lstm_cell_matches_reference():
+    rng = np.random.RandomState(2)
+    B, H = 4, 8
+    xg = jnp.asarray(rng.randn(B, 4 * H).astype('float32'))
+    r = jnp.asarray(rng.randn(B, H).astype('float32'))
+    c = jnp.asarray(rng.randn(B, H).astype('float32'))
+    w = jnp.asarray((rng.randn(H, 4 * H) * 0.3).astype('float32'))
+    h_ref, c_ref = pk._lstm_cell_reference(xg, r, c, w)
+    h_out, c_out = pk.fused_lstm_cell(xg, r, c, w, interpret=True)
+    np.testing.assert_allclose(np.asarray(h_out), np.asarray(h_ref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(c_out), np.asarray(c_ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flash_attention_is_differentiable():
+    import jax
+    rng = np.random.RandomState(3)
+    B, T, H, D = 1, 32, 2, 8
+    q = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal=True,
+                                          block_q=16, block_k=16,
+                                          interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(pk.attention_reference(q, k, v, causal=True) ** 2)
+
+    g_pallas = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pallas, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_fused_lstm_cell_is_differentiable():
+    import jax
+    rng = np.random.RandomState(4)
+    B, H = 2, 4
+    xg = jnp.asarray(rng.randn(B, 4 * H).astype('float32'))
+    r = jnp.asarray(rng.randn(B, H).astype('float32'))
+    c = jnp.asarray(rng.randn(B, H).astype('float32'))
+    w = jnp.asarray((rng.randn(H, 4 * H) * 0.3).astype('float32'))
+
+    def loss_pallas(xg, r, c, w):
+        h, cn = pk.fused_lstm_cell(xg, r, c, w, interpret=True)
+        return jnp.sum(h * cn)
+
+    def loss_ref(xg, r, c, w):
+        h, cn = pk._lstm_cell_reference(xg, r, c, w)
+        return jnp.sum(h * cn)
+
+    g_p = jax.grad(loss_pallas, argnums=(0, 1, 2, 3))(xg, r, c, w)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(xg, r, c, w)
+    for a, b in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
