@@ -1,0 +1,18 @@
+"""``paddle_tpu.v2`` — thin compat veneer for the legacy v2 surface.
+
+Parity scope (SURVEY.md §1.5 ruling): Fluid-era book/benchmark scripts
+import only the data pieces of v2 (``import paddle.v2 as paddle`` then
+``paddle.batch`` / ``paddle.reader`` / ``paddle.dataset``) plus a no-op
+``init``. The v2 gserver/trainer stack itself is superseded by Fluid and
+is out of the rebuild's surface (ref: python/paddle/v2/__init__.py).
+"""
+from ..reader import batch  # noqa
+from .. import reader  # noqa
+from .. import dataset  # noqa
+
+
+def init(**kwargs):
+    """No-op (ref v2.init configured the legacy C++ trainer; the XLA
+    runtime needs no global init). Accepts and ignores use_gpu/
+    trainer_count/... keywords."""
+    return None
