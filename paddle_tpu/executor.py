@@ -117,6 +117,14 @@ class Executor(object):
         for name, val in feed.items():
             var = block._find_var_recursive(name)
             if isinstance(val, SequenceTensor):
+                if isinstance(val.data, jax.Array):
+                    # Device-resident sequence feed: no host round-trip.
+                    dt = runtime_dtype(var.dtype if var else val.data.dtype)
+                    data = val.data if str(val.data.dtype) == dt \
+                        else val.data.astype(dt)
+                    out[name] = SequenceTensor(data, val.lengths,
+                                               val.sub_lengths)
+                    continue
                 data = np.asarray(val.data)
                 dt = runtime_dtype(var.dtype if var else data.dtype)
                 out[name] = SequenceTensor(
