@@ -172,11 +172,13 @@ def main():
         record['resnet50'] = res
         peak = next((p for s, p in _PEAK_BF16
                      if s in (kind or '').lower()), None)
-        if on_tpu and peak:
-            # matmul/conv run bf16 on the MXU under AMP (core/amp.py,
-            # auto-on for TPU backends), so bf16 peak is the denominator
-            from paddle_tpu.core.amp import amp_enabled
-            record['amp_bf16'] = bool(amp_enabled())
+        # matmul/conv run bf16 on the MXU under AMP (core/amp.py,
+        # auto-on for TPU backends), so bf16 peak is the denominator;
+        # with AMP off the bf16 peak would be the wrong denominator, so
+        # only report MFU for the AMP path.
+        from paddle_tpu.core.amp import amp_enabled
+        record['amp_bf16'] = bool(on_tpu and amp_enabled())
+        if on_tpu and peak and record['amp_bf16']:
             record['resnet50_mfu_bf16_peak'] = round(
                 res['images_per_sec'] * RESNET_TRAIN_FLOPS_PER_IMG / peak,
                 4)

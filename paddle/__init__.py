@@ -1,0 +1,87 @@
+"""``paddle`` — top-level import shim over :mod:`paddle_tpu`.
+
+The north star (BASELINE.json) requires existing reference scripts to run
+unchanged except for the ``place =`` line: they do ``import paddle``,
+``import paddle.fluid as fluid``, ``import paddle.v2 as paddle`` and then
+use ``paddle.batch`` / ``paddle.reader`` / ``paddle.dataset``
+(ref: python/paddle/fluid/tests/book/test_fit_a_line.py:15-16).
+
+This package aliases the whole ``paddle_tpu`` tree under the ``paddle``
+name with a meta-path finder, so ``paddle.fluid`` *is*
+``paddle_tpu.fluid`` (same module object) and submodule imports like
+``import paddle.fluid.profiler`` or ``import paddle.dataset.mnist``
+resolve without enumerating anything here.
+"""
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+__version__ = '0.12.0+tpu'
+
+
+def _real_name(fullname):
+    """Map a ``paddle[...]`` module path to its paddle_tpu home.
+
+    paddle.fluid        -> paddle_tpu.fluid   (fluid.py facade module)
+    paddle.fluid.<sub>  -> paddle_tpu.<sub>   (framework, layers, io, ...)
+    paddle.v2           -> paddle_tpu.v2
+    paddle.v2.<sub>     -> paddle_tpu.<sub>   (dataset, reader)
+    paddle.<sub>        -> paddle_tpu.<sub>   (dataset, reader, ...)
+    """
+    rest = fullname[len('paddle.'):]
+    if rest == 'fluid':
+        return 'paddle_tpu.fluid'
+    if rest.startswith('fluid.'):
+        return 'paddle_tpu.' + rest[len('fluid.'):]
+    if rest == 'v2':
+        return 'paddle_tpu.v2'
+    if rest.startswith('v2.'):
+        return 'paddle_tpu.' + rest[len('v2.'):]
+    return 'paddle_tpu.' + rest
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, real):
+        self._real = real
+
+    def create_module(self, spec):
+        # Return the real module itself: ``paddle.fluid is
+        # paddle_tpu.fluid``, so state (default programs, scopes) is
+        # shared no matter which name a script imported.
+        return importlib.import_module(self._real)
+
+    def exec_module(self, module):
+        pass
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith('paddle.'):
+            return None
+        real = _real_name(fullname)
+        try:
+            found = importlib.util.find_spec(real) is not None
+        except (ImportError, ValueError):
+            found = False
+        if not found:
+            return None
+        spec = importlib.util.spec_from_loader(fullname,
+                                               _AliasLoader(real))
+        real_spec = importlib.util.find_spec(real)
+        # Mark alias packages as packages so ``import paddle.v2.dataset``
+        # style chains keep resolving through this finder.
+        if real_spec.submodule_search_locations is not None:
+            spec.submodule_search_locations = list(
+                real_spec.submodule_search_locations)
+        return spec
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())
+
+# Eager conveniences used as plain attributes by reference scripts:
+#   paddle.batch(reader, batch_size), paddle.reader.shuffle,
+#   paddle.dataset.mnist.train
+from paddle_tpu.reader import batch  # noqa: E402
+from paddle_tpu import reader, dataset  # noqa: E402

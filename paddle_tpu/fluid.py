@@ -3,9 +3,13 @@
 Reference scripts do `import paddle.fluid as fluid`; with paddle_tpu:
 `import paddle_tpu.fluid as fluid` (or `from paddle_tpu import fluid`).
 """
+# Empty __path__ makes this module import-package-like: submodule imports
+# (``import paddle.fluid.profiler``) get past the parent-__path__ check
+# and resolve through the ``paddle`` shim's meta-path alias finder.
+__path__ = []
 from . import (framework, layers, initializer, regularizer, clip, optimizer,  # noqa
                backward, unique_name, io, nets, metrics, evaluator, average,
-               profiler)
+               profiler, core)
 from .framework import (Program, Block, Variable, Operator,  # noqa
                         default_startup_program, default_main_program,
                         program_guard, switch_startup_program,
