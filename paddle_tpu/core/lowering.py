@@ -13,6 +13,8 @@ so the forward is traced exactly once; gradients bind to the reference's
 ``<param>@GRAD`` names and downstream ops (grad clip, regularizers, optimizer
 update ops) consume them as ordinary environment values.
 """
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
@@ -20,6 +22,40 @@ from .registry import get_kernel
 from ..framework import convert_np_dtype
 
 RNG_KEY = '__rng__'
+
+# Mesh for with_sharding_constraint on Variable.sharding-annotated values.
+# Set (only) by ParallelExecutor while tracing; the plain Executor lowers
+# identically but unconstrained.
+_SHARDING_MESH = [None]
+
+
+@contextlib.contextmanager
+def sharding_mesh(mesh):
+    prev = _SHARDING_MESH[0]
+    _SHARDING_MESH[0] = mesh
+    try:
+        yield
+    finally:
+        _SHARDING_MESH[0] = prev
+
+
+def _constrain(val, spec, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if not isinstance(val, jax.Array) or not getattr(val, 'ndim', 0):
+        return val
+    axes = set(mesh.axis_names)
+
+    def clean(entry):
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in axes)
+            return kept or None
+        return entry if entry in axes else None
+
+    spec = [clean(e) for e in spec][:val.ndim]
+    if all(e is None for e in spec):
+        return val
+    return jax.lax.with_sharding_constraint(
+        val, NamedSharding(mesh, P(*spec)))
 
 # JAX default (x64 disabled) canonicalizes these anyway; do it explicitly so
 # cache keys and feeds are stable. TPU has no fast f64/i64 path.
@@ -114,6 +150,13 @@ class BlockRunner(object):
                             name in env and _is_float(env[name]):
                         env[name] = jax.tree_util.tree_map(
                             jax.lax.stop_gradient, env[name])
+            mesh = _SHARDING_MESH[0]
+            if mesh is not None:
+                for name in op.output_arg_names:
+                    var = self.block._find_var_recursive(name)
+                    spec = getattr(var, 'sharding', None)
+                    if spec and name in env:
+                        env[name] = _constrain(env[name], spec, mesh)
         return env
 
 

@@ -92,6 +92,11 @@ class Optimizer(object):
         program, startup_program = self._target_programs()
         var = program.global_block().create_var(
             name=var_name, shape=shape, dtype=dtype, persistable=True)
+        if tuple(shape) == tuple(param.shape):
+            # moments live in the param's layout: a tp-sharded weight gets
+            # tp-sharded optimizer state (ZeRO over dp is layered on top
+            # by DistributeTranspiler.transpile(slice_var_up=True))
+            var.sharding = getattr(param, 'sharding', None)
         startup = startup_program.global_block()
         sv = startup.create_var(name=var_name, shape=shape, dtype=dtype,
                                 persistable=True)

@@ -40,6 +40,29 @@ class ParallelExecutor(object):
     def device_count(self):
         return int(np.prod(list(self._mesh.shape.values())))
 
+    def _var_sharding(self, name):
+        """NamedSharding for a state var: Variable.sharding (set via
+        ParamAttr(sharding=...) / set_sharding / the ZeRO transpiler) is
+        honored; axis names absent from this mesh degrade to replicated
+        on that dim. Default: replicated (reference semantics)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._mesh
+        var = self._program.global_block()._find_var_recursive(name)
+        spec = getattr(var, 'sharding', None) if var is not None else None
+        if not spec:
+            return NamedSharding(mesh, P())
+        axes = set(mesh.axis_names)
+
+        def clean(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in axes)
+                return kept or None
+            return entry if entry in axes else None
+
+        return NamedSharding(mesh, P(*[clean(e) for e in spec]))
+
     def _shardings(self, feed, state_names):
         from jax.sharding import NamedSharding, PartitionSpec as P
         mesh = self._mesh
@@ -55,7 +78,7 @@ class ParallelExecutor(object):
             return NamedSharding(mesh, P('dp'))
 
         feeds_s = {k: feed_shard(v) for k, v in feed.items()}
-        state_s = {n: repl for n in state_names}
+        state_s = {n: self._var_sharding(n) for n in state_names}
         return feeds_s, state_s, repl
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
@@ -78,13 +101,21 @@ class ParallelExecutor(object):
                tuple(fetch_names), tuple(state_in), tuple(state_out))
         jitted = self._cache.get(key)
         if jitted is None:
+            from ..core import lowering as _lowering
             fn = lower_block(program, program.global_block(),
                              sorted(feed.keys()), fetch_names, state_in,
                              state_out)
+
+            def fn_with_mesh(feeds, state, _fn=fn):
+                # activations with Variable.sharding get a
+                # with_sharding_constraint during tracing
+                with _lowering.sharding_mesh(self._mesh):
+                    return _fn(feeds, state)
+
             feeds_s, state_s, repl = self._shardings(feed, state_in)
-            out_state_s = {n: repl for n in state_out}
+            out_state_s = {n: self._var_sharding(n) for n in state_out}
             jitted = jax.jit(
-                fn, in_shardings=(feeds_s, state_s),
+                fn_with_mesh, in_shardings=(feeds_s, state_s),
                 out_shardings=(None, out_state_s),
                 donate_argnums=(1,))
             self._cache[key] = jitted

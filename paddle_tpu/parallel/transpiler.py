@@ -22,6 +22,20 @@ from ..framework import Program, default_main_program
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerSimple',
            'InferenceTranspiler', 'memory_optimize', 'release_memory']
 
+# Optimizer update ops -> their accumulator-state input slots.
+# (ref: the pserver held exactly these vars — its optimize blocks ran on
+# param slices, distribute_transpiler.py::_create_table_optimize_block)
+_OPTIM_STATE_SLOTS = {
+    'momentum': ('Velocity',),
+    'adam': ('Moment1', 'Moment2'),
+    'adamax': ('Moment', 'InfNorm'),
+    'adagrad': ('Moment',),
+    'decayed_adagrad': ('Moment',),
+    'adadelta': ('AvgSquaredGrad', 'AvgSquaredUpdate'),
+    'rmsprop': ('MeanSquare', 'Moment'),
+    'ftrl': ('SquaredAccumulator', 'LinearAccumulator'),
+}
+
 
 class DistributeTranspiler(object):
     def __init__(self):
@@ -30,6 +44,7 @@ class DistributeTranspiler(object):
         self.pserver_endpoints = []
         self.sync_mode = True
         self._program = None
+        self.sliced_vars = []
 
     def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
                   trainers=1, sync_mode=True, split_method=None,
@@ -48,7 +63,53 @@ class DistributeTranspiler(object):
             jax.distributed.initialize(
                 coordinator_address=self.pserver_endpoints[0],
                 num_processes=trainers, process_id=trainer_id)
+        if slice_var_up:
+            self._slice_optimizer_state()
         return self
+
+    def _dp_size(self):
+        """Shard count for ZeRO slicing: the dp extent of the active mesh
+        (single- or multi-process), falling back to the trainer count."""
+        from .mesh import _current_mesh
+        if _current_mesh is not None:
+            return int(dict(zip(_current_mesh.axis_names,
+                                _current_mesh.devices.shape)).get('dp', 1))
+        return max(self.trainers, 1)
+
+    def _slice_optimizer_state(self):
+        """ZeRO-style optimizer-state sharding — the TPU mapping of the
+        reference's param-slice-per-pserver layout.
+
+        The reference slices each parameter round-robin over pservers and
+        runs the optimizer remotely on the slice, so each host holds
+        1/N of the optimizer state (ref: python/paddle/fluid/transpiler/
+        distribute_transpiler.py::transpile, slice_var_up). Here the same
+        memory win comes from marking each accumulator Variable sharded
+        over the 'dp' mesh axis on dim 0: XLA SPMD keeps the moment
+        buffers resident as [N/dp, ...] shards, partitions the elementwise
+        update, and gathers only the param output (params stay replicated,
+        matching trainer semantics). Consumed by
+        ParallelExecutor._var_sharding.
+        """
+        dp = self._dp_size()
+        self.sliced_vars = []
+        if dp <= 1:
+            return
+        block = self._program.global_block()
+        for op in block.ops:
+            slots = _OPTIM_STATE_SLOTS.get(op.type)
+            if not slots:
+                continue
+            for slot in slots:
+                for name in op.inputs.get(slot, []):
+                    var = block._find_var_recursive(name)
+                    if var is None or var.sharding is not None:
+                        continue  # keep explicit (e.g. tp) shardings
+                    if len(var.shape) >= 1 and var.shape[0] % dp == 0 \
+                            and var.shape[0] >= dp:
+                        var.sharding = ('dp',)
+                        self.sliced_vars.append(name)
+        self._program._bump_version()
 
     def get_trainer_program(self):
         """The trainer program is the original program: gradient exchange
@@ -58,8 +119,9 @@ class DistributeTranspiler(object):
 
     def get_pserver_program(self, endpoint):
         """No parameter server exists on the TPU stack; optimizer state is
-        replicated (or ZeRO-sharded via sharding attrs). Returns an empty
-        heartbeat program so pserver launcher scripts stay functional."""
+        ZeRO-sharded across the dp axis instead (see
+        _slice_optimizer_state). Returns an empty heartbeat program so
+        pserver launcher scripts stay functional."""
         return Program()
 
     def get_startup_program(self, endpoint, pserver_program=None):

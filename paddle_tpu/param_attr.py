@@ -8,7 +8,7 @@ __all__ = ['ParamAttr', 'WeightNormParamAttr']
 class ParamAttr(object):
     def __init__(self, name=None, initializer=None, learning_rate=1.0,
                  regularizer=None, trainable=True, gradient_clip=None,
-                 do_model_average=None):
+                 do_model_average=None, sharding=None):
         self.name = name
         self.initializer = initializer
         self.learning_rate = learning_rate
@@ -16,6 +16,11 @@ class ParamAttr(object):
         self.trainable = trainable
         self.gradient_clip = gradient_clip
         self.do_model_average = do_model_average
+        # TPU extension: PartitionSpec-like tuple of mesh axis names per
+        # dim (e.g. (None, 'mp') to column-shard an fc weight). Consumed
+        # by ParallelExecutor in_shardings and the lowering's
+        # with_sharding_constraint pass.
+        self.sharding = tuple(sharding) if sharding is not None else None
 
     def set_default_initializer(self, initializer):
         if initializer is None:
@@ -61,6 +66,7 @@ class ParamAttr(object):
             'trainable': self.trainable,
             'gradient_clip_attr': self.gradient_clip,
             'do_model_average': self.do_model_average,
+            'sharding': self.sharding,
         }
         if with_initializer:
             kwargs['initializer'] = self.initializer
