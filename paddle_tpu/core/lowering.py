@@ -41,17 +41,10 @@ def sharding_mesh(mesh):
 
 def _constrain(val, spec, mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import clean_spec
     if not isinstance(val, jax.Array) or not getattr(val, 'ndim', 0):
         return val
-    axes = set(mesh.axis_names)
-
-    def clean(entry):
-        if isinstance(entry, (tuple, list)):
-            kept = tuple(a for a in entry if a in axes)
-            return kept or None
-        return entry if entry in axes else None
-
-    spec = [clean(e) for e in spec][:val.ndim]
+    spec = clean_spec(spec, mesh, ndim=val.ndim)
     if all(e is None for e in spec):
         return val
     return jax.lax.with_sharding_constraint(

@@ -9,6 +9,7 @@ learnable signal so convergence tests remain meaningful. The fallback is
 clearly marked via ``paddle_tpu.dataset.is_synthetic()``.
 """
 import os
+import zlib
 
 import numpy as np
 
@@ -23,7 +24,10 @@ def is_synthetic():
 
 
 def rng(name, salt=0):
-    return np.random.RandomState(abs(hash((name, salt))) % (2 ** 31))
+    # crc32, not hash(): str hash is salted per process, which would make
+    # the "deterministic" synthetic corpora differ run to run.
+    key = ('%s|%d' % (name, salt)).encode()
+    return np.random.RandomState(zlib.crc32(key) % (2 ** 31))
 
 
 def class_templates(name, num_classes, dim, scale=1.0):
@@ -38,7 +42,15 @@ def image_sampler(name, num_classes, chw, n, seed_salt=0, noise=0.35):
     class templates + noise."""
     c, h, w = chw
     dim = c * h * w
-    templates = class_templates(name, num_classes, dim, scale=0.8)
+    # Templates are keyed by the dataset FAMILY: mnist_train/mnist_test
+    # must draw from the same class prototypes or held-out accuracy is
+    # structurally stuck at chance.
+    family = name
+    for suffix in ('_train', '_test', '_valid'):
+        if family.endswith(suffix):
+            family = family[:-len(suffix)]
+            break
+    templates = class_templates(family, num_classes, dim, scale=0.8)
     # cheap low-pass: average pool the template noise to get blobs
     t = templates.reshape(num_classes, c, h, w)
     k = max(2, h // 7)

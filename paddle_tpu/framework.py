@@ -103,8 +103,9 @@ class Variable(object):
         return grad_var_name(self.name)
 
     def set_sharding(self, spec):
-        """Attach a PartitionSpec-like tuple (mesh axis names per dim)."""
-        self.sharding = tuple(spec)
+        """Attach a PartitionSpec-like tuple (mesh axis names per dim).
+        A bare string means dim 0 (like jax P('dp'))."""
+        self.sharding = (spec,) if isinstance(spec, str) else tuple(spec)
         return self
 
     def to_string(self, throw_on_error=False):
@@ -116,7 +117,7 @@ class Variable(object):
 
     def _desc(self):
         return (self.name, self.shape, self.dtype, self.lod_level,
-                self.persistable, self.stop_gradient)
+                self.persistable, self.stop_gradient, self.sharding)
 
 
 class Parameter(Variable):

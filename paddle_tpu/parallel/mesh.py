@@ -11,6 +11,25 @@ import numpy as np
 _current_mesh = None
 
 
+def clean_spec(spec, mesh, ndim=None):
+    """Sanitize a Variable.sharding tuple against a mesh: axis names not in
+    the mesh degrade to None (replicated on that dim); optionally truncate
+    to ndim. Shared by ParallelExecutor in_shardings and the lowering's
+    with_sharding_constraint pass so both interpret specs identically."""
+    axes = set(mesh.axis_names)
+
+    def clean(entry):
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in axes)
+            return kept or None
+        return entry if entry in axes else None
+
+    out = [clean(e) for e in spec]
+    if ndim is not None:
+        out = out[:ndim]
+    return out
+
+
 def set_mesh(mesh):
     global _current_mesh
     _current_mesh = mesh

@@ -46,22 +46,13 @@ class ParallelExecutor(object):
         honored; axis names absent from this mesh degrade to replicated
         on that dim. Default: replicated (reference semantics)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from .mesh import clean_spec
         mesh = self._mesh
         var = self._program.global_block()._find_var_recursive(name)
         spec = getattr(var, 'sharding', None) if var is not None else None
         if not spec:
             return NamedSharding(mesh, P())
-        axes = set(mesh.axis_names)
-
-        def clean(entry):
-            if entry is None:
-                return None
-            if isinstance(entry, (tuple, list)):
-                kept = tuple(a for a in entry if a in axes)
-                return kept or None
-            return entry if entry in axes else None
-
-        return NamedSharding(mesh, P(*[clean(e) for e in spec]))
+        return NamedSharding(mesh, P(*clean_spec(spec, mesh)))
 
     def _shardings(self, feed, state_names):
         from jax.sharding import NamedSharding, PartitionSpec as P

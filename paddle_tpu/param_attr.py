@@ -20,6 +20,8 @@ class ParamAttr(object):
         # dim (e.g. (None, 'mp') to column-shard an fc weight). Consumed
         # by ParallelExecutor in_shardings and the lowering's
         # with_sharding_constraint pass.
+        if isinstance(sharding, str):
+            sharding = (sharding,)  # P('dp')-style: axis name on dim 0
         self.sharding = tuple(sharding) if sharding is not None else None
 
     def set_default_initializer(self, initializer):
