@@ -90,7 +90,10 @@ class Variable(object):
         self.is_data = is_data
         self.type = type or 'lod_tensor'
         self.op = None           # defining op (set by append_op)
-        self.sharding = kwargs.get('sharding', None)  # PartitionSpec-like tuple
+        sharding = kwargs.get('sharding', None)  # PartitionSpec-like tuple
+        if isinstance(sharding, str):
+            sharding = (sharding,)   # P('dp')-style: axis name on dim 0
+        self.sharding = tuple(sharding) if sharding is not None else None
         self.error_clip = kwargs.get('error_clip', None)
 
     # ---- fluid-compatible sugar -------------------------------------------------
@@ -106,6 +109,9 @@ class Variable(object):
         """Attach a PartitionSpec-like tuple (mesh axis names per dim).
         A bare string means dim 0 (like jax P('dp'))."""
         self.sharding = (spec,) if isinstance(spec, str) else tuple(spec)
+        if self.block is not None:
+            # shardings are part of the compiled-step cache key
+            self.block.program._bump_version()
         return self
 
     def to_string(self, throw_on_error=False):

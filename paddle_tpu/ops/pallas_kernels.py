@@ -59,119 +59,358 @@ def attention_reference(q, k, v, causal=True, q_off=0, k_off=0):
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal):
-    """One (batch*head, q-block) program: stream k/v blocks, online softmax."""
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, block_q, block_k, causal, n_kb):
+    """One (batch*head, q-block, k-block) grid step.
+
+    The k-block index is the innermost grid dim, so Mosaic streams k/v
+    blocks HBM->VMEM with automatic double-buffering while the online
+    softmax state (m, l, acc) persists in VMEM scratch across steps.
+    No dynamic_slice on values anywhere — Mosaic can't lower it; all
+    block movement is done by the BlockSpec index maps.
+    """
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)              # [block_q, D]
-    d = q.shape[-1]
-    scale = 1.0 / math.sqrt(d)
-    t_k = k_ref.shape[1]
-    n_kb = t_k // block_k
+    kb = pl.program_id(2)
 
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def body(kb, carry):
-        o, m, l = carry
-        k_blk = jax.lax.dynamic_slice_in_dim(
-            k_ref[0], kb * block_k, block_k, axis=0).astype(jnp.float32)
-        v_blk = jax.lax.dynamic_slice_in_dim(
-            v_ref[0], kb * block_k, block_k, axis=0).astype(jnp.float32)
+    # Causal: k blocks strictly above the diagonal contribute nothing.
+    live = (kb * block_k <= (qi + 1) * block_q - 1) if causal else \
+        (kb >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)          # [block_k, D]
+        v = v_ref[0].astype(jnp.float32)
+        scale = 1.0 / math.sqrt(q.shape[-1])
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
         if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + jnp.sum(p, axis=-1)
+        m_prev = m_scr[:, :1]                     # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        o_new = o * alpha[:, None] + pv
-        return o_new, m_new, l_new
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    o0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
     if causal:
-        # only k blocks at or before this q block contribute
-        n_live = (jnp.minimum((qi + 1) * block_q, t_k)
-                  + block_k - 1) // block_k
+        last_kb = jnp.minimum(n_kb - 1, ((qi + 1) * block_q - 1) // block_k)
     else:
-        n_live = n_kb
-    o, m, l = jax.lax.fori_loop(0, n_live, body, (o0, m0, l0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        last_kb = n_kb - 1
+
+    @pl.when(kb == last_kb)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # logsumexp row stats, saved for the blockwise backward
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
+
+
+def _kb_clamp(causal, block_q, block_k, n_kb):
+    """k-block index map for causal kernels: dead (fully-masked) grid
+    steps re-reference the last live block, so Pallas skips their HBM
+    DMA entirely (an index map that repeats the previous indices is a
+    no-op fetch)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def imap(b, i, j):
+        last = jnp.minimum(n_kb - 1, ((i + 1) * block_q - 1) // block_k)
+        return (b, jnp.minimum(j, last), 0)
+    return imap
+
+
+def _qi_clamp(causal, block_q, block_k):
+    """q-block index map for the dk/dv pass: steps before the diagonal
+    re-reference the first live q block (no-op DMA)."""
+    if not causal:
+        return lambda b, j, i: (b, i, 0)
+
+    def imap(b, j, i):
+        first = (j * block_k) // block_q
+        return (b, jnp.maximum(i, first), 0)
+    return imap
 
 
 def _flash_pallas_call(q, k, v, causal, block_q, block_k, interpret):
-    """Raw Pallas forward on [B, T, H, D]."""
-    B, T, H, D = q.shape
-    qn = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    kn = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    vn = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    on = pl.pallas_call(
+    """Raw Pallas forward on [BH, T, D] -> (out, lse [BH, T, 1])."""
+    BH, T, D = q.shape
+    n_kb = T // block_k
+    kb_map = _kb_clamp(causal, block_q, block_k, n_kb)
+    on, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal),
-        grid=(B * H, T // block_q),
+                          block_k=block_k, causal=causal, n_kb=n_kb),
+        grid=(BH, T // block_q, n_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), kb_map),
+            pl.BlockSpec((1, block_k, D), kb_map),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum l
+            pltpu.VMEM((block_q, D), jnp.float32),     # unnormalised acc
+        ],
         interpret=interpret,
-    )(qn, kn, vn)
-    return on.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    )(q, k, v)
+    return on, lse
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dq_scr, *, block_q, block_k, causal, n_kb):
+    """dq pass: one (bh, q-block, k-block) step; dq accumulates in VMEM."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (kb * block_k <= (qi + 1) * block_q - 1) if causal else (kb >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                          # [bq, 1]
+        delta = delta_ref[0]                      # [bq, 1]
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                      # normalised probs
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bq, bk]
+        ds = p * (dp - delta)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_scr, dv_scr, *,
+                      block_q, block_k, causal, n_qb):
+    """dk/dv pass: one (bh, k-block, q-block) step; q blocks stream
+    innermost, dk/dv accumulate in VMEM. All math stays q-major so no
+    in-kernel transposes are needed (dot_general contracts dim 0)."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = ((qi + 1) * block_q - 1 >= kb * block_k) if causal else (qi >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # p^T @ do and ds^T @ q via dim-0 contractions (no transposes)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
+                      interpret):
+    """Blockwise backward on [BH, T, D] operands: O(T) memory, never
+    materialises the [T, T] score matrix (ADVICE r1: the old backward
+    recomputed full attention through XLA)."""
+    BH, T, D = q.shape
+    n_qb = T // block_q
+    n_kb = T // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)       # [BH, T, 1]
+    kb_map = _kb_clamp(causal, block_q, block_k, n_kb)
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, n_kb=n_kb),
+        grid=(BH, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), kb_map),
+            pl.BlockSpec((1, block_k, D), kb_map),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    qi_map = _qi_clamp(causal, block_q, block_k)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, n_qb=n_qb),
+        grid=(BH, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), qi_map),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), qi_map),
+            pl.BlockSpec((1, block_q, 1), qi_map),
+            pl.BlockSpec((1, block_q, 1), qi_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _to_bh(x):
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _from_bh(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_pallas_call(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return (_flash_pallas_call(q, k, v, causal, block_q, block_k,
-                               interpret), (q, k, v))
+    B, T, H, D = q.shape
+    qn, kn, vn = _to_bh(q), _to_bh(k), _to_bh(v)
+    on, lse = _flash_pallas_call(qn, kn, vn, causal, block_q, block_k,
+                                 interpret)
+    return _from_bh(on, B, H), (qn, kn, vn, on, lse, B, H)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    # Flash-style backward: recompute attention through the XLA reference
-    # (identical math) and transpose it — no [T, T] tensor is saved
-    # between fwd and bwd, only q/k/v.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal), q, k, v)
-    return vjp(g)
+    # Blockwise Pallas backward: O(T) memory, recomputes p from the saved
+    # logsumexp rather than materialising [T, T] (ADVICE r1).
+    qn, kn, vn, on, lse, B, H = res
+    dq, dk, dv = _flash_bwd_pallas(qn, kn, vn, on, lse, _to_bh(g),
+                                   causal, block_q, block_k, interpret)
+    return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+def _pick_block(T, target):
+    """Largest multiple of 128 that is <= target and divides T."""
+    b = min(target, T)
+    b -= b % 128
+    while b >= 128:
+        if T % b == 0:
+            return b
+        b -= 128
+    return None
+
+
+# Below this seq len the XLA attention wins on TPU. Measured on v5e
+# (fwd+bwd train step): pallas 1.26x at T=512, 1.39x at T=2048, 2.0x at
+# T=4096; fwd-only loses below T=1024 but the blockwise backward more
+# than makes up for it.
+_FLASH_MIN_T = 512
+
+
+def flash_attention(q, k, v, causal=True, block_q=512, block_k=256,
                     interpret=None):
     """Blockwise attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
 
-    Forward uses the Pallas kernel on TPU (or when ``interpret=True``);
-    backward recomputes through the XLA reference via custom_vjp, so the
-    training step differentiates cleanly. Off-TPU / non-block-aligned
-    shapes take the reference path outright.
+    Forward and backward both run as Pallas kernels on TPU (or under
+    ``interpret=True``): the forward saves per-row logsumexp and the
+    backward streams k/v (dq pass) and q (dk/dv pass) blocks, so memory
+    stays O(T) end to end. Off-TPU, for short sequences where XLA wins,
+    or for non-128-aligned shapes, the identical-math XLA reference runs
+    instead.
     """
     T = q.shape[1]
     if interpret is None:
         interpret = False
-    use_pallas = _HAS_PALLAS and (interpret or _on_tpu())
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
+    use_pallas = _HAS_PALLAS and (interpret or
+                                  (_on_tpu() and T >= _FLASH_MIN_T))
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(T, block_k)
+    if bq is None or bk is None:
         use_pallas = False
     if not use_pallas:
         return attention_reference(q, k, v, causal)
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, bq, bk, interpret)
 
 
 # ---- fused LSTM cell ------------------------------------------------------------
@@ -195,10 +434,11 @@ def _lstm_cell_kernel(xg_ref, r_ref, c_ref, w_ref, h_out, c_out):
     g = xg + jax.lax.dot_general(r, w, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
     hdim = c_prev.shape[-1]
-    gc = jax.lax.dynamic_slice_in_dim(g, 0, hdim, axis=1)
-    gi = jax.lax.dynamic_slice_in_dim(g, hdim, hdim, axis=1)
-    gf = jax.lax.dynamic_slice_in_dim(g, 2 * hdim, hdim, axis=1)
-    go = jax.lax.dynamic_slice_in_dim(g, 3 * hdim, hdim, axis=1)
+    # static slices (Mosaic has no dynamic_slice lowering)
+    gc = g[:, 0:hdim]
+    gi = g[:, hdim:2 * hdim]
+    gf = g[:, 2 * hdim:3 * hdim]
+    go = g[:, 3 * hdim:4 * hdim]
     i = jax.nn.sigmoid(gi)
     f = jax.nn.sigmoid(gf)
     c = jnp.tanh(gc) * i + c_prev * f
@@ -245,6 +485,12 @@ def fused_lstm_cell(xg, r_prev, c_prev, w, interpret=None):
     if interpret is None:
         interpret = False
     use_pallas = _HAS_PALLAS and (interpret or _on_tpu())
+    # Whole-array kernel: everything must fit VMEM (~16MB). The weight
+    # dominates; past ~10MB of f32 operands Mosaic compilation fails.
+    B, H = c_prev.shape
+    vmem_bytes = 4 * (w.size + xg.size + 3 * B * H + 2 * B * H)
+    if vmem_bytes > 10 * 1024 * 1024:
+        use_pallas = False
     if not use_pallas:
         return _lstm_cell_reference(xg, r_prev, c_prev, w)
     return _lstm_cell(xg, r_prev, c_prev, w, interpret)
