@@ -128,6 +128,8 @@ class BlockRunner(object):
         self.grad_mode = grad_mode
 
     def run_ops(self, ops, env):
+        from ..debugging import nan_checks_enabled
+        guard = nan_checks_enabled()
         for op in ops:
             kernel = get_kernel(op.type)
             try:
@@ -136,6 +138,8 @@ class BlockRunner(object):
                 raise type(e)(
                     "while lowering op %r (%s -> %s): %s" %
                     (op.type, op.inputs, op.outputs, e)) from e
+            if guard:
+                _check_outputs(op, env)
             if self.grad_mode:
                 for name in op.output_arg_names:
                     var = self.block._find_var_recursive(name)
@@ -157,6 +161,24 @@ def _is_float(val):
     leaves = jax.tree_util.tree_leaves(val)
     return any(jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
                for l in leaves)
+
+
+def _check_outputs(op, env):
+    """Debug-mode NaN/Inf guard: one checkify.check per float output,
+    carrying op provenance (type, output, inputs) in the message."""
+    from jax.experimental import checkify
+    for name in op.output_arg_names:
+        if name not in env:
+            continue
+        for leaf in jax.tree_util.tree_leaves(env[name]):
+            arr = jnp.asarray(leaf)
+            if not jnp.issubdtype(arr.dtype, jnp.floating):
+                continue
+            checkify.check(
+                jnp.isfinite(arr.astype(jnp.float32)).all(),
+                "NaN/Inf detected in output '%s' of op '%s' "
+                "(inputs: %s)" % (name, op.type,
+                                  sorted(op.input_arg_names)))
 
 
 def _find_marker(ops):

@@ -1,0 +1,42 @@
+"""Failure detection: debug-mode NaN/Inf guard.
+
+Parity: paddle/fluid/platform/enforce.h + the FLAGS_check_nan_inf
+per-op tensor checks (operators run under CheckNanInf when the flag is
+set). TPU design: checks must live INSIDE the compiled step — there is
+no per-op host boundary to hook — so when the guard is enabled the
+lowering inserts a ``checkify.check`` after every float-producing op
+(errors carry the op type, output name and input names), the Executor
+compiles the step through ``checkify.checkify``, and the functionalized
+error is re-raised on the host with that provenance.
+
+Enable with ``fluid.check_nan_inf(True)``, the ``check_nan_inf()``
+context manager, or ``PADDLE_TPU_CHECK_NAN_INF=1``.
+"""
+import contextlib
+import os
+
+__all__ = ['check_nan_inf', 'nan_checks_enabled', 'nan_guard']
+
+_CHECK = [os.environ.get('PADDLE_TPU_CHECK_NAN_INF', '0') == '1']
+
+
+def check_nan_inf(enable=True):
+    """Globally enable/disable the per-op NaN/Inf guard (debug mode:
+    steps recompile with checks and run slower)."""
+    prev = _CHECK[0]
+    _CHECK[0] = bool(enable)
+    return prev
+
+
+def nan_checks_enabled():
+    return _CHECK[0]
+
+
+@contextlib.contextmanager
+def nan_guard():
+    """Context manager form: NaN/Inf checks enabled inside the block."""
+    prev = check_nan_inf(True)
+    try:
+        yield
+    finally:
+        check_nan_inf(prev)

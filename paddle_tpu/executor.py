@@ -206,17 +206,26 @@ class Executor(object):
         state_in_names = sorted(set(state_in_names) | {RNG_KEY})
         state_out_names = sorted(set(state_out_names) | {RNG_KEY})
 
+        from .debugging import nan_checks_enabled
+        guard = nan_checks_enabled()
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
                tuple(fetch_names), tuple(state_in_names),
-               tuple(state_out_names))
+               tuple(state_out_names), guard)
         entry = self._cache.get(key)
         if entry is None:
             lower_prog = self._maybe_prune(program, fetch_names)
             fn = lower_block(lower_prog, lower_prog.global_block(),
                              sorted(feed.keys()), fetch_names,
                              state_in_names, state_out_names)
-            jitted = jax.jit(fn, donate_argnums=(1,))
+            if guard:
+                # Debug mode: functionalize the per-op NaN/Inf checks.
+                # No donation — on a thrown error the scope must still
+                # hold live (pre-step) state buffers.
+                from jax.experimental import checkify
+                jitted = jax.jit(checkify.checkify(fn))
+            else:
+                jitted = jax.jit(fn, donate_argnums=(1,))
             self._cache[key] = jitted
         else:
             jitted = entry
@@ -224,7 +233,11 @@ class Executor(object):
         state = {n: scope.find_var(n) for n in state_in_names}
 
         with jax.default_device(self.place.jax_device()):
-            fetches, new_state = jitted(feed, state)
+            if guard:
+                err, (fetches, new_state) = jitted(feed, state)
+                err.throw()
+            else:
+                fetches, new_state = jitted(feed, state)
         for n, v in new_state.items():
             scope.set_var(n, v)
         if return_numpy:

@@ -35,6 +35,10 @@ from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,  # noqa
 from .inferencer import Inferencer  # noqa
 from . import debugger  # noqa
 from . import debugger as debuger  # noqa
+from . import memory  # noqa
+from .memory import (memory_stats, memory_allocated,  # noqa
+                     max_memory_allocated, HostArena)
+from .debugging import check_nan_inf, nan_guard, nan_checks_enabled  # noqa
 from . import graphviz  # noqa
 from . import net_drawer  # noqa
 from . import concurrency  # noqa

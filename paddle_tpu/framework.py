@@ -90,10 +90,8 @@ class Variable(object):
         self.is_data = is_data
         self.type = type or 'lod_tensor'
         self.op = None           # defining op (set by append_op)
-        sharding = kwargs.get('sharding', None)  # PartitionSpec-like tuple
-        if isinstance(sharding, str):
-            sharding = (sharding,)   # P('dp')-style: axis name on dim 0
-        self.sharding = tuple(sharding) if sharding is not None else None
+        self._sharding = None
+        self.sharding = kwargs.get('sharding', None)  # PartitionSpec tuple
         self.error_clip = kwargs.get('error_clip', None)
 
     # ---- fluid-compatible sugar -------------------------------------------------
@@ -105,13 +103,28 @@ class Variable(object):
     def grad_name(self):
         return grad_var_name(self.name)
 
+    @property
+    def sharding(self):
+        return self._sharding
+
+    @sharding.setter
+    def sharding(self, spec):
+        """Every writer (ParamAttr plumbing, transpilers, user code) goes
+        through here: bare strings normalize to dim-0 specs and the
+        program version bumps so compiled-step caches are invalidated
+        (shardings are part of the fingerprint)."""
+        if isinstance(spec, str):
+            spec = (spec,)           # P('dp')-style: axis name on dim 0
+        spec = tuple(spec) if spec is not None else None
+        changed = spec != self._sharding
+        self._sharding = spec
+        if changed and self.block is not None:
+            self.block.program._bump_version()
+
     def set_sharding(self, spec):
         """Attach a PartitionSpec-like tuple (mesh axis names per dim).
         A bare string means dim 0 (like jax P('dp'))."""
-        self.sharding = (spec,) if isinstance(spec, str) else tuple(spec)
-        if self.block is not None:
-            # shardings are part of the compiled-step cache key
-            self.block.program._bump_version()
+        self.sharding = spec
         return self
 
     def to_string(self, throw_on_error=False):

@@ -29,9 +29,11 @@ def _load():
             return _LIB
         _BUILD_TRIED = True
         try:
+            srcs = [os.path.join(_HERE, f) for f in os.listdir(_HERE)
+                    if f.endswith('.cc')] + [os.path.join(_HERE, 'Makefile')]
             if not os.path.exists(_LIB_PATH) or (
                     os.path.getmtime(_LIB_PATH) <
-                    os.path.getmtime(os.path.join(_HERE, 'recordio.cc'))):
+                    max(os.path.getmtime(s) for s in srcs)):
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
         except Exception:

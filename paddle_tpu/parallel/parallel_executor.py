@@ -87,9 +87,12 @@ class ParallelExecutor(object):
         state_out = sorted(set(state_out) | {RNG_KEY})
 
         from ..executor import _spec
+        from ..debugging import nan_checks_enabled
+        guard = nan_checks_enabled()
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
-               tuple(fetch_names), tuple(state_in), tuple(state_out))
+               tuple(fetch_names), tuple(state_in), tuple(state_out),
+               guard)
         jitted = self._cache.get(key)
         if jitted is None:
             from ..core import lowering as _lowering
@@ -105,15 +108,27 @@ class ParallelExecutor(object):
 
             feeds_s, state_s, repl = self._shardings(feed, state_in)
             out_state_s = {n: self._var_sharding(n) for n in state_out}
-            jitted = jax.jit(
-                fn_with_mesh, in_shardings=(feeds_s, state_s),
-                out_shardings=(None, out_state_s),
-                donate_argnums=(1,))
+            if guard:
+                # debug mode: functionalize per-op NaN/Inf checks; no
+                # donation so state survives a thrown error
+                from jax.experimental import checkify
+                jitted = jax.jit(
+                    checkify.checkify(fn_with_mesh),
+                    in_shardings=(feeds_s, state_s))
+            else:
+                jitted = jax.jit(
+                    fn_with_mesh, in_shardings=(feeds_s, state_s),
+                    out_shardings=(None, out_state_s),
+                    donate_argnums=(1,))
             self._cache[key] = jitted
 
         state = {n: scope.find_var(n) for n in state_in}
         with self._mesh:
-            fetches, new_state = jitted(feed, state)
+            if guard:
+                err, (fetches, new_state) = jitted(feed, state)
+                err.throw()
+            else:
+                fetches, new_state = jitted(feed, state)
         for n, v in new_state.items():
             scope.set_var(n, v)
         if return_numpy:

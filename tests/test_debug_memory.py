@@ -1,0 +1,133 @@
+"""Failure detection (NaN/Inf guard with op provenance) + memory
+introspection (SURVEY.md §2.7; VERDICT r1 missing #7).
+
+Parity intent: paddle/fluid/platform/enforce.h (FLAGS_check_nan_inf) and
+paddle/fluid/memory/memory.h.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _build_div_program():
+    """y = mean(x / d): feeding d=0 makes elementwise_div produce inf."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        d = fluid.layers.data(name='d', shape=[4], dtype='float32')
+        out = fluid.layers.elementwise_div(x, d)
+        loss = fluid.layers.mean(out)
+    return main, startup, loss
+
+
+def test_nan_guard_names_producing_op():
+    main, startup, loss = _build_div_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xs = np.ones((2, 4), np.float32)
+        bad = np.zeros((2, 4), np.float32)
+        with fluid.nan_guard():
+            with pytest.raises(Exception) as ei:
+                exe.run(main, feed={'x': xs, 'd': bad},
+                        fetch_list=[loss])
+        msg = str(ei.value)
+        assert 'NaN/Inf' in msg
+        assert 'elementwise_div' in msg  # op provenance
+
+
+def test_nan_guard_passes_clean_runs_and_restores_state():
+    main, startup, loss = _build_div_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xs = np.ones((2, 4), np.float32)
+        ds = np.full((2, 4), 2.0, np.float32)
+        with fluid.nan_guard():
+            out = exe.run(main, feed={'x': xs, 'd': ds},
+                          fetch_list=[loss])[0]
+        assert abs(float(np.asarray(out).mean()) - 0.5) < 1e-6
+        # guard off again outside the context; uncached path still works
+        out = exe.run(main, feed={'x': xs, 'd': ds}, fetch_list=[loss])[0]
+        assert abs(float(np.asarray(out).mean()) - 0.5) < 1e-6
+
+
+def test_nan_guard_training_step_grad_overflow():
+    """exp of a huge value overflows in the backward-bearing program."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.fc(input=x, size=4)
+        loss = fluid.layers.mean(fluid.layers.exp(h * 200.0))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xs = np.full((2, 4), 50.0, np.float32)
+        with fluid.nan_guard():
+            with pytest.raises(Exception) as ei:
+                exe.run(main, feed={'x': xs}, fetch_list=[loss])
+        assert 'NaN/Inf' in str(ei.value)
+
+
+def test_memory_stats_shape():
+    stats = fluid.memory_stats(fluid.CPUPlace())
+    assert isinstance(stats, dict)
+    assert 'bytes_in_use' in stats
+    assert fluid.memory_allocated(fluid.CPUPlace()) >= 0
+    assert fluid.max_memory_allocated(fluid.CPUPlace()) >= 0
+
+
+def test_host_arena_alloc_reset_stats():
+    arena = fluid.HostArena(chunk_bytes=1 << 20)
+    a = arena.alloc((128, 128), 'float32')
+    a[:] = 3.0
+    b = arena.alloc((64,), 'int64')
+    b[:] = 7
+    assert float(a.sum()) == 3.0 * 128 * 128
+    assert int(b.sum()) == 7 * 64
+    st = arena.stats()
+    if arena.native:
+        assert st['allocated'] >= 128 * 128 * 4 + 64 * 8
+        assert st['capacity'] >= st['allocated']
+        # growth: an allocation bigger than the chunk adds a chunk
+        big = arena.alloc((1 << 19,), 'float32')   # 2MB > 1MB chunk
+        big[:] = 1.0
+        assert arena.stats()['chunks'] >= 2
+        arena.reset()
+        assert arena.stats()['allocated'] == 0
+    arena.close()
+
+
+def test_nan_guard_parallel_executor():
+    """Guard also functionalizes through the mesh-sharded path."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel.mesh import set_mesh
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs multi-device mesh")
+    mesh = Mesh(np.asarray(devs[:2]).reshape(2,), ('dp',))
+    main, startup, loss = _build_div_program()
+    set_mesh(mesh)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            pexe = fluid.ParallelExecutor(use_cuda=False,
+                                          loss_name=loss.name,
+                                          main_program=main, mesh=mesh)
+            xs = np.ones((4, 4), np.float32)
+            with fluid.nan_guard():
+                ok = pexe.run([loss], feed={'x': xs,
+                                            'd': xs * 2.0})[0]
+                assert abs(float(np.asarray(ok).mean()) - 0.5) < 1e-6
+                with pytest.raises(Exception) as ei:
+                    pexe.run([loss], feed={'x': xs,
+                                           'd': np.zeros_like(xs)})
+            assert 'NaN/Inf' in str(ei.value)
+            assert 'elementwise_div' in str(ei.value)
+    finally:
+        set_mesh(None)
