@@ -116,22 +116,51 @@ class EditDistance(Evaluator):
 
 
 class DetectionMAP(Evaluator):
+    """mAP over the evaluation stream.
+
+    Per-batch MAP comes from the in-XLA detection_map kernel; the
+    cross-batch Accum* LoD state of the reference op
+    (paddle/fluid/operators/detection_map_op.h GetInputPos/GetOutputPos)
+    maps to a host-side DetectionMAPState (ops/detection_map_ref.py):
+    call update_state(detections, labels) with per-image rows after each
+    eval batch, then eval() for the exact accumulated mAP.
+    """
+
     def __init__(self, input, gt_label, gt_box, class_num,
                  background_label=0, overlap_threshold=0.5,
                  evaluate_difficult=True, ap_version='integral'):
         super(DetectionMAP, self).__init__("map_eval")
+        from .ops.detection_map_ref import DetectionMAPState
         label = layers.concat([gt_label, gt_box], axis=1)
-        map_out = layers.detection_map(input, label, class_num,
-                                       ap_version=ap_version)
+        map_out = layers.detection_map(
+            input, label, class_num, background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version)
         self.cur_map = map_out
         self.accum_map = self.create_state(
             dtype='float32', shape=[1], suffix='accum_map')
         layers.sums(input=[self.accum_map, map_out], out=self.accum_map)
+        self._state = DetectionMAPState(
+            overlap_threshold, evaluate_difficult, ap_version,
+            class_num, background_label)
+        self._host_mode = False
 
     def get_map_var(self):
         return self.cur_map, self.accum_map
 
+    def update_state(self, detections, labels):
+        """Accumulate one evaluated batch (lists of per-image arrays:
+        detections [D_i, 6], labels [G_i, 5|6])."""
+        self._host_mode = True
+        self._state.update(detections, labels)
+
+    def reset(self, executor, reset_program=None):
+        self._state.reset()
+        return super(DetectionMAP, self).reset(executor, reset_program)
+
     def eval(self, executor, eval_program=None):
+        if self._host_mode:
+            return np.array([self._state.value()], np.float32)
         from .executor import global_scope, as_numpy
         return np.asarray(as_numpy(global_scope().find_var(
             self.accum_map.name)))

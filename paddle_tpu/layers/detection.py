@@ -227,5 +227,6 @@ def detection_map(detect_res, label, class_num, background_label=0,
         outputs={'MAP': map_out},
         attrs={'overlap_threshold': overlap_threshold,
                'evaluate_difficult': evaluate_difficult,
-               'ap_type': ap_version, 'class_num': class_num})
+               'ap_type': ap_version, 'class_num': class_num,
+               'background_label': background_label})
     return map_out

@@ -85,6 +85,7 @@ class HostArena(object):
         from .native.loader import _load
         self._lib = _load()
         self._handle = None
+        self._views = {}   # id(view) -> weakref (ndarray isn't hashable)
         if self._lib is not None:
             try:
                 self._lib.arena_create.restype = ctypes.c_void_p
@@ -121,6 +122,10 @@ class HostArena(object):
         arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
         arr = arr.view(_ArenaArray)
         arr._arena_ref = self   # views pin the arena's pages alive
+        import weakref
+        key = id(arr)
+        self._views[key] = weakref.ref(
+            arr, lambda _r, k=key, v=self._views: v.pop(k, None))
         return arr
 
     def reset(self):
@@ -144,11 +149,22 @@ class HostArena(object):
                 'pinned': bool(pinned.value), 'native': True}
 
     def close(self):
-        if self._handle is not None:
-            self._lib.arena_destroy(self._handle)
-            self._handle = None
+        """Unmap the arena. Refuses while alloc()'d views are alive —
+        a munmap under an outstanding view would be a segfault, not an
+        exception."""
+        if self._handle is None:
+            return
+        if len(self._views):
+            raise RuntimeError(
+                "HostArena.close(): %d allocated view(s) still alive; "
+                "drop them (or let them be garbage-collected) first"
+                % len(self._views))
+        self._lib.arena_destroy(self._handle)
+        self._handle = None
 
     def __del__(self):
+        # GC only runs this when no view holds _arena_ref, so the
+        # outstanding-views check cannot fire spuriously here.
         try:
             self.close()
         except Exception:

@@ -377,68 +377,140 @@ def _ssd_loss_fused(ctx):
 # ---- detection mAP --------------------------------------------------------------
 @register_kernel('detection_map')
 def _detection_map(ctx):
-    """Simplified single-batch mAP (integral AP). DetectRes [D, 6]
-    (label, score, box), Label [G, 5+] (label, box, ...). Invalid rows
-    have label < 0. Parity (simplified — no difficult handling, one
-    image set per call): paddle/fluid/operators/detection_map_op.h."""
-    det = unwrap(ctx.input('DetectRes'))
-    gt = unwrap(ctx.input('Label'))
+    """Full-semantics mAP in one XLA program (static shapes).
+
+    Parity: paddle/fluid/operators/detection_map_op.h — per-image
+    per-class greedy matching by MAX IoU of CLIPPED det boxes (strict
+    > threshold), visited-gt double matches are false positives,
+    difficult gts (6-col labels, evaluate_difficult=False) contribute
+    neither tp nor fp, 'integral' and '11point' AP, and the reference's
+    class-participation rules (a class counts iff it has detections and
+    pos_count != background_label). Cross-batch accumulation (the Accum*
+    LoD state) lives host-side in ops/detection_map_ref.py, used by
+    evaluator.DetectionMAP.
+
+    Shapes: DetectRes [D, 6] / [B, D, 6] / SequenceTensor rows
+    (label, score, xmin, ymin, xmax, ymax); Label [G, 5] (label, box) or
+    [G, 6] (label, is_difficult, box). Invalid (padding) rows have
+    label < 0.
+    """
+    from ..lod import SequenceTensor
+
+    def rows_and_ids(val):
+        if isinstance(val, SequenceTensor):
+            # padded layout [batch, padded_len, feat]; rows past each
+            # image's length get label -1 (invalid) like any padding
+            data = jnp.asarray(val.data)
+            lens = jnp.asarray(val.lengths).reshape(-1)
+            b, t, f = data.shape
+            pad = jnp.arange(t)[None, :] >= lens[:, None]
+            data = jnp.where(pad[..., None],
+                             data.at[..., 0].set(-1.0), data)
+            return data.reshape(b * t, f), jnp.repeat(jnp.arange(b), t)
+        if val.ndim == 3:
+            b, d = val.shape[0], val.shape[1]
+            return (val.reshape(b * d, val.shape[2]),
+                    jnp.repeat(jnp.arange(b), d))
+        return val, jnp.zeros((val.shape[0],), jnp.int32)
+
+    det, det_img = rows_and_ids(ctx.input('DetectRes'))
+    gt, gt_img = rows_and_ids(ctx.input('Label'))
     thr = float(ctx.attr('overlap_threshold', 0.3))
+    eval_diff = bool(ctx.attr('evaluate_difficult', True))
+    ap_type = ctx.attr('ap_type', 'integral')
     class_num = int(ctx.attr('class_num'))
-    if det.ndim == 3:
-        det = det.reshape(-1, det.shape[-1])
-    if gt.ndim == 3:
-        gt = gt.reshape(-1, gt.shape[-1])
-    gt_label = gt[:, 0]
-    gt_box = gt[:, 1:5]
-    d_label, d_score, d_box = det[:, 0], det[:, 1], det[:, 2:6]
+    background = int(ctx.attr('background_label', 0))
 
-    lt = jnp.maximum(d_box[:, None, :2], gt_box[None, :, :2])
-    rb = jnp.minimum(d_box[:, None, 2:], gt_box[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0)
-    inter = wh[..., 0] * wh[..., 1]
-    a1 = jnp.maximum(d_box[:, 2] - d_box[:, 0], 0) * \
-        jnp.maximum(d_box[:, 3] - d_box[:, 1], 0)
-    a2 = jnp.maximum(gt_box[:, 2] - gt_box[:, 0], 0) * \
-        jnp.maximum(gt_box[:, 3] - gt_box[:, 1], 0)
-    iou = inter / jnp.maximum(a1[:, None] + a2[None, :] - inter, 1e-10)
+    d_label = det[:, 0]
+    d_score = det[:, 1]
+    d_box = jnp.clip(det[:, 2:6], 0.0, 1.0)      # ClipBBox
+    g_label = gt[:, 0]
+    if gt.shape[1] >= 6:
+        g_diff = jnp.abs(gt[:, 1]) >= 1e-6
+        g_box = gt[:, 2:6]
+    else:
+        g_diff = jnp.zeros((gt.shape[0],), bool)
+        g_box = gt[:, 1:5]
+    valid_d = d_label >= 0
+    valid_g = g_label >= 0
 
-    aps = []
-    present = []
+    # Jaccard with the reference's disjoint early-out
+    lt = jnp.maximum(d_box[:, None, :2], g_box[None, :, :2])
+    rb = jnp.minimum(d_box[:, None, 2:], g_box[None, :, 2:])
+    disjoint = jnp.any(rb < d_box[:, None, :2], -1) | \
+        jnp.any(lt > d_box[:, None, 2:], -1)
+    inter = (rb[..., 0] - lt[..., 0]) * (rb[..., 1] - lt[..., 1])
+    a1 = (d_box[:, 2] - d_box[:, 0]) * (d_box[:, 3] - d_box[:, 1])
+    a2 = (g_box[:, 2] - g_box[:, 0]) * (g_box[:, 3] - g_box[:, 1])
+    iou = jnp.where(disjoint, 0.0,
+                    inter / jnp.maximum(a1[:, None] + a2[None, :] - inter,
+                                        1e-20))
+
+    cand = (det_img[:, None] == gt_img[None, :]) & \
+        (d_label[:, None] == g_label[None, :]) & \
+        valid_d[:, None] & valid_g[None, :]
+
+    nd, ng = det.shape[0], gt.shape[0]
+    order = jnp.argsort(jnp.where(valid_d, -d_score, jnp.inf),
+                        stable=True)
+
+    counted_g = valid_g & (eval_diff | ~g_diff)
+
+    def step(t, carry):
+        visited, tp, fp = carry
+        i = order[t]
+        ious = jnp.where(cand[i], iou[i], -1.0)
+        max_ov = jnp.max(ious, initial=-1.0)
+        max_idx = jnp.argmax(ious)
+        matched = max_ov > thr
+        evaluated = eval_diff | ~g_diff[max_idx]
+        is_tp = matched & evaluated & ~visited[max_idx] & valid_d[i]
+        # difficult match (not evaluated): neither tp nor fp
+        is_fp = valid_d[i] & (~matched | (matched & evaluated & \
+                                          visited[max_idx]))
+        visited = jnp.where(is_tp, visited.at[max_idx].set(True),
+                            visited)
+        tp = tp.at[i].set(is_tp)
+        fp = fp.at[i].set(is_fp)
+        return visited, tp, fp
+
+    visited0 = jnp.zeros((ng,), bool)
+    _, tp, fp = jax.lax.fori_loop(
+        0, nd, step, (visited0, jnp.zeros((nd,), bool),
+                      jnp.zeros((nd,), bool)))
+
+    tp_o = jnp.take(tp, order).astype(jnp.float32)
+    fp_o = jnp.take(fp, order).astype(jnp.float32)
+    label_o = jnp.take(d_label, order)
+    valid_o = jnp.take(valid_d, order)
+
+    aps, participates = [], []
     for c in range(class_num):
-        dmask = (d_label == c)
-        gmask = (gt_label == c)
-        n_gt = gmask.sum()
-        ok = (iou >= thr) & gmask[None, :]
-        order = jnp.argsort(-jnp.where(dmask, d_score, _NEG))
-
-        def step(i, carry):
-            used, tp = carry
-            di = order[i]
-            hits = ok[di] & ~used
-            hit = jnp.any(hits) & dmask[di]
-            first = jnp.argmax(hits)
-            used = jnp.where(hit, used.at[first].set(True), used)
-            tp = tp.at[i].set(hit)
-            return used, tp
-
-        used0 = jnp.zeros(gt_box.shape[0], bool)
-        tp0 = jnp.zeros(det.shape[0], bool)
-        _, tp = jax.lax.fori_loop(0, det.shape[0], step, (used0, tp0))
-        valid = jnp.take(dmask, order)
-        tp_c = jnp.cumsum(tp.astype(jnp.float32))
-        fp_c = jnp.cumsum((valid & ~tp).astype(jnp.float32))
-        recall = tp_c / jnp.maximum(n_gt, 1)
-        precision = tp_c / jnp.maximum(tp_c + fp_c, 1e-10)
-        # integral AP: sum precision deltas where recall increases
-        d_recall = jnp.diff(recall, prepend=0.0)
-        ap = jnp.sum(precision * d_recall)
+        npos = jnp.sum((g_label == c) & counted_g).astype(jnp.float32)
+        has_det = jnp.any(valid_d & (d_label == c))
+        mc = (label_o == c) & valid_o
+        cum_tp = jnp.cumsum(jnp.where(mc, tp_o, 0.0))
+        cum_fp = jnp.cumsum(jnp.where(mc, fp_o, 0.0))
+        contributing = mc & (tp_o + fp_o > 0)
+        precision = cum_tp / jnp.maximum(cum_tp + cum_fp, 1e-20)
+        recall = cum_tp / jnp.maximum(npos, 1.0)
+        if ap_type == '11point':
+            ap = jnp.float32(0.0)
+            for j in range(11):
+                m = contributing & (recall >= j / 10.0)
+                ap = ap + jnp.max(jnp.where(m, precision, 0.0),
+                                  initial=0.0) / 11.0
+        else:  # integral
+            prev = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
+            delta = jnp.abs(recall - prev)
+            ap = jnp.sum(jnp.where(contributing & (delta > 1e-6),
+                                   precision * delta, 0.0))
         aps.append(ap)
-        present.append((n_gt > 0).astype(jnp.float32))
+        participates.append((npos > 0) & (npos != background) & has_det)
     aps = jnp.stack(aps)
-    present = jnp.stack(present)
-    mAP = jnp.sum(aps * present) / jnp.maximum(jnp.sum(present), 1.0)
-    ctx.set_output('MAP', mAP.reshape(1))
+    part = jnp.stack(participates).astype(jnp.float32)
+    m_ap = jnp.sum(aps * part) / jnp.maximum(jnp.sum(part), 1.0)
+    ctx.set_output('MAP', m_ap.reshape(1))
 
 
 @register_kernel('polygon_box_transform')

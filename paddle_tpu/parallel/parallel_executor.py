@@ -114,7 +114,8 @@ class ParallelExecutor(object):
                 from jax.experimental import checkify
                 jitted = jax.jit(
                     checkify.checkify(fn_with_mesh),
-                    in_shardings=(feeds_s, state_s))
+                    in_shardings=(feeds_s, state_s),
+                    out_shardings=(None, (None, out_state_s)))
             else:
                 jitted = jax.jit(
                     fn_with_mesh, in_shardings=(feeds_s, state_s),

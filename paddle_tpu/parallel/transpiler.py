@@ -149,22 +149,87 @@ def release_memory(input_program, skip_opt_set=None):
 
 
 class InferenceTranspiler(object):
-    """Parity: inference_transpiler.py (conv+bn fold, relu fuse)."""
+    """Parity: inference_transpiler.py (conv+bn fold).
+
+    The reference rewrites conv weights in place so inference programs
+    drop their batch_norm ops entirely
+    (python/paddle/fluid/transpiler/inference_transpiler.py::
+    _fuse_conv_bn / _fuse_param). Same rewrite here, at the Program IR
+    level: for every conv2d whose single consumer is a batch_norm,
+
+        w' = w * scale / sqrt(var + eps)        (per output channel)
+        b' = bias - mean * scale / sqrt(var + eps)
+
+    the BN op is REMOVED and an elementwise_add(axis=1) with the new
+    bias takes over BN's output name. Remaining BN/dropout ops are
+    flipped to test mode.
+    """
 
     def transpile(self, program, place=None, scope=None):
-        self._fold_batch_norm(program)
+        from ..executor import global_scope
+        scope = scope or global_scope()
+        self._fuse_conv_bn(program, scope)
+        self._mark_test_mode(program)
         return program
 
-    def _fold_batch_norm(self, program):
-        """Mark BN ops as test-mode; actual folding of scale into conv
-        weights happens numerically at load time when weights are static.
-        XLA fuses the remaining scale/shift into the conv epilogue, which
-        achieves the same runtime effect as the reference's weight
-        rewrite."""
+    def _consumers(self, block, name):
+        return [op for op in block.ops
+                if name in op.input_arg_names]
+
+    def _fuse_conv_bn(self, program, scope):
+        import numpy as np
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in ('conv2d', 'depthwise_conv2d'):
+                i += 1
+                continue
+            out_name = op.outputs['Output'][0]
+            consumers = self._consumers(block, out_name)
+            if len(consumers) != 1 or consumers[0].type != 'batch_norm':
+                i += 1
+                continue
+            bn = consumers[0]
+            w_name = op.inputs['Filter'][0]
+            vals = {}
+            ok = True
+            for slot in ('Scale', 'Bias', 'Mean', 'Variance'):
+                v = scope.find_var(bn.inputs[slot][0])
+                if v is None:
+                    ok = False
+                    break
+                vals[slot] = np.asarray(v, np.float32)
+            w_val = scope.find_var(w_name)
+            if not ok or w_val is None:
+                i += 1
+                continue
+            w_val = np.asarray(w_val, np.float32)
+            eps = float(bn.attrs.get('epsilon', 1e-5))
+            alpha = vals['Scale'] / np.sqrt(vals['Variance'] + eps)
+            new_w = w_val * alpha[:, None, None, None]
+            new_b = vals['Bias'] - vals['Mean'] * alpha
+
+            bias_var = block.create_var(
+                name=w_name + '.bn_fold_bias', shape=list(new_b.shape),
+                dtype='float32', persistable=True)
+            scope.set_var(w_name, new_w.astype(w_val.dtype))
+            scope.set_var(bias_var.name, new_b.astype(np.float32))
+
+            bn_idx = block.ops.index(bn)
+            bn_out = bn.outputs['Y'][0]
+            block.remove_op(bn_idx)
+            block.insert_op(bn_idx, type='elementwise_add',
+                            inputs={'X': [out_name],
+                                    'Y': [bias_var.name]},
+                            outputs={'Out': [bn_out]},
+                            attrs={'axis': 1})
+            i += 1
+        program._bump_version()
+
+    def _mark_test_mode(self, program):
         for block in program.blocks:
             for op in block.ops:
-                if op.type == 'batch_norm':
-                    op.attrs['is_test'] = True
-                if op.type == 'dropout':
+                if op.type in ('batch_norm', 'dropout'):
                     op.attrs['is_test'] = True
         program._bump_version()

@@ -97,6 +97,24 @@ def test_host_arena_alloc_reset_stats():
         assert arena.stats()['chunks'] >= 2
         arena.reset()
         assert arena.stats()['allocated'] == 0
+        del big
+    del a, b
+    import gc
+    gc.collect()
+    arena.close()
+
+
+def test_host_arena_close_refuses_with_live_views():
+    arena = fluid.HostArena(chunk_bytes=1 << 16)
+    if not arena.native:
+        pytest.skip("native arena unavailable")
+    v = arena.alloc((16,), 'float32')
+    with pytest.raises(RuntimeError):
+        arena.close()
+    v[:] = 1.0  # still safely mapped
+    del v
+    import gc
+    gc.collect()
     arena.close()
 
 

@@ -3,6 +3,7 @@ python/paddle/fluid/tests/unittests/test_{prior_box,box_coder,
 bipartite_match,target_assign,multiclass_nms,detection_map}_op.py).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 
@@ -154,3 +155,134 @@ def test_detection_map_perfect_predictions():
         return (m,)
     m, = _run(build)
     np.testing.assert_allclose(np.asarray(m), [1.0], rtol=1e-5)
+
+
+def _random_map_case(rng, n_img, class_num, six_col):
+    """Random per-image detections/labels + the padded equivalents."""
+    dets, gts = [], []
+    for _ in range(n_img):
+        nd = rng.randint(1, 6)
+        ng = rng.randint(1, 5)
+        d = np.zeros((nd, 6), np.float32)
+        d[:, 0] = rng.randint(0, class_num, nd)
+        d[:, 1] = rng.rand(nd)
+        xy = rng.rand(nd, 2) * 0.6
+        d[:, 2:4] = xy
+        d[:, 4:6] = xy + rng.rand(nd, 2) * 0.4 + 0.05
+        g = np.zeros((ng, 6 if six_col else 5), np.float32)
+        g[:, 0] = rng.randint(0, class_num, ng)
+        off = 1
+        if six_col:
+            g[:, 1] = rng.rand(ng) < 0.3
+            off = 2
+        gxy = rng.rand(ng, 2) * 0.6
+        g[:, off:off + 2] = gxy
+        g[:, off + 2:off + 4] = gxy + rng.rand(ng, 2) * 0.4 + 0.05
+        dets.append(d)
+        gts.append(g)
+    return dets, gts
+
+
+def _pad_imgs(arrs, width):
+    n = max(a.shape[0] for a in arrs)
+    out = np.full((len(arrs), n, width), -1.0, np.float32)
+    for i, a in enumerate(arrs):
+        out[i, :a.shape[0], :a.shape[1]] = a
+    return out
+
+
+@pytest.mark.parametrize('ap_type', ['integral', '11point'])
+@pytest.mark.parametrize('six_col,eval_diff', [(False, True),
+                                               (True, True),
+                                               (True, False)])
+def test_detection_map_matches_reference_algorithm(ap_type, six_col,
+                                                   eval_diff):
+    """In-XLA kernel vs the exact host transcription of
+    detection_map_op.h (two independent implementations agreeing)."""
+    from paddle_tpu.ops.detection_map_ref import detection_map_numpy
+    import zlib
+    rng = np.random.RandomState(
+        zlib.crc32(repr((ap_type, six_col, eval_diff)).encode()) % 1000)
+    for trial in range(4):
+        class_num = 4
+        dets, gts = _random_map_case(rng, n_img=3, class_num=class_num,
+                                     six_col=six_col)
+        expected = detection_map_numpy(
+            dets, gts, overlap_threshold=0.4,
+            evaluate_difficult=eval_diff, ap_version=ap_type)
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            d_in = fluid.layers.data(name='d', shape=[5, 6],
+                                     dtype='float32')
+            g_in = fluid.layers.data(
+                name='g', shape=[4, 6 if six_col else 5],
+                dtype='float32')
+            m = fluid.layers.detection.detection_map(
+                d_in, g_in, class_num=class_num,
+                overlap_threshold=0.4, evaluate_difficult=eval_diff,
+                ap_version=ap_type)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got = exe.run(main, feed={
+                'd': _pad_imgs(dets, 6),
+                'g': _pad_imgs(gts, 6 if six_col else 5),
+            }, fetch_list=[m])[0]
+        np.testing.assert_allclose(float(np.asarray(got)), expected,
+                                   rtol=1e-4, atol=1e-5), (trial,)
+
+
+def test_detection_map_state_accumulates_across_batches():
+    """Reference Accum* semantics: two update() calls == one-shot over
+    the union of images."""
+    from paddle_tpu.ops.detection_map_ref import (DetectionMAPState,
+                                                  detection_map_numpy)
+    rng = np.random.RandomState(9)
+    d1, g1 = _random_map_case(rng, 2, 3, six_col=True)
+    d2, g2 = _random_map_case(rng, 3, 3, six_col=True)
+    st = DetectionMAPState(0.4, False, '11point')
+    st.update(d1, g1)
+    st.update(d2, g2)
+    oneshot = detection_map_numpy(d1 + d2, g1 + g2,
+                                  overlap_threshold=0.4,
+                                  evaluate_difficult=False,
+                                  ap_version='11point')
+    assert abs(st.value() - oneshot) < 1e-6
+    st.reset()
+    assert st.value() == 0.0
+
+
+def test_detection_map_sequence_tensor_input():
+    """LoD-fed detections/labels (the reference's native layout) match
+    the host reference; padding rows are ignored."""
+    from paddle_tpu.ops.detection_map_ref import detection_map_numpy
+    from paddle_tpu.lod import SequenceTensor
+    rng = np.random.RandomState(17)
+    dets, gts = _random_map_case(rng, n_img=3, class_num=3,
+                                 six_col=False)
+    expected = detection_map_numpy(dets, gts, overlap_threshold=0.4,
+                                   ap_version='integral')
+
+    def to_seq(arrs, width):
+        padded = _pad_imgs(arrs, width)   # [B, N, w], -1 padded
+        lens = [a.shape[0] for a in arrs]
+        return SequenceTensor(padded.astype('float32'), [lens])
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d_in = fluid.layers.data(name='d', shape=[5, 6],
+                                 dtype='float32', lod_level=1)
+        g_in = fluid.layers.data(name='g', shape=[4, 5],
+                                 dtype='float32', lod_level=1)
+        m = fluid.layers.detection.detection_map(
+            d_in, g_in, class_num=3, overlap_threshold=0.4,
+            ap_version='integral')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got = exe.run(main, feed={'d': to_seq(dets, 6),
+                                  'g': to_seq(gts, 5)},
+                      fetch_list=[m])[0]
+    np.testing.assert_allclose(float(np.asarray(got)), expected,
+                               rtol=1e-4, atol=1e-5)
