@@ -14,6 +14,7 @@ so the forward is traced exactly once; gradients bind to the reference's
 update ops) consume them as ordinary environment values.
 """
 import contextlib
+import time
 
 import jax
 import jax.numpy as jnp
@@ -129,15 +130,33 @@ class BlockRunner(object):
 
     def run_ops(self, ops, env):
         from ..debugging import nan_checks_enabled
+        from .. import profiler as _prof
         guard = nan_checks_enabled()
+        profiling = _prof.op_profiling_enabled()
         for op in ops:
             kernel = get_kernel(op.type)
+            t0 = time.perf_counter() if profiling else 0.0
             try:
-                kernel(OpCtx(op, env, self))
+                # named_scope stamps the op type into HLO metadata, so
+                # XLA traces (Perfetto/TensorBoard) carry op provenance
+                with jax.named_scope(op.type):
+                    kernel(OpCtx(op, env, self))
             except Exception as e:
                 raise type(e)(
                     "while lowering op %r (%s -> %s): %s" %
                     (op.type, op.inputs, op.outputs, e)) from e
+            if profiling:
+                outs = [env[n] for n in op.output_arg_names if n in env]
+                # only time real (eager) execution — during tracing the
+                # values are tracers and a timer would measure nothing
+                if not any(isinstance(o, jax.core.Tracer)
+                           for o in jax.tree_util.tree_leaves(outs)):
+                    try:
+                        jax.block_until_ready(outs)
+                    except Exception:
+                        pass
+                    _prof.record_op_event(op.type,
+                                          time.perf_counter() - t0)
             if guard:
                 _check_outputs(op, env)
             if self.grad_mode:
@@ -164,8 +183,10 @@ def _is_float(val):
 
 
 def _check_outputs(op, env):
-    """Debug-mode NaN/Inf guard: one checkify.check per float output,
-    carrying op provenance (type, output, inputs) in the message."""
+    """Debug-mode NaN/Inf guard: one check per float output, carrying op
+    provenance (type, output, inputs). Under a trace it functionalizes
+    via checkify; on concrete (eager/profiling) values it raises
+    directly."""
     from jax.experimental import checkify
     for name in op.output_arg_names:
         if name not in env:
@@ -174,11 +195,15 @@ def _check_outputs(op, env):
             arr = jnp.asarray(leaf)
             if not jnp.issubdtype(arr.dtype, jnp.floating):
                 continue
-            checkify.check(
-                jnp.isfinite(arr.astype(jnp.float32)).all(),
-                "NaN/Inf detected in output '%s' of op '%s' "
-                "(inputs: %s)" % (name, op.type,
-                                  sorted(op.input_arg_names)))
+            msg = ("NaN/Inf detected in output '%s' of op '%s' "
+                   "(inputs: %s)" % (name, op.type,
+                                     sorted(op.input_arg_names)))
+            if isinstance(arr, jax.core.Tracer):
+                checkify.check(
+                    jnp.isfinite(arr.astype(jnp.float32)).all(), msg)
+            elif not bool(jnp.isfinite(
+                    arr.astype(jnp.float32)).all()):
+                raise FloatingPointError(msg)
 
 
 def _find_marker(ops):
@@ -221,8 +246,19 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
                 return jnp.sum(loss), genv
 
             param_vals = {p: env[p] for p in param_names}
+            from .. import profiler as _prof
+            _profiling = _prof.op_profiling_enabled() and not any(
+                isinstance(v, jax.core.Tracer)
+                for v in jax.tree_util.tree_leaves(param_vals))
+            _t0 = time.perf_counter() if _profiling else 0.0
             (_, env2), pgrads = jax.value_and_grad(
                 g, has_aux=True)(param_vals)
+            if _profiling:
+                # the fused fwd+bwd region is one XLA program; per-op
+                # attribution inside it would be fiction
+                jax.block_until_ready(pgrads)
+                _prof.record_op_event('fwd_bwd(value_and_grad)',
+                                      time.perf_counter() - _t0)
             env = env2
             env.update(param_vals)
             scale = marker.attrs.get('loss_scale', None)

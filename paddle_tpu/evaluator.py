@@ -156,6 +156,7 @@ class DetectionMAP(Evaluator):
 
     def reset(self, executor, reset_program=None):
         self._state.reset()
+        self._host_mode = False
         return super(DetectionMAP, self).reset(executor, reset_program)
 
     def eval(self, executor, eval_program=None):

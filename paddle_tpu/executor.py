@@ -207,18 +207,24 @@ class Executor(object):
         state_out_names = sorted(set(state_out_names) | {RNG_KEY})
 
         from .debugging import nan_checks_enabled
+        from . import profiler as _prof
         guard = nan_checks_enabled()
+        profiling = _prof.op_profiling_enabled()
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
                tuple(fetch_names), tuple(state_in_names),
-               tuple(state_out_names), guard)
+               tuple(state_out_names), guard, profiling)
         entry = self._cache.get(key)
         if entry is None:
             lower_prog = self._maybe_prune(program, fetch_names)
             fn = lower_block(lower_prog, lower_prog.global_block(),
                              sorted(feed.keys()), fetch_names,
                              state_in_names, state_out_names)
-            if guard:
+            if profiling:
+                # Per-op profiling: run UN-jitted so the lowering
+                # executes (and times) op by op on the device.
+                jitted = fn
+            elif guard:
                 # Debug mode: functionalize the per-op NaN/Inf checks.
                 # No donation — on a thrown error the scope must still
                 # hold live (pre-step) state buffers.
@@ -233,10 +239,11 @@ class Executor(object):
         state = {n: scope.find_var(n) for n in state_in_names}
 
         with jax.default_device(self.place.jax_device()):
-            if guard:
+            if guard and not profiling:
                 err, (fetches, new_state) = jitted(feed, state)
                 err.throw()
             else:
+                # profiling path is eager; its guard checks raise inline
                 fetches, new_state = jitted(feed, state)
         for n, v in new_state.items():
             scope.set_var(n, v)

@@ -179,6 +179,13 @@ class InferenceTranspiler(object):
     def _fuse_conv_bn(self, program, scope):
         import numpy as np
         block = program.global_block()
+        # a filter shared by several convs cannot be rewritten in place:
+        # each BN would need its own scaled copy
+        filter_uses = {}
+        for op in block.ops:
+            if op.type in ('conv2d', 'depthwise_conv2d'):
+                f = op.inputs['Filter'][0]
+                filter_uses[f] = filter_uses.get(f, 0) + 1
         i = 0
         while i < len(block.ops):
             op = block.ops[i]
@@ -192,6 +199,9 @@ class InferenceTranspiler(object):
                 continue
             bn = consumers[0]
             w_name = op.inputs['Filter'][0]
+            if filter_uses.get(w_name, 0) > 1:
+                i += 1
+                continue
             vals = {}
             ok = True
             for slot in ('Scale', 'Bias', 'Mean', 'Variance'):

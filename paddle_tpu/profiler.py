@@ -1,8 +1,20 @@
 """Profiler.
 
-Parity: python/paddle/fluid/profiler.py (CUDA-event profiler + nvprof).
-TPU design: wraps jax.profiler traces (viewable in TensorBoard/Perfetto)
-plus host wall-clock per-run stats collected by the Executor.
+Parity: python/paddle/fluid/profiler.py + platform/profiler.cc (per-op
+event table with calls/total/max/min/ave, printed by stop_profiler
+sorted by a key). TPU design, two layers:
+
+- XLA trace: start/stop_profiler wrap jax.profiler traces (TensorBoard/
+  Perfetto). Every op lowers under ``jax.named_scope(op_type)`` so HLO
+  metadata carries op provenance into those traces at zero runtime cost.
+- Per-op host table: while profiling is active the Executor runs the
+  program UN-jitted, so the lowering executes op by op on the device and
+  each kernel is timed with a hard sync (like the reference timing each
+  operator Run()). Inside a training step the fused
+  ``jax.value_and_grad`` region is one event ('fwd_bwd(value_and_grad)')
+  — XLA compiles it as a single fused program, so finer attribution
+  would be fiction. Expect profiled steps to run slower; that is the
+  price of per-op truth on a fusing compiler.
 """
 import contextlib
 import os
@@ -13,6 +25,23 @@ __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
 
 _stats = {'runs': 0, 'wall': 0.0}
 _trace_dir = None
+_op_profiling = [False]
+_op_events = {}   # op_type -> [calls, total_s, max_s, min_s]
+
+
+def op_profiling_enabled():
+    return _op_profiling[0]
+
+
+def record_op_event(op_type, seconds):
+    ev = _op_events.get(op_type)
+    if ev is None:
+        _op_events[op_type] = [1, seconds, seconds, seconds]
+    else:
+        ev[0] += 1
+        ev[1] += seconds
+        ev[2] = max(ev[2], seconds)
+        ev[3] = min(ev[3], seconds)
 
 
 @contextlib.contextmanager
@@ -25,12 +54,14 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 def reset_profiler():
     _stats['runs'] = 0
     _stats['wall'] = 0.0
+    _op_events.clear()
 
 
 def start_profiler(state='All', tracer_option=None,
                    trace_dir='/tmp/paddle_tpu_trace'):
     global _trace_dir
     import jax
+    _op_profiling[0] = True
     os.makedirs(trace_dir, exist_ok=True)
     try:
         jax.profiler.start_trace(trace_dir)
@@ -39,9 +70,38 @@ def start_profiler(state='All', tracer_option=None,
         _trace_dir = None
 
 
+def _print_table(sorted_key, out=None):
+    """Reference-style event table (platform/profiler.cc PrintProfiler)."""
+    if not _op_events:
+        return
+    rows = [(name, ev[0], ev[1], ev[2], ev[3], ev[1] / ev[0])
+            for name, ev in _op_events.items()]
+    # reference sorts every key descending (profiler.cc SetSortedFunc);
+    # no sorted_key keeps insertion order (kDefault)
+    key_idx = {'calls': 1, 'total': 2, 'max': 3, 'min': 4,
+               'ave': 5}.get(sorted_key)
+    if key_idx is not None:
+        rows.sort(key=lambda r: -r[key_idx])
+    lines = ["", "------------------------->     Profiling Report     "
+             "<-------------------------", ""]
+    lines.append("%-28s %8s %12s %12s %12s %12s" %
+                 ("Event", "Calls", "Total(ms)", "Max(ms)", "Min(ms)",
+                  "Ave(ms)"))
+    for name, calls, total, mx, mn, ave in rows:
+        lines.append("%-28s %8d %12.4f %12.4f %12.4f %12.4f" %
+                     (name, calls, total * 1e3, mx * 1e3, mn * 1e3,
+                      ave * 1e3))
+    text = "\n".join(lines)
+    if out is not None:
+        with open(out, 'w') as f:
+            f.write(text + "\n")
+    print(text)
+
+
 def stop_profiler(sorted_key=None, profile_path=None):
     global _trace_dir
     import jax
+    _op_profiling[0] = False
     if _trace_dir is not None:
         try:
             jax.profiler.stop_trace()
@@ -49,6 +109,7 @@ def stop_profiler(sorted_key=None, profile_path=None):
             pass
         print("[paddle_tpu.profiler] trace written to %s" % _trace_dir)
         _trace_dir = None
+    _print_table(sorted_key, profile_path)
     if _stats['runs']:
         print("[paddle_tpu.profiler] %d runs, %.3f s total, %.3f ms/run" %
               (_stats['runs'], _stats['wall'],
@@ -60,7 +121,11 @@ def profiler(state='All', sorted_key=None, profile_path=None,
              tracer_option=None):
     start_profiler(state)
     t0 = time.time()
-    yield
-    _stats['runs'] += 1
-    _stats['wall'] += time.time() - t0
-    stop_profiler(sorted_key, profile_path)
+    try:
+        yield
+    finally:
+        # an exception in the body must still stop the trace and clear
+        # the op-profiling flag, or every later run stays eager
+        _stats['runs'] += 1
+        _stats['wall'] += time.time() - t0
+        stop_profiler(sorted_key, profile_path)

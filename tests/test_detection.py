@@ -230,7 +230,8 @@ def test_detection_map_matches_reference_algorithm(ap_type, six_col,
                 'g': _pad_imgs(gts, 6 if six_col else 5),
             }, fetch_list=[m])[0]
         np.testing.assert_allclose(float(np.asarray(got)), expected,
-                                   rtol=1e-4, atol=1e-5), (trial,)
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg='trial %d' % trial)
 
 
 def test_detection_map_state_accumulates_across_batches():

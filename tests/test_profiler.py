@@ -1,0 +1,77 @@
+"""Per-op profiler (VERDICT r1 #9). Parity: platform/profiler.cc event
+table + python/paddle/fluid/profiler.py API."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler
+
+
+def _train_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def test_per_op_event_table(capsys):
+    profiler.reset_profiler()
+    main, startup, loss = _train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(4, 8).astype('float32'),
+            'y': rng.randn(4, 1).astype('float32')}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with profiler.profiler('All', 'total'):
+            for _ in range(3):
+                exe.run(main, feed=feed, fetch_list=[loss])
+    out = capsys.readouterr().out
+    assert 'Profiling Report' in out
+    assert 'fwd_bwd(value_and_grad)' in out       # the fused region
+    # optimizer update ops run per-op (post-marker, eager)
+    assert 'sgd' in out
+    assert 'Calls' in out and 'Ave(ms)' in out
+    ev = dict(profiler._op_events)
+    assert ev['fwd_bwd(value_and_grad)'][0] == 3  # calls
+    assert ev['sgd'][0] >= 3                      # >=1 param x 3 steps
+
+
+def test_inference_per_op_granularity():
+    """No backward marker -> every op times individually."""
+    profiler.reset_profiler()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        out = fluid.layers.softmax(h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        profiler.start_profiler('All')
+        exe.run(main, feed={'x': np.ones((2, 8), np.float32)},
+                fetch_list=[out])
+        profiler.stop_profiler('total')
+    ev = dict(profiler._op_events)
+    assert 'mul' in ev and 'softmax' in ev and 'relu' in ev
+    for name, (calls, total, mx, mn) in ev.items():
+        assert calls >= 1 and total >= 0 and mx >= mn
+    profiler.reset_profiler()
+    assert not profiler._op_events
+
+
+def test_profiling_does_not_pollute_normal_runs():
+    """After stop_profiler, runs are jitted again and record nothing."""
+    profiler.reset_profiler()
+    main, startup, loss = _train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {'x': np.ones((2, 8), np.float32),
+            'y': np.ones((2, 1), np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+    assert not profiler._op_events
