@@ -179,13 +179,14 @@ class InferenceTranspiler(object):
     def _fuse_conv_bn(self, program, scope):
         import numpy as np
         block = program.global_block()
-        # a filter shared by several convs cannot be rewritten in place:
-        # each BN would need its own scaled copy
+        # a filter with ANY other consumer (another conv, a sub-block op,
+        # a fetch helper) cannot be rewritten in place: each use would
+        # need its own scaled copy
         filter_uses = {}
-        for op in block.ops:
-            if op.type in ('conv2d', 'depthwise_conv2d'):
-                f = op.inputs['Filter'][0]
-                filter_uses[f] = filter_uses.get(f, 0) + 1
+        for b in program.blocks:
+            for op in b.ops:
+                for name in op.input_arg_names:
+                    filter_uses[name] = filter_uses.get(name, 0) + 1
         i = 0
         while i < len(block.ops):
             op = block.ops[i]

@@ -78,10 +78,13 @@ def _print_table(sorted_key, out=None):
             for name, ev in _op_events.items()]
     # reference sorts every key descending (profiler.cc SetSortedFunc);
     # no sorted_key keeps insertion order (kDefault)
-    key_idx = {'calls': 1, 'total': 2, 'max': 3, 'min': 4,
-               'ave': 5}.get(sorted_key)
-    if key_idx is not None:
-        rows.sort(key=lambda r: -r[key_idx])
+    keys = {'calls': 1, 'total': 2, 'max': 3, 'min': 4, 'ave': 5}
+    if sorted_key is not None and sorted_key not in keys:
+        raise ValueError(
+            "The sorted_key must be None or in %s, got %r"
+            % (sorted(keys), sorted_key))
+    if sorted_key is not None:
+        rows.sort(key=lambda r: -r[keys[sorted_key]])
     lines = ["", "------------------------->     Profiling Report     "
              "<-------------------------", ""]
     lines.append("%-28s %8s %12s %12s %12s %12s" %
