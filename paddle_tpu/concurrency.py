@@ -8,9 +8,9 @@ pipelining readers/trainers around the device step (the same role the
 reference's channels play in its CSP examples), built on queue.Queue.
 ``Go`` runs its body eagerly on a thread pool at run time.
 """
+import collections
 import contextlib
 import time
-import queue
 import threading
 
 __all__ = ['Go', 'make_channel', 'channel_send', 'channel_recv',
@@ -18,40 +18,105 @@ __all__ = ['Go', 'make_channel', 'channel_send', 'channel_recv',
 
 
 class Channel(object):
-    """Typed bounded channel. capacity=0 -> synchronous handoff."""
+    """Typed Go-style channel under one condition variable.
+
+    capacity=0 is a TRUE rendezvous: send() returns only after a
+    receiver has taken the value. close() is race-free with send — both
+    take the same lock, so a send can never enqueue after close (the
+    check-then-put race ADVICE r1 flagged in the queue.Queue version).
+    Values queued before close() still drain through recv() (Go
+    semantics); senders still blocked at close() withdraw their
+    undelivered item and return False.
+    """
 
     def __init__(self, dtype, capacity=0):
         self.dtype = dtype
-        self._q = queue.Queue(maxsize=capacity if capacity > 0 else 1)
-        self._closed = threading.Event()
-        self._sync = capacity == 0
+        self.capacity = capacity
+        self._cond = threading.Condition()
+        self._items = collections.deque()   # (value, done_event | None)
+        self._recv_waiting = 0
+        self._is_closed = False
 
     def send(self, value):
-        # Poll with a timeout so a close() while we're blocked on a full
-        # queue wakes us up instead of deadlocking the producer thread.
-        while True:
-            if self._closed.is_set():
+        with self._cond:
+            if self._is_closed:
                 return False
-            try:
-                self._q.put(value, timeout=0.05)
+            if self.capacity > 0:
+                while len(self._items) >= self.capacity:
+                    self._cond.wait()
+                    if self._is_closed:
+                        return False
+                self._items.append((value, None))
+                self._cond.notify_all()
                 return True
-            except queue.Full:
-                continue
+            done = threading.Event()
+            entry = (value, done)
+            self._items.append(entry)
+            self._cond.notify_all()
+            while not done.is_set():
+                if self._is_closed:
+                    # withdraw if nobody took it; consumed wins otherwise
+                    try:
+                        self._items.remove(entry)
+                        return False
+                    except ValueError:
+                        pass   # receiver popped it; done is (being) set
+                self._cond.wait()
+            return True
 
     def recv(self):
-        while True:
+        with self._cond:
+            self._recv_waiting += 1
             try:
-                return True, self._q.get(timeout=0.05)
-            except queue.Empty:
-                if self._closed.is_set():
-                    return False, None
+                while not self._items:
+                    if self._is_closed:
+                        return False, None
+                    self._cond.wait()
+                value, done = self._items.popleft()
+                if done is not None:
+                    done.set()
+                self._cond.notify_all()
+                return True, value
+            finally:
+                self._recv_waiting -= 1
 
     def close(self):
-        self._closed.set()
+        with self._cond:
+            self._is_closed = True
+            self._cond.notify_all()
+
+    # ---- Select hooks ------------------------------------------------------
+    def try_send(self, value):
+        """Atomic non-blocking send: enqueue iff it can complete without
+        waiting (room in a buffered channel, or a receiver already
+        waiting on a rendezvous channel). Select's send cases use this —
+        a separate can_send()-then-send() pair would race another
+        selector into a blocked send."""
+        with self._cond:
+            if self._is_closed:
+                return False
+            if self.capacity > 0:
+                if len(self._items) >= self.capacity:
+                    return False
+                self._items.append((value, None))
+                self._cond.notify_all()
+                return True
+            if self._recv_waiting <= len(self._items):
+                return False
+            # a waiting receiver is guaranteed to take it; no need to
+            # block for the rendezvous to finish
+            self._items.append((value, None))
+            self._cond.notify_all()
+            return True
+
+    def can_recv(self):
+        with self._cond:
+            return bool(self._items) or self._is_closed
 
     @property
     def closed(self):
-        return self._closed.is_set() and self._q.empty()
+        with self._cond:
+            return self._is_closed and not self._items
 
 
 def make_channel(dtype, capacity=0):
@@ -133,13 +198,12 @@ class Select(object):
         while True:
             for action, ch, value, body in self._cases:
                 if action is channel_send:
-                    if not ch._q.full():
-                        action(ch, value)
+                    if ch.try_send(value):
                         for fn in body:
                             fn()
                         return True
                 else:
-                    if not ch._q.empty() or ch._closed.is_set():
+                    if ch.can_recv():
                         _, ok = action(ch)
                         for fn in body:
                             fn()

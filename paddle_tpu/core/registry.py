@@ -10,10 +10,19 @@ It reads inputs from the lowering environment and writes outputs back.
 
 _KERNELS = {}
 
+# Ops whose presence must pin a program to whole-block lowering: they
+# have host side effects or cross-run state beyond their dataflow
+# outputs, so the executor's prune-to-fetches must never drop them
+# (ADVICE r1: keep this next to the registry so new side-effecting ops
+# register their exemption alongside their kernel).
+SIDE_EFFECT_OPS = {'backward_marker', 'print'}
 
-def register_kernel(op_type):
+
+def register_kernel(op_type, side_effect=False):
     def deco(fn):
         _KERNELS[op_type] = fn
+        if side_effect:
+            SIDE_EFFECT_OPS.add(op_type)
         return fn
     return deco
 

@@ -97,6 +97,11 @@ def fetch_var(name, scope=None, return_numpy=True):
     return val
 
 
+def _side_effect_ops():
+    from .core.registry import SIDE_EFFECT_OPS
+    return SIDE_EFFECT_OPS
+
+
 def _spec(val):
     if isinstance(val, SequenceTensor):
         return ('seq', tuple(val.data.shape), str(val.data.dtype),
@@ -170,7 +175,7 @@ class Executor(object):
         block = program.global_block()
         persist_outs = []
         for op in block.ops:
-            if op.type in ('backward_marker', 'print'):
+            if op.type in _side_effect_ops():
                 # training step / host side effects: lower the whole block
                 return program
             if any(isinstance(v, framework.Block)

@@ -173,19 +173,34 @@ def _max_pool2d_with_index(ctx):
         kh, kw = x.shape[2], x.shape[3]
         ph = pw = 0
     n, c, h, w = x.shape
-    neg = jnp.finfo(x.dtype).min
+    # finite sentinel below any f32 activation: finfo.min would round to
+    # -inf in bf16 on TPU and 0 * -inf = NaN inside the patch conv
+    neg = jnp.asarray(-3.3e38, x.dtype)
     patches = lax.conv_general_dilated_patches(
-        jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
-                constant_values=neg),
+        jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw))),
         (kh, kw), (sh, sw), 'VALID',
         dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
     ho, wo = patches.shape[2], patches.shape[3]
     patches = patches.reshape(n, c, kh * kw, ho, wo)
-    local = jnp.argmax(patches, axis=2)
-    out = jnp.max(patches, axis=2)
+    # Mask pad cells out of the argmax explicitly (the reference clips
+    # windows to the image, math/pooling.cc, so Mask is always a real
+    # pixel; relying on pad == dtype-min would pick padding whenever
+    # data ties with it — ADVICE r1).
+    ones = jnp.ones((1, 1, h, w), x.dtype)
+    valid = lax.conv_general_dilated_patches(
+        jnp.pad(ones, ((0, 0), (0, 0), (ph, ph), (pw, pw))),
+        (kh, kw), (sh, sw), 'VALID',
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    valid = valid.reshape(1, 1, kh * kw, ho, wo) > 0.5
+    score = jnp.where(valid, patches, neg)
+    local = jnp.argmax(score, axis=2)
+    out = jnp.max(score, axis=2)
     lh, lw = local // kw, local % kw
     gh = jnp.arange(ho).reshape(1, 1, ho, 1) * sh - ph + lh
     gw = jnp.arange(wo).reshape(1, 1, 1, wo) * sw - pw + lw
+    # belt for degenerate fully-padded windows: clamp into the image
+    gh = jnp.clip(gh, 0, h - 1)
+    gw = jnp.clip(gw, 0, w - 1)
     ctx.set_output('Out', out)
     ctx.set_output('Mask', (gh * w + gw).astype(jnp.int32))
 

@@ -172,8 +172,8 @@ class InferenceTranspiler(object):
         self._mark_test_mode(program)
         return program
 
-    def _consumers(self, block, name):
-        return [op for op in block.ops
+    def _consumers(self, program, name):
+        return [op for b in program.blocks for op in b.ops
                 if name in op.input_arg_names]
 
     def _fuse_conv_bn(self, program, scope):
@@ -194,7 +194,7 @@ class InferenceTranspiler(object):
                 i += 1
                 continue
             out_name = op.outputs['Output'][0]
-            consumers = self._consumers(block, out_name)
+            consumers = self._consumers(program, out_name)
             if len(consumers) != 1 or consumers[0].type != 'batch_norm':
                 i += 1
                 continue

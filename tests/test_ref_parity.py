@@ -436,3 +436,28 @@ def test_accuracy_top1():
                  out_slots=('Accuracy',),
                  extra_outs=('Correct', 'Total'))[0]
     np.testing.assert_allclose(np.asarray(got), [2.0 / 3.0], rtol=1e-6)
+
+
+def test_max_pool2d_with_index_mask_always_in_image():
+    """ADVICE r1: argmax must never address padding — every Mask entry
+    is a real pixel and Out == x[mask] even when data ties with the
+    pad value."""
+    rng = np.random.RandomState(15)
+    x = rng.randn(2, 3, 6, 6).astype('float32')
+    # worst case: deeply negative data (pad cells must still never win;
+    # values below the -3.3e38 sentinel are out of contract)
+    x[1] = -1e30
+    got_out, got_mask = run_op(
+        'max_pool2d_with_index', {'X': x},
+        {'ksize': [3, 3], 'strides': [2, 2], 'paddings': [1, 1]},
+        out_slots=('Out', 'Mask'))
+    out = np.asarray(got_out)
+    mask = np.asarray(got_mask)
+    h, w = 6, 6
+    assert mask.min() >= 0 and mask.max() < h * w
+    for n in range(2):
+        for c in range(3):
+            flat = x[n, c].reshape(-1)
+            np.testing.assert_allclose(out[n, c].reshape(-1),
+                                       flat[mask[n, c].reshape(-1)],
+                                       rtol=1e-6)
