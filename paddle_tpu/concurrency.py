@@ -55,12 +55,14 @@ class Channel(object):
             self._cond.notify_all()
             while not done.is_set():
                 if self._is_closed:
-                    # withdraw if nobody took it; consumed wins otherwise
-                    try:
-                        self._items.remove(entry)
-                        return False
-                    except ValueError:
-                        pass   # receiver popped it; done is (being) set
+                    # withdraw if nobody took it; consumed wins otherwise.
+                    # Identity scan, not deque.remove(): == on queued
+                    # numpy payloads raises/ambiguates.
+                    for idx, queued in enumerate(self._items):
+                        if queued is entry:
+                            del self._items[idx]
+                            return False
+                    # not queued -> a receiver popped it; done is being set
                 self._cond.wait()
             return True
 
@@ -109,9 +111,21 @@ class Channel(object):
             self._cond.notify_all()
             return True
 
-    def can_recv(self):
+    def try_recv(self):
+        """Atomic non-blocking recv for Select: (ready, ok, value).
+        ready=False means nothing to take and the channel is open — a
+        separate can_recv()-then-recv() pair would race another consumer
+        into a blocked recv."""
         with self._cond:
-            return bool(self._items) or self._is_closed
+            if self._items:
+                value, done = self._items.popleft()
+                if done is not None:
+                    done.set()
+                self._cond.notify_all()
+                return True, True, value
+            if self._is_closed:
+                return True, False, None
+            return False, False, None
 
     @property
     def closed(self):
@@ -203,8 +217,8 @@ class Select(object):
                             fn()
                         return True
                 else:
-                    if ch.can_recv():
-                        _, ok = action(ch)
+                    ready, ok, _val = ch.try_recv()
+                    if ready:
                         for fn in body:
                             fn()
                         return ok

@@ -20,7 +20,13 @@ _SYNTHETIC = True
 
 
 def is_synthetic():
+    """False once any dataset module has served REAL cached data."""
     return _SYNTHETIC
+
+
+def mark_real_data():
+    global _SYNTHETIC
+    _SYNTHETIC = False
 
 
 def rng(name, salt=0):

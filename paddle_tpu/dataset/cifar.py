@@ -1,24 +1,67 @@
-"""CIFAR-10/100. Parity: python/paddle/dataset/cifar.py (synthetic
-fallback; images flattened 3*32*32 in [-1,1])."""
+"""CIFAR-10/100. Parity: python/paddle/dataset/cifar.py — a cached
+cifar-{10,100}-python.tar.gz is parsed when present (pickled batches,
+samples /255.0 like the reference); otherwise the synthetic fallback
+(images flattened 3*32*32 in [-1, 1])."""
+import pickle
+import tarfile
+
+import numpy as np
+
 from . import _synth
+from .common import cached_path
 
 __all__ = ['train10', 'test10', 'train100', 'test100']
 
 
+def _tar_reader(archive, sub_name):
+    path = cached_path('cifar', archive)
+    if path is None:
+        return None
+    _synth.mark_real_data()
+
+    def reader():
+        with tarfile.open(path, mode='r') as f:
+            names = [m.name for m in f if sub_name in m.name]
+            for name in sorted(names):
+                batch = pickle.load(f.extractfile(name),
+                                    encoding='bytes')
+                data = batch[b'data']
+                labels = batch.get(b'labels',
+                                   batch.get(b'fine_labels'))
+                assert labels is not None
+                for sample, label in zip(data, labels):
+                    # reference normalization (cifar.py read_batch)
+                    yield (np.asarray(sample) / 255.0).astype(
+                        np.float32), int(label)
+    return reader
+
+
 def train10():
+    real = _tar_reader('cifar-10-python.tar.gz', 'data_batch')
+    if real is not None:
+        return real
     return _synth.image_sampler('cifar10_train', 10, (3, 32, 32), 8192)
 
 
 def test10():
+    real = _tar_reader('cifar-10-python.tar.gz', 'test_batch')
+    if real is not None:
+        return real
     return _synth.image_sampler('cifar10_test', 10, (3, 32, 32), 1024,
                                 seed_salt=1)
 
 
 def train100():
+    real = _tar_reader('cifar-100-python.tar.gz', 'train')
+    if real is not None:
+        return real
     return _synth.image_sampler('cifar100_train', 100, (3, 32, 32), 8192)
 
 
 def test100():
+    real = _tar_reader('cifar-100-python.tar.gz', 'test')
+    if real is not None:
+        return real
     return _synth.image_sampler('cifar100_test', 100, (3, 32, 32), 1024,
                                 seed_salt=1)
 

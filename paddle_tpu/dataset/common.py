@@ -5,7 +5,25 @@ import os
 
 from ._synth import DATA_HOME
 
-__all__ = ['DATA_HOME', 'download', 'md5file', 'split', 'cluster_files_reader']
+__all__ = ['DATA_HOME', 'data_home', 'cached_path', 'download', 'md5file',
+           'split', 'cluster_files_reader']
+
+
+def data_home():
+    """Cache root, re-read from the environment on every call (tests and
+    multi-corpus setups repoint PADDLE_TPU_DATA_HOME at runtime)."""
+    return os.environ.get('PADDLE_TPU_DATA_HOME', DATA_HOME)
+
+
+def cached_path(module_name, filename, md5sum=None):
+    """Path of a cached corpus file in the reference layout
+    (<data_home>/<module>/<file>), or None when absent/corrupt. The
+    real-data parsers probe this and fall back to synthetic corpora."""
+    path = os.path.join(data_home(), module_name, filename)
+    if os.path.exists(path) and (md5sum is None or
+                                 md5file(path) == md5sum):
+        return path
+    return None
 
 
 def md5file(fname):
@@ -17,7 +35,7 @@ def md5file(fname):
 
 
 def download(url, module_name, md5sum, save_name=None):
-    dirname = os.path.join(DATA_HOME, module_name)
+    dirname = os.path.join(data_home(), module_name)
     filename = os.path.join(
         dirname, url.split('/')[-1] if save_name is None else save_name)
     if os.path.exists(filename) and (md5sum is None or

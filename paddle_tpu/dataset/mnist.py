@@ -1,15 +1,61 @@
-"""MNIST. Parity: python/paddle/dataset/mnist.py (synthetic fallback:
-class-conditional 28x28 templates; see _synth.py)."""
+"""MNIST. Parity: python/paddle/dataset/mnist.py — a cached idx-gzip
+pair (reference layout/normalization: flat 784 floats in [-1, 1]) is
+parsed when present; otherwise the deterministic synthetic fallback
+(class-conditional 28x28 templates; see _synth.py) keeps convergence
+tests meaningful in the zero-egress environment."""
+import gzip
+import struct
+
+import numpy as np
+
 from . import _synth
+from .common import cached_path
 
 __all__ = ['train', 'test']
 
 
+def _idx_reader(image_name, label_name):
+    img_path = cached_path('mnist', image_name)
+    lab_path = cached_path('mnist', label_name)
+    if img_path is None or lab_path is None:
+        return None
+
+    _synth.mark_real_data()
+
+    def reader():
+        with gzip.open(img_path, 'rb') as f:
+            data = f.read()
+        with gzip.open(lab_path, 'rb') as f:
+            ldata = f.read()
+        magic, n, rows, cols = struct.unpack('>IIII', data[:16])
+        assert magic == 2051, "bad idx image magic %d" % magic
+        lmagic, ln = struct.unpack('>II', ldata[:8])
+        assert lmagic == 2049, "bad idx label magic %d" % lmagic
+        count = min(n, ln)   # tolerate a truncated half of the pair
+        images = np.frombuffer(data, np.uint8, offset=16,
+                               count=count * rows * cols).reshape(
+            count, rows * cols).astype('float32')
+        # reference normalization (mnist.py reader_creator)
+        images = images / 255.0 * 2.0 - 1.0
+        labels = np.frombuffer(ldata, np.uint8, offset=8, count=count)
+        for i in range(count):
+            yield images[i, :], int(labels[i])
+    return reader
+
+
 def train():
+    real = _idx_reader('train-images-idx3-ubyte.gz',
+                       'train-labels-idx1-ubyte.gz')
+    if real is not None:
+        return real
     return _synth.image_sampler('mnist_train', 10, (1, 28, 28), 8192)
 
 
 def test():
+    real = _idx_reader('t10k-images-idx3-ubyte.gz',
+                       't10k-labels-idx1-ubyte.gz')
+    if real is not None:
+        return real
     return _synth.image_sampler('mnist_test', 10, (1, 28, 28), 1024,
                                 seed_salt=1)
 

@@ -1,8 +1,11 @@
-"""UCI housing. Parity: python/paddle/dataset/uci_housing.py (synthetic
-fallback: fixed 13-dim linear model + noise, normalized features)."""
+"""UCI housing. Parity: python/paddle/dataset/uci_housing.py — a cached
+housing.data is parsed with the reference's normalization ((x - avg) /
+(max - min), 80/20 split); otherwise the synthetic fallback (fixed
+13-dim linear model + noise)."""
 import numpy as np
 
 from . import _synth
+from .common import cached_path
 
 __all__ = ['train', 'test']
 
@@ -11,6 +14,43 @@ feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
 
 _W = _synth.rng('uci_housing_w').randn(13).astype('float32')
 _B = 22.5
+
+_REAL = {}   # (path, mtime, size) -> (train_rows, test_rows)
+
+
+def _load_real(feature_num=14, ratio=0.8):
+    import os
+    path = cached_path('uci_housing', 'housing.data')
+    if path is None:
+        return None
+    st = os.stat(path)
+    key = (path, st.st_mtime_ns, st.st_size)
+    if key not in _REAL:
+        _REAL.clear()   # content changed: drop stale parses
+        _synth.mark_real_data()
+        data = np.fromfile(path, sep=' ')
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maximums = data.max(axis=0)
+        minimums = data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (
+                maximums[i] - minimums[i])
+        offset = int(data.shape[0] * ratio)
+        _REAL[key] = (data[:offset], data[offset:])
+    return _REAL[key]
+
+
+def _real_reader(split_idx):
+    loaded = _load_real()
+    if loaded is None:
+        return None
+    rows = loaded[split_idx]
+
+    def reader():
+        for d in rows:
+            yield d[:-1].astype('float32'), d[-1:].astype('float32')
+    return reader
 
 
 def _sampler(n, salt):
@@ -24,11 +64,11 @@ def _sampler(n, salt):
 
 
 def train():
-    return _sampler(404, 0)
+    return _real_reader(0) or _sampler(404, 0)
 
 
 def test():
-    return _sampler(102, 1)
+    return _real_reader(1) or _sampler(102, 1)
 
 
 def fetch():
