@@ -32,6 +32,30 @@ def set_amp(on):
     _STATE['mode'] = on
 
 
+def conv_layout():
+    """'NCHW' (default, reference layout) or 'NHWC'. On TPU the vector
+    lane dim wants channels minor; set PADDLE_TPU_CONV_LAYOUT=NHWC to
+    run convs channels-last (the kernel transposes at op boundaries and
+    XLA cancels the transposes between adjacent convs)."""
+    mode = _STATE.get('conv_layout')
+    if mode is None:
+        mode = os.environ.get('PADDLE_TPU_CONV_LAYOUT', 'NCHW').upper()
+        _STATE['conv_layout'] = mode if mode in ('NCHW', 'NHWC') \
+            else 'NCHW'
+    return _STATE['conv_layout']
+
+
+def set_conv_layout(layout):
+    if layout is None:
+        _STATE['conv_layout'] = None
+        return
+    layout = layout.upper()
+    if layout not in ('NCHW', 'NHWC'):
+        raise ValueError("conv layout must be NCHW or NHWC, got %r"
+                         % layout)
+    _STATE['conv_layout'] = layout
+
+
 def mxu_compute(fn, *operands):
     """Run ``fn(*operands)`` on the MXU in bf16 under AMP.
 

@@ -4,35 +4,53 @@ samples /255.0 like the reference); otherwise the synthetic fallback
 (images flattened 3*32*32 in [-1, 1])."""
 import pickle
 import tarfile
+import warnings
 
 import numpy as np
 
 from . import _synth
-from .common import cached_path
+from .common import cached_path, file_key
 
 __all__ = ['train10', 'test10', 'train100', 'test100']
+
+
+_PARSED = {}   # (file_key, sub_name) -> list of (sample, label)
 
 
 def _tar_reader(archive, sub_name):
     path = cached_path('cifar', archive)
     if path is None:
         return None
+    try:
+        key = (file_key(path), sub_name)
+        if key not in _PARSED:
+            samples = []
+            with tarfile.open(path, mode='r') as f:
+                names = [m.name for m in f if sub_name in m.name]
+                assert names, "no %r members" % sub_name
+                for name in sorted(names):
+                    batch = pickle.load(f.extractfile(name),
+                                        encoding='bytes')
+                    data = batch[b'data']
+                    labels = batch.get(b'labels',
+                                       batch.get(b'fine_labels'))
+                    assert labels is not None
+                    for sample, label in zip(data, labels):
+                        # reference normalization (cifar read_batch)
+                        samples.append((
+                            (np.asarray(sample) / 255.0).astype(
+                                np.float32), int(label)))
+            _PARSED[key] = samples
+        samples = _PARSED[key]
+    except Exception as e:   # corrupt cache -> synthetic fallback
+        warnings.warn("cifar cache unreadable (%s); using synthetic "
+                      "fallback" % e)
+        return None
     _synth.mark_real_data()
 
     def reader():
-        with tarfile.open(path, mode='r') as f:
-            names = [m.name for m in f if sub_name in m.name]
-            for name in sorted(names):
-                batch = pickle.load(f.extractfile(name),
-                                    encoding='bytes')
-                data = batch[b'data']
-                labels = batch.get(b'labels',
-                                   batch.get(b'fine_labels'))
-                assert labels is not None
-                for sample, label in zip(data, labels):
-                    # reference normalization (cifar.py read_batch)
-                    yield (np.asarray(sample) / 255.0).astype(
-                        np.float32), int(label)
+        for sample in samples:
+            yield sample
     return reader
 
 

@@ -15,6 +15,13 @@ def data_home():
     return os.environ.get('PADDLE_TPU_DATA_HOME', DATA_HOME)
 
 
+def file_key(path):
+    """(path, mtime_ns, size): parse-memo key that invalidates when the
+    cached file is replaced in place."""
+    st = os.stat(path)
+    return (path, st.st_mtime_ns, st.st_size)
+
+
 def cached_path(module_name, filename, md5sum=None):
     """Path of a cached corpus file in the reference layout
     (<data_home>/<module>/<file>), or None when absent/corrupt. The

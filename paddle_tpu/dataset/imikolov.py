@@ -4,6 +4,7 @@ with <unk> last, <s>/<e> framed n-grams); otherwise a synthetic
 Zipf-skewed id stream over a fixed vocab."""
 import collections
 import tarfile
+import warnings
 
 from . import _synth
 from .common import cached_path
@@ -31,6 +32,15 @@ def build_dict(min_word_freq=50):
     path = cached_path('imikolov', _ARCHIVE)
     if path is None:
         return {('w%d' % i): i for i in range(N_VOCAB)}
+    try:
+        return _build_dict_real(path, min_word_freq)
+    except Exception as e:   # corrupt cache -> synthetic fallback
+        warnings.warn("imikolov cache unreadable (%s); using synthetic "
+                      "vocab" % e)
+        return {('w%d' % i): i for i in range(N_VOCAB)}
+
+
+def _build_dict_real(path, min_word_freq):
     with tarfile.open(path) as tf:
         trainf = tf.extractfile(_TRAIN_FILE)
         testf = tf.extractfile(_TEST_FILE)
@@ -54,6 +64,14 @@ def _real_ngram_reader(filename, word_idx, n):
     if unk_probe not in word_idx:
         # a dict without <unk> (e.g. the synthetic fallback vocab)
         # cannot index a real corpus; stay on the synthetic stream
+        return None
+    try:   # validate eagerly so a corrupt tgz falls back, not crashes
+        with tarfile.open(path) as tf:
+            if tf.extractfile(filename) is None:
+                raise IOError("missing member %s" % filename)
+    except Exception as e:
+        warnings.warn("imikolov cache unreadable (%s); using synthetic "
+                      "stream" % e)
         return None
     _synth.mark_real_data()
 

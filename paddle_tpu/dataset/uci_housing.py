@@ -19,15 +19,25 @@ _REAL = {}   # (path, mtime, size) -> (train_rows, test_rows)
 
 
 def _load_real(feature_num=14, ratio=0.8):
-    import os
+    from .common import file_key
+    import warnings
     path = cached_path('uci_housing', 'housing.data')
     if path is None:
         return None
-    st = os.stat(path)
-    key = (path, st.st_mtime_ns, st.st_size)
+    key = file_key(path)
     if key not in _REAL:
+        try:
+            _parse_real(path, key, feature_num, ratio)
+        except Exception as e:   # corrupt cache -> synthetic fallback
+            warnings.warn("uci_housing cache unreadable (%s); using "
+                          "synthetic fallback" % e)
+            return None
+    return _REAL[key]
+
+
+def _parse_real(path, key, feature_num, ratio):
+    if True:
         _REAL.clear()   # content changed: drop stale parses
-        _synth.mark_real_data()
         data = np.fromfile(path, sep=' ')
         data = data.reshape(data.shape[0] // feature_num, feature_num)
         maximums = data.max(axis=0)
@@ -38,7 +48,7 @@ def _load_real(feature_num=14, ratio=0.8):
                 maximums[i] - minimums[i])
         offset = int(data.shape[0] * ratio)
         _REAL[key] = (data[:offset], data[offset:])
-    return _REAL[key]
+        _synth.mark_real_data()
 
 
 def _real_reader(split_idx):

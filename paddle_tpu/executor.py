@@ -147,6 +147,28 @@ class Executor(object):
         return out
 
     def _state_names(self, program, scope):
+        # Steady-state steps skip the whole-block var scan: the result
+        # only changes when the program mutates (fingerprint) or the
+        # scope chain gains/loses vars. The memo lives ON the scope so
+        # it dies with it (no id()-reuse aliasing, no unbounded growth
+        # in a long-lived Executor).
+        census = 0
+        s = scope
+        while s is not None:
+            census += len(s.vars)
+            s = s.parent
+        memo = getattr(scope, '_state_names_memo', None)
+        if memo is None:
+            memo = scope._state_names_memo = {}
+        key = (program.fingerprint(), census)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        result = self._state_names_uncached(program, scope)
+        memo[key] = result
+        return result
+
+    def _state_names_uncached(self, program, scope):
         names_in, names_out = [], set()
         for b in program.blocks:
             for v in b.vars.values():

@@ -392,3 +392,45 @@ _alias('lstm', 'dynamic_lstm')
 _alias('lstmp', 'dynamic_lstmp')
 _alias('gru', 'dynamic_gru')
 _alias('smooth_l1_loss', 'smooth_l1')
+
+
+# ---- distributed markers --------------------------------------------------------
+@register_kernel('send_marker', side_effect=True)
+def _send_marker(ctx):
+    """Parity: operators/send_op.cc (gRPC push to a pserver). On the TPU
+    stack gradient exchange is implicit in the SPMD step (XLA psum over
+    ICI/DCN; see parallel/transpiler.py), so a Send inside a program
+    lowers to identity: each requested get_var receives the matching
+    send_var's value (the pserver round-trip is a no-op because the
+    'pserver state' is the locally sharded optimizer state). Registered
+    as a side-effect op so prune-to-fetches never drops it."""
+    xs = ctx.inputs('X')
+    for i, name in enumerate(ctx.output_names('Out')):
+        if xs:
+            ctx.env[name] = xs[min(i, len(xs) - 1)]
+
+
+@register_kernel('recv_marker', side_effect=True)
+def _recv_marker(ctx):
+    """Parity: operators/recv_op.cc. Identity for the same reason as
+    send_marker: parameters are already resident (replicated or
+    ZeRO-sharded) on every device. A reference-shaped recv (no X
+    inputs) materialises zeros for shaped outputs — the value arrives
+    via the sharded state, not this op."""
+    xs = ctx.inputs('X')
+    for i, name in enumerate(ctx.output_names('Out')):
+        if i < len(xs):
+            ctx.env[name] = xs[i]
+            continue
+        var = ctx.runner.block._find_var_recursive(name)
+        if var is not None and var.shape:
+            from ..core.lowering import runtime_dtype
+            ctx.env[name] = jnp.zeros(
+                var.shape, runtime_dtype(var.dtype))
+
+
+@register_kernel('listen_and_serv_marker', side_effect=True)
+def _listen_and_serv_marker(ctx):
+    """Parity: operators/listen_and_serv_op.cc (pserver gRPC loop). No
+    server exists on the TPU stack; the op is a no-op placeholder so
+    pserver-style launcher programs execute cleanly."""

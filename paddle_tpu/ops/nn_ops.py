@@ -31,14 +31,23 @@ def _conv2d(ctx):
     groups = ctx.attr('groups', 1) or 1
     if ctx.op.type == 'depthwise_conv2d':
         groups = x.shape[1]
-    from ..core.amp import mxu_compute
-    out = mxu_compute(
-        lambda a, b: jax.lax.conv_general_dilated(
+    from ..core.amp import mxu_compute, conv_layout
+    nhwc = conv_layout() == 'NHWC'
+
+    def conv(a, b):
+        # NHWC: channels-last on the TPU lanes; XLA cancels the
+        # transposes between back-to-back convs, leaving boundary ones
+        if nhwc:
+            a, b = a.transpose(0, 2, 3, 1), b.transpose(2, 3, 1, 0)
+        out = jax.lax.conv_general_dilated(
             a, b, window_strides=strides,
             padding=[(pads[0], pads[0]), (pads[1], pads[1])],
             rhs_dilation=dilations, feature_group_count=groups,
-            dimension_numbers=('NCHW', 'OIHW', 'NCHW')), x, w)
-    ctx.set_output('Output', out)
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC') if nhwc
+            else ('NCHW', 'OIHW', 'NCHW'))
+        return out.transpose(0, 3, 1, 2) if nhwc else out
+
+    ctx.set_output('Output', mxu_compute(conv, x, w))
 
 
 @register_kernel('conv2d_transpose')

@@ -134,3 +134,30 @@ def test_synthetic_fallback_without_cache(data_home):
     assert x.shape == (13,) and y.shape == (1,)
     word_idx = ds.imikolov.build_dict()
     assert len(word_idx) == 2074
+
+
+def test_corrupt_caches_fall_back_to_synthetic(data_home):
+    """A truncated/garbage cache warns and serves synthetic data instead
+    of crashing (cached_path contract)."""
+    (data_home / 'mnist').mkdir()
+    (data_home / 'uci_housing').mkdir()
+    (data_home / 'imikolov').mkdir()
+    (data_home / 'mnist' / 'train-images-idx3-ubyte.gz').write_bytes(
+        b'not gzip at all')
+    (data_home / 'mnist' / 'train-labels-idx1-ubyte.gz').write_bytes(
+        b'junk')
+    # 137 values: not a multiple of 14 -> reshape would fail
+    (data_home / 'uci_housing' / 'housing.data').write_text(
+        ' '.join(['1.0'] * 137))
+    (data_home / 'imikolov' / 'simple-examples.tgz').write_bytes(
+        b'\x00\x01broken')
+    ds.uci_housing._REAL.clear()
+    with pytest.warns(UserWarning):
+        img, lab = next(iter(ds.mnist.train()()))
+    assert img.shape == (784,)
+    with pytest.warns(UserWarning):
+        x, y = next(iter(ds.uci_housing.train()()))
+    assert x.shape == (13,)
+    with pytest.warns(UserWarning):
+        word_idx = ds.imikolov.build_dict()
+    assert len(word_idx) == 2074
