@@ -119,47 +119,62 @@ def _causal_attention(q, k, v, q_off=0, k_off=0):
 def ring_attention(q, k, v, axis_name='sp'):
     """Causal ring attention inside shard_map: the sequence dim is sharded
     over `axis_name`; KV blocks rotate around the ring (ppermute over ICI)
-    while each device keeps a running online-softmax accumulator. Memory per
-    device is O(T_local^2), never O(T^2).
+    while each device merges per-block (out, lse) partials by exact
+    logsumexp weighting. Memory per device: O(T_local) when the Pallas
+    kernel engages (TPU, 128-aligned blocks >= _FLASH_MIN_T),
+    O(T_local^2) on the XLA fallback — never O(T^2) either way.
+
+    Per ring step the held KV block is globally either entirely in the
+    PAST (full unmasked attention), the DIAGONAL (plain causal), or the
+    FUTURE (contributes nothing) — so each partial is computed by the
+    Pallas flash kernel (ops/pallas_kernels.flash_attention_with_lse;
+    XLA reference off-TPU) with NO positional offsets, and lse gradients
+    flow through the merge via the kernel's lse-aware backward.
 
     q,k,v: [B, T_local, H, Dh]. Returns [B, T_local, H, Dh].
     """
+    from ..ops.pallas_kernels import flash_attention_with_lse
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, T, H, Dh = q.shape
-    scale = 1.0 / math.sqrt(Dh)
-    qf = q.astype(jnp.bfloat16)
 
-    qpos = idx * T + jnp.arange(T)
+    def partial_block(k_cur, v_cur, kind):
+        # kind: 0 = past (full), 1 = diagonal (causal), 2 = future (skip)
+        def past(_):
+            return flash_attention_with_lse(q, k_cur, v_cur,
+                                            causal=False)
+        def diag(_):
+            return flash_attention_with_lse(q, k_cur, v_cur,
+                                            causal=True)
+        def future(_):
+            # finite "empty" sentinel: -inf would make 0 * nan gradients
+            # through logaddexp; exp(-1e30 - real_lse) is exactly 0
+            return (jnp.zeros_like(q),
+                    jnp.full((B, H, T), -1e30, jnp.float32))
+        return jax.lax.switch(kind, (past, diag, future), None)
 
     def step(carry, i):
-        o, m, l, k_cur, v_cur = carry
-        src = (idx - i) % n  # whose KV block we hold this step
-        kpos = src * T + jnp.arange(T)
-        s = jnp.einsum('bqhd,bkhd->bhqk', qf, k_cur.astype(jnp.bfloat16),
-                       preferred_element_type=jnp.float32) * scale
-        mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l = l * alpha + jnp.sum(p, axis=-1)
-        pv = jnp.einsum('bhqk,bkhd->bhqd', p.astype(jnp.bfloat16),
-                        v_cur.astype(jnp.bfloat16),
-                        preferred_element_type=jnp.float32)
-        o = o * alpha[..., None] + pv
+        acc, lse_acc, k_cur, v_cur = carry
+        src = (idx - i) % n            # whose KV block we hold this step
+        kind = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+        out_b, lse_b = partial_block(k_cur, v_cur, kind)
+        # exact merge of normalized partials by logsumexp weights
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        w_acc = jnp.exp(lse_acc - lse_new)
+        w_b = jnp.exp(lse_b - lse_new)
+        # weights are [B, H, T]; outputs are [B, T, H, Dh]
+        wt = lambda w: jnp.transpose(w, (0, 2, 1))[..., None]
+        acc = acc * wt(w_acc) + out_b.astype(jnp.float32) * wt(w_b)
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o, m_new, l, k_nxt, v_nxt), None
+        return (acc, lse_new, k_nxt, v_nxt), None
 
-    o0 = jnp.zeros((B, H, T, Dh), jnp.float32)
-    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
-    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v),
-                                      jnp.arange(n))
-    out = o / l[..., None]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    acc0 = jnp.zeros((B, T, H, Dh), jnp.float32)
+    lse0 = jnp.full((B, H, T), -1e30, jnp.float32)
+    (acc, _, _, _), _ = jax.lax.scan(step, (acc0, lse0, k, v),
+                                     jnp.arange(n))
+    return acc.astype(q.dtype)
 
 
 def _block(x, lp, cfg, attn_fn):

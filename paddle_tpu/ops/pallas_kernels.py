@@ -39,13 +39,11 @@ def _on_tpu():
 
 
 # ---- flash attention ------------------------------------------------------------
-def attention_reference(q, k, v, causal=True, q_off=0, k_off=0):
-    """Canonical masked-softmax attention, plain XLA. q,k,v: [B, T, H, D].
-
-    Single source of truth for the math: the Pallas kernel's parity tests,
-    flash_attention's off-TPU fallback, its custom-vjp backward, AND the
-    transformer model's blockwise/ring path (which passes q_off/k_off for
-    the global positions of local blocks) all call this."""
+def attention_reference_with_lse(q, k, v, causal=True, q_off=0, k_off=0):
+    """Masked-softmax attention + per-row logsumexp, plain XLA.
+    q,k,v: [B, T, H, D] -> (out [B, T, H, D], lse [B, H, T]). The lse
+    output is what lets ring attention merge per-block partial results
+    exactly (see models/transformer.py::ring_attention)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -54,9 +52,21 @@ def attention_reference(q, k, v, causal=True, q_off=0, k_off=0):
         kpos = k_off + jnp.arange(k.shape[1])
         mask = qpos[:, None] >= kpos[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum('bhqk,bkhd->bqhd', p, v,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)     # [B, H, Tq]
+    p = jnp.exp(s - lse[..., None]).astype(q.dtype)
+    out = jnp.einsum('bhqk,bkhd->bqhd', p, v,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return out, lse
+
+
+def attention_reference(q, k, v, causal=True, q_off=0, k_off=0):
+    """Canonical masked-softmax attention, plain XLA. q,k,v: [B, T, H, D].
+
+    Single source of truth for the math: the Pallas kernel's parity tests,
+    flash_attention's off-TPU fallback, AND the transformer model's
+    blockwise/ring path (which passes q_off/k_off for the global positions
+    of local blocks) all call this."""
+    return attention_reference_with_lse(q, k, v, causal, q_off, k_off)[0]
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
@@ -279,15 +289,21 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
-                      interpret):
+                      interpret, g_lse=None):
     """Blockwise backward on [BH, T, D] operands: O(T) memory, never
     materialises the [T, T] score matrix (ADVICE r1: the old backward
-    recomputed full attention through XLA)."""
+    recomputed full attention through XLA).
+
+    g_lse (optional [BH, T, 1]): cotangent of the logsumexp output. The
+    chain rule folds it straight into the delta term — ds = p*(dp -
+    delta + g_lse) — because dlse/ds_ij = p_ij; dv is unaffected."""
     BH, T, D = q.shape
     n_qb = T // block_q
     n_kb = T // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)       # [BH, T, 1]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     kb_map = _kb_clamp(causal, block_q, block_k, n_kb)
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q,
@@ -345,29 +361,40 @@ def _from_bh(x, B, H):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
+    (out, lse), _ = _flash_lse_fwd(q, k, v, causal, block_q, block_k,
+                                   interpret)
+    return out, lse
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
     B, T, H, D = q.shape
     qn, kn, vn = _to_bh(q), _to_bh(k), _to_bh(v)
     on, lse = _flash_pallas_call(qn, kn, vn, causal, block_q, block_k,
                                  interpret)
-    return _from_bh(on, B, H), (qn, kn, vn, on, lse, B, H)
+    lse_bht = lse[..., 0].reshape(B, H, T)
+    return ((_from_bh(on, B, H), lse_bht),
+            (qn, kn, vn, on, lse, B, H))
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_lse_bwd(causal, block_q, block_k, interpret, res, g):
     # Blockwise Pallas backward: O(T) memory, recomputes p from the saved
-    # logsumexp rather than materialising [T, T] (ADVICE r1).
+    # logsumexp rather than materialising [T, T] (ADVICE r1). The lse
+    # cotangent (nonzero when ring attention merges partial blocks)
+    # folds into the delta term.
+    g_out, g_lse = g
     qn, kn, vn, on, lse, B, H = res
-    dq, dk, dv = _flash_bwd_pallas(qn, kn, vn, on, lse, _to_bh(g),
-                                   causal, block_q, block_k, interpret)
+    BH, T, _ = qn.shape
+    g_lse_n = None
+    if g_lse is not None:
+        g_lse_n = jnp.asarray(g_lse).reshape(BH, T, 1)
+    dq, dk, dv = _flash_bwd_pallas(qn, kn, vn, on, lse, _to_bh(g_out),
+                                   causal, block_q, block_k, interpret,
+                                   g_lse=g_lse_n)
     return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H))
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _pick_block(T, target):
@@ -399,6 +426,20 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=256,
     or for non-128-aligned shapes, the identical-math XLA reference runs
     instead.
     """
+    return flash_attention_with_lse(q, k, v, causal, block_q, block_k,
+                                    interpret)[0]
+
+
+def flash_attention_with_lse(q, k, v, causal=True, block_q=512,
+                             block_k=256, interpret=None):
+    """flash_attention that also returns per-row logsumexp [B, H, T].
+
+    This is the ring-attention building block: each device computes its
+    local (out, lse) partials per KV block and merges them exactly via
+    logsumexp weighting — gradients flow through BOTH outputs (the lse
+    cotangent folds into the Pallas backward's delta term). Engagement
+    policy identical to flash_attention; falls back to the XLA
+    reference (with lse) elsewhere."""
     T = q.shape[1]
     if interpret is None:
         interpret = False
@@ -409,8 +450,8 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=256,
     if bq is None or bk is None:
         use_pallas = False
     if not use_pallas:
-        return attention_reference(q, k, v, causal)
-    return _flash(q, k, v, causal, bq, bk, interpret)
+        return attention_reference_with_lse(q, k, v, causal)
+    return _flash_lse(q, k, v, causal, bq, bk, interpret)
 
 
 # ---- fused LSTM cell ------------------------------------------------------------

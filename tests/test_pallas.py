@@ -107,13 +107,13 @@ def test_pallas_path_engages_for_transformer_shapes(monkeypatch):
     """The kernel must actually fire for the flagship transformer's
     shapes (VERDICT r1: no test asserted the Pallas path engages)."""
     fired = []
-    orig = pk._flash
+    orig = pk._flash_lse
 
     def spy(*args, **kwargs):
         fired.append(True)
         return orig(*args, **kwargs)
 
-    monkeypatch.setattr(pk, '_flash', spy)
+    monkeypatch.setattr(pk, '_flash_lse', spy)
     rng = np.random.RandomState(5)
     B, T, H, D = 2, 512, 8, 64   # entry()'s flagship attention shape
     q = jnp.asarray(rng.randn(B, T, H, D).astype('float32'))
@@ -191,3 +191,80 @@ def test_fused_lstm_engages_in_scan_with_grads(monkeypatch):
     fused = build_and_train()      # Pallas kernel body via interpret
     assert calls, "fused path never engaged"
     np.testing.assert_allclose(fused, baseline, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_with_lse_matches_reference_including_lse_grads():
+    """flash_attention_with_lse: out AND lse match, and gradients flow
+    correctly through BOTH outputs (the lse cotangent folds into the
+    backward's delta term — the ring-attention merge depends on it)."""
+    import jax
+    rng = np.random.RandomState(7)
+    B, T, H, D = 2, 256, 2, 64
+    q = jnp.asarray(rng.randn(B, T, H, D) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D) * 0.5, jnp.float32)
+    go = jnp.asarray(rng.randn(B, T, H, D) * 0.1, jnp.float32)
+    gl = jnp.asarray(rng.randn(B, H, T) * 0.1, jnp.float32)
+
+    for causal in (True, False):
+        op, lp = pk.flash_attention_with_lse(
+            q, k, v, causal=causal, block_q=128, block_k=128,
+            interpret=True)
+        orf, lrf = pk.attention_reference_with_lse(q, k, v,
+                                                   causal=causal)
+        np.testing.assert_allclose(np.asarray(op), np.asarray(orf),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(lrf),
+                                   rtol=2e-4, atol=2e-5)
+
+        def loss_p(q, k, v):
+            o, l = pk.flash_attention_with_lse(
+                q, k, v, causal=causal, block_q=128, block_k=128,
+                interpret=True)
+            return jnp.sum(o * go) + jnp.sum(l * gl)
+
+        def loss_r(q, k, v):
+            o, l = pk.attention_reference_with_lse(q, k, v,
+                                                   causal=causal)
+            return jnp.sum(o * go) + jnp.sum(l * gl)
+
+        gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_uses_flash_kernel(monkeypatch):
+    """With 128-aligned local blocks the ring path really runs the
+    Pallas kernel for its partials."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from paddle_tpu.models import transformer as T
+
+    fired = []
+    orig = pk._flash_lse
+
+    def spy(q, k, v, causal, bq, bk, interpret):
+        fired.append(True)
+        return orig(q, k, v, causal, bq, bk, interpret)
+
+    # force kernel engagement off-TPU: route through interpret mode
+    monkeypatch.setattr(
+        pk, 'flash_attention_with_lse',
+        lambda q, k, v, causal=True, **kw: spy(q, k, v, causal, 128,
+                                               128, True))
+    devs = np.asarray(jax.devices()[:2]).reshape(2,)
+    mesh = Mesh(devs, ('sp',))
+    rng = np.random.RandomState(1)
+    B, Tt, H, D = 1, 256, 2, 64   # T_local = 128
+    q = jnp.asarray(rng.randn(B, Tt, H, D) * 0.5, jnp.float32)
+    ring = shard_map(lambda q, k, v: T.ring_attention(q, k, v, 'sp'),
+                     mesh=mesh,
+                     in_specs=(P(None, 'sp'),) * 3,
+                     out_specs=P(None, 'sp'), check_rep=False)
+    out = np.asarray(jax.jit(ring)(q, q, q))
+    assert fired, "Pallas kernel did not engage inside ring attention"
+    ref = np.asarray(pk.attention_reference(q, q, q, causal=True))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
