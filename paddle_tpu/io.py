@@ -229,15 +229,52 @@ SUCCESS_MARK_FILENAME = "_SUCCESS"
 CHECKPOINT_PREFIX = "checkpoint"
 
 
+_ORBAX_SUBDIR = '__orbax__'
+
+
+def _orbax_checkpointer():
+    """PyTreeCheckpointer or None. Orbax is the TPU-native checkpoint
+    format (sharded-array aware, atomic renames); npz remains both the
+    fallback and the inference-model format."""
+    try:
+        import orbax.checkpoint as ocp
+        return ocp.PyTreeCheckpointer()
+    except Exception:
+        return None
+
+
 def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
-                    save_interval_secs=600, main_program=None):
+                    save_interval_secs=600, main_program=None,
+                    backend='auto'):
+    """backend: 'auto' (orbax when importable), 'orbax', or 'npz'."""
+    if backend not in ('auto', 'orbax', 'npz'):
+        raise ValueError("backend must be 'auto', 'orbax' or 'npz', "
+                         "got %r" % (backend,))
     if checkpoint_dir is None:
         checkpoint_dir = os.getcwd()
     serials = _get_checkpoint_serials(checkpoint_dir)
     serial = (max(serials) + 1) if serials else 0
     cur_dir = os.path.join(checkpoint_dir,
                            "%s_%d" % (CHECKPOINT_PREFIX, serial))
-    save_persistables(executor, cur_dir, main_program)
+    if os.path.isdir(cur_dir):
+        # leftover of an interrupted save (no _SUCCESS mark): clear it,
+        # orbax refuses to overwrite an existing directory
+        shutil.rmtree(cur_dir)
+    ckptr = _orbax_checkpointer() if backend in ('auto', 'orbax') else None
+    if backend == 'orbax' and ckptr is None:
+        raise RuntimeError("orbax backend requested but not importable")
+    if ckptr is not None:
+        program = main_program or default_main_program()
+        scope = global_scope()
+        state = {}
+        for var in filter(is_persistable, program.list_vars()):
+            val = scope.find_var(var.name)
+            if val is not None:
+                state[var.name] = np.asarray(as_numpy(val))
+        os.makedirs(cur_dir, exist_ok=True)
+        ckptr.save(os.path.join(cur_dir, _ORBAX_SUBDIR), state)
+    else:
+        save_persistables(executor, cur_dir, main_program)
     open(os.path.join(cur_dir, SUCCESS_MARK_FILENAME), 'w').close()
     serials = _get_checkpoint_serials(checkpoint_dir)
     for s in sorted(serials)[:-max_num_checkpoints]:
@@ -256,7 +293,30 @@ def load_checkpoint(executor, checkpoint_dir=None, serial=None,
     serial = serial if serial is not None else max(serials)
     cur_dir = os.path.join(checkpoint_dir,
                            "%s_%d" % (CHECKPOINT_PREFIX, serial))
-    load_persistables(executor, cur_dir, main_program)
+    orbax_dir = os.path.join(cur_dir, _ORBAX_SUBDIR)
+    if os.path.isdir(orbax_dir):
+        ckptr = _orbax_checkpointer()
+        if ckptr is None:
+            raise RuntimeError(
+                "checkpoint %s was written by orbax but orbax is not "
+                "importable" % cur_dir)
+        state = ckptr.restore(orbax_dir)
+        scope = global_scope()
+        program = main_program or default_main_program()
+        wanted = {v.name: v for v in filter(is_persistable,
+                                            program.list_vars())}
+        from .core.lowering import runtime_dtype
+        import jax.numpy as jnp
+        for name, val in state.items():
+            var = wanted.get(name)
+            if var is None:
+                continue
+            # same dtype coercion as load_vars: the runtime is 32-bit
+            arr = np.asarray(val)
+            dt = runtime_dtype(var.dtype if var.dtype else str(arr.dtype))
+            scope.set_var(name, jnp.asarray(arr.astype(dt)))
+    else:
+        load_persistables(executor, cur_dir, main_program)
     return cur_dir
 
 

@@ -1,7 +1,10 @@
 """save/load vars + inference model + checkpoints + reader decorators +
 datasets (SURVEY.md §4; parity: tests/unittests/test_io_save_load*,
 tests/test_reader, dataset smoke tests)."""
+import os
+
 import numpy as np
+import pytest
 
 import paddle_tpu
 import paddle_tpu.fluid as fluid
@@ -145,3 +148,96 @@ def test_recordio_write_read_roundtrip(tmp_path):
     payloads = [bytes([i]) * (i + 1) for i in range(5)]
     loader.write_records(path, payloads)
     assert list(loader.read_records(path)) == payloads
+
+
+def test_orbax_checkpoint_roundtrip_and_rotation(tmp_path):
+    """save/load_checkpoint through the orbax backend: train, save,
+    perturb, load -> params restored; rotation keeps max_num."""
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.io as pio
+
+    pytest.importorskip('orbax.checkpoint')
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1, name='ckpt_fc')
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(8, 4).astype('float32'),
+            'y': rng.randn(8, 1).astype('float32')}
+    scope = fluid.Scope()
+    ckdir = str(tmp_path / 'ck')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        w_name = [v.name for v in main.global_block().all_parameters()
+                  if 'w' in v.name][0]
+        w_saved = np.asarray(scope.find_var(w_name)).copy()
+        # also covers momentum accumulator state
+        for i in range(5):   # rotation: 5 saves, keep 3
+            d = pio.save_checkpoint(exe, ckdir, max_num_checkpoints=3,
+                                    main_program=main)
+        assert os.path.isdir(os.path.join(d, '__orbax__'))
+        import glob
+        assert len(glob.glob(os.path.join(ckdir, 'checkpoint_*'))) == 3
+        # clobber the weights, then restore
+        scope.set_var(w_name, np.zeros_like(w_saved))
+        pio.load_checkpoint(exe, ckdir, main_program=main)
+        np.testing.assert_allclose(np.asarray(scope.find_var(w_name)),
+                                   w_saved, rtol=1e-6)
+        # training continues from the restored state
+        out = exe.run(main, feed=feed, fetch_list=[loss])[0]
+        assert np.isfinite(np.asarray(out)).all()
+
+
+def test_npz_checkpoint_backend_still_works(tmp_path):
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.io as pio
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                backend='npz')
+        assert not os.path.isdir(os.path.join(d, '__orbax__'))
+        pio.load_checkpoint(exe, str(tmp_path), main_program=main)
+
+
+def test_interrupted_checkpoint_save_recovers(tmp_path):
+    """A stale serial dir without _SUCCESS (interrupted save) must not
+    wedge future saves."""
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.io as pio
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # simulate the wreck of an interrupted save at serial 0
+        stale = tmp_path / 'checkpoint_0'
+        (stale / '__orbax__').mkdir(parents=True)
+        (stale / '__orbax__' / 'junk').write_text('partial')
+        d = pio.save_checkpoint(exe, str(tmp_path), main_program=main)
+        assert os.path.exists(os.path.join(d, '_SUCCESS'))
+        pio.load_checkpoint(exe, str(tmp_path), main_program=main)
+
+
+def test_checkpoint_rejects_unknown_backend(tmp_path):
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.io as pio
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(ValueError):
+        pio.save_checkpoint(exe, str(tmp_path), backend='Orbax')
