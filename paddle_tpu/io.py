@@ -264,13 +264,18 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
     if backend == 'orbax' and ckptr is None:
         raise RuntimeError("orbax backend requested but not importable")
     if ckptr is not None:
+        import jax
         program = main_program or default_main_program()
         scope = global_scope()
         state = {}
         for var in filter(is_persistable, program.list_vars()):
             val = scope.find_var(var.name)
-            if val is not None:
-                state[var.name] = np.asarray(as_numpy(val))
+            if val is None:
+                continue
+            # jax.Arrays go to orbax directly so sharded saves stay
+            # sharded (no host gather); everything else via numpy
+            state[var.name] = val if isinstance(val, jax.Array) \
+                else np.asarray(as_numpy(val))
         os.makedirs(cur_dir, exist_ok=True)
         ckptr.save(os.path.join(cur_dir, _ORBAX_SUBDIR), state)
     else:

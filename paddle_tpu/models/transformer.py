@@ -247,6 +247,190 @@ def _adam_update(params, grads, opt, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8):
     return new_p, {'m': m, 'v': v, 't': t}
 
 
+# ---------------------------------------------------------------------------
+# pipeline parallelism (pp axis)
+# ---------------------------------------------------------------------------
+def stack_pipeline_params(params, cfg, n_stages):
+    """Per-layer trees l0..l{L-1} -> one 'layers' tree whose leaves are
+    [n_stages, L/n_stages, ...] (stage-major), ready to shard over the
+    'pp' mesh axis on dim 0. Non-layer params pass through."""
+    L = cfg.n_layers
+    assert L % n_stages == 0, (L, n_stages)
+    per = L // n_stages
+    layer_trees = [params['l%d' % i] for i in range(L)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs).reshape((n_stages, per) + xs[0].shape),
+        *layer_trees)
+    rest = {k: v for k, v in params.items() if not _is_layer_key(k)}
+    rest['layers'] = stacked
+    return rest
+
+
+def unstack_pipeline_params(params, cfg):
+    """Inverse of stack_pipeline_params."""
+    stacked = params['layers']
+    L = cfg.n_layers
+    out = {k: v for k, v in params.items() if k != 'layers'}
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((L,) + x.shape[2:]), stacked)
+    for i in range(L):
+        out['l%d' % i] = jax.tree_util.tree_map(lambda x: x[i], flat)
+    return out
+
+
+def _is_layer_key(k):
+    return k.startswith('l') and k[1:].isdigit()
+
+
+def make_pipeline_fn(cfg, mesh, attn_fn, n_micro, axis_name='pp'):
+    """The pipelined middle of the network: [B, T, D] -> [B, T, D]
+    through all transformer blocks, GPipe fill/drain over the pp axis.
+
+    shard_map covers ONLY the block stack — embedding/ln_f/unembed stay
+    outside under the SPMD partitioner, so shard_map's replication rules
+    insert the right gradient psums (activations enter replicated over
+    pp; stage weights enter sharded over pp). Per tick every stage runs
+    its local layers and ppermutes the activation to the next stage;
+    stage 0 injects microbatch t, the last stage collects microbatch
+    t-(S-1). Bubble fraction is (S-1)/(n_micro+S-1).
+    """
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    S = axes[axis_name]
+    per = cfg.n_layers // S
+    if attn_fn is None:
+        from ..ops.pallas_kernels import flash_attention
+        attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+
+    def leaf_spec(x):
+        return P(*((axis_name,) + (None,) * (x.ndim - 1)))
+
+    def run(layers, x):
+        # layers leaves arrive [1, per, ...]; x arrives [B_local, T, D]
+        layers = jax.tree_util.tree_map(lambda v: v[0], layers)
+        stage = jax.lax.axis_index(axis_name)
+        B, T, D = x.shape
+        assert B % n_micro == 0, (B, n_micro)
+        bm = B // n_micro
+        x_micro = x.reshape(n_micro, bm, T, D)
+
+        blk = _block
+        if cfg.remat:
+            blk = jax.checkpoint(_block, static_argnums=(2, 3))
+
+        def apply_stage(h):
+            for j in range(per):
+                lp = jax.tree_util.tree_map(lambda v: v[j], layers)
+                h = blk(h, lp, cfg, attn_fn)
+            return h
+
+        def tick(carry, t):
+            state, outbuf = carry
+            inj = x_micro[jnp.minimum(t, n_micro - 1)]
+            x_in = jnp.where(stage == 0, inj, state)
+            y = apply_stage(x_in)
+            out_t = t - (S - 1)
+            idx = jnp.clip(out_t, 0, n_micro - 1)
+            is_out = (stage == S - 1) & (out_t >= 0)
+            cur = jax.lax.dynamic_index_in_dim(outbuf, idx, 0,
+                                               keepdims=False)
+            outbuf = jax.lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(is_out, y, cur), idx, 0)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            state = jax.lax.ppermute(y, axis_name, perm)
+            return (state, outbuf), None
+
+        state0 = jnp.zeros((bm, T, D), x.dtype)
+        outbuf0 = jnp.zeros((n_micro, bm, T, D), x.dtype)
+        (_, outbuf), _ = jax.lax.scan(
+            tick, (state0, outbuf0), jnp.arange(n_micro + S - 1))
+        # outputs live on the last stage; replicate them over pp
+        outbuf = jax.lax.psum(
+            jnp.where(stage == S - 1, outbuf, jnp.zeros_like(outbuf)),
+            axis_name)
+        return outbuf.reshape(B, T, D)
+
+    sample_layers = jax.eval_shape(
+        lambda: stack_pipeline_params(init_params(cfg, 0), cfg,
+                                      S))['layers']
+    layers_specs = jax.tree_util.tree_map(leaf_spec, sample_layers)
+    batch_axis = 'dp' if axes.get('dp', 1) > 1 else None
+    return functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(layers_specs, P(batch_axis, None, None)),
+        out_specs=P(batch_axis, None, None),
+        check_vma=False)(run)
+
+
+def forward_pipelined(params, tokens, cfg, pipe_fn, pos_offset=0):
+    """Pipelined forward: embed -> pp block pipeline -> ln_f/unembed.
+    params must be in stacked form (stack_pipeline_params)."""
+    dt = cfg.dtype
+    x = params['embed'].astype(dt)[tokens]
+    T = tokens.shape[1]
+    x = x + jax.lax.dynamic_slice_in_dim(
+        params['pos'].astype(dt), pos_offset, T, 0)[None]
+    x = pipe_fn(params['layers'], x)
+    x = _layer_norm(x, params['ln_f_g'], params['ln_f_b'])
+    return (x @ params['embed'].astype(dt).T).astype(jnp.float32)
+
+
+def pipeline_param_specs(cfg, n_stages, mesh=None):
+    """PartitionSpecs for the stacked form: stage dim over 'pp',
+    everything else from param_specs' non-layer entries (axis names
+    absent from `mesh` degrade to replicated)."""
+    base = param_specs(cfg)
+    specs = {k: v for k, v in base.items() if not _is_layer_key(k)}
+    if mesh is not None:
+        from ..parallel.mesh import clean_spec
+        specs = jax.tree_util.tree_map(
+            lambda s: P(*clean_spec(tuple(s), mesh)), specs,
+            is_leaf=lambda x: isinstance(x, P))
+    sample = jax.eval_shape(
+        lambda: stack_pipeline_params(init_params(cfg, 0), cfg,
+                                      n_stages))['layers']
+    specs['layers'] = jax.tree_util.tree_map(
+        lambda x: P(*(('pp',) + (None,) * (x.ndim - 1))), sample)
+    return specs
+
+
+def make_pipeline_train_step(cfg, mesh, lr=1e-3, n_micro=4):
+    """(stacked_params, opt, inputs, targets) -> (loss, params', opt')
+    with pipeline parallelism over the mesh's 'pp' axis (+ dp batch
+    sharding). v1 scope: dp x pp meshes (tensor/sequence axes compose
+    via make_train_step instead)."""
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert axes.get('pp', 1) > 1, "mesh has no pp axis"
+    pipe_fn = make_pipeline_fn(cfg, mesh, None, n_micro)
+
+    pspecs = pipeline_param_specs(cfg, axes['pp'], mesh)
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    opt_sh = {'m': param_sh, 'v': param_sh,
+              't': NamedSharding(mesh, P())}
+    tok_sh = NamedSharding(mesh, P('dp') if axes.get('dp', 1) > 1
+                           else P())
+
+    def loss_pp(params, inputs, targets):
+        logits = forward_pipelined(params, inputs, cfg, pipe_fn)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    def step(params, opt, inputs, targets):
+        loss, grads = jax.value_and_grad(loss_pp)(params, inputs,
+                                                  targets)
+        new_params, new_opt = _adam_update(params, grads, opt, lr)
+        return loss, new_params, new_opt
+
+    return jax.jit(
+        step,
+        in_shardings=(param_sh, opt_sh, tok_sh, tok_sh),
+        out_shardings=(NamedSharding(mesh, P()), param_sh, opt_sh),
+        donate_argnums=(0, 1))
+
+
 def make_train_step(cfg, mesh, lr=1e-3, seq_parallel=None):
     """One jitted (params, opt, tokens) -> (loss, params', opt') step over
     `mesh`. Sequence parallelism (ring attention) activates when the mesh
