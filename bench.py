@@ -135,6 +135,52 @@ def bench_lstm(on_tpu):
             'last_loss': round(last, 4)}
 
 
+def bench_transformer(on_tpu):
+    """Flagship transformer (Pallas flash attention fwd+bwd) tokens/sec
+    at the long-context shape; no reference baseline — this is the
+    framework's own long-context headline."""
+    import time
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer as T
+    if on_tpu:
+        B, S = 2, 2048
+        cfg = T.TransformerConfig(vocab=8192, d_model=1024, n_heads=16,
+                                  n_layers=6, d_ff=4096, max_len=S)
+        steps = 10
+    else:
+        B, S = 2, 128
+        cfg = T.TransformerConfig(vocab=512, d_model=64, n_heads=2,
+                                  n_layers=2, d_ff=128, max_len=S)
+        steps = 2
+    params = T.init_params(cfg, seed=0)
+    opt = T.init_adam_state(params)
+    toks = np.random.RandomState(0).randint(
+        0, cfg.vocab, (B, S + 1)).astype(np.int32)
+    inputs, targets = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+
+    @jax.jit
+    def step(params, opt, inputs, targets):
+        loss, grads = jax.value_and_grad(T.loss_fn)(params, inputs,
+                                                    targets, cfg)
+        new_p, new_o = T._adam_update(params, grads, opt)
+        return loss, new_p, new_o
+
+    loss, params, opt = step(params, opt, inputs, targets)
+    float(loss)   # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt = step(params, opt, inputs, targets)
+    last = float(loss)
+    dt = time.perf_counter() - t0
+    tps = steps * B * S / dt
+    log('transformer: %.0f tok/s (B %d, S %d, %d layers, loss %.3f)' %
+        (tps, B, S, cfg.n_layers, last))
+    return {'tokens_per_sec': round(tps, 2), 'batch_size': B,
+            'seq_len': S, 'n_layers': cfg.n_layers,
+            'last_loss': round(last, 4)}
+
+
 def main():
     record = {
         'metric': 'resnet50_train_images_per_sec_per_chip',
@@ -195,6 +241,13 @@ def main():
     except Exception as e:
         record['lstm_error'] = '%s: %s' % (type(e).__name__, str(e)[:500])
         log('lstm bench failed: %s' % record['lstm_error'])
+
+    try:
+        record['transformer'] = bench_transformer(on_tpu)
+    except Exception as e:
+        record['transformer_error'] = '%s: %s' % (type(e).__name__,
+                                                  str(e)[:500])
+        log('transformer bench failed: %s' % record['transformer_error'])
 
     print(json.dumps(_finite(record)), flush=True)
     return 0

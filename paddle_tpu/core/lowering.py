@@ -245,6 +245,13 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
                 loss = genv[loss_name]
                 return jnp.sum(loss), genv
 
+            if getattr(program, '_remat', False):
+                # memory_optimize() hint: rematerialize the forward
+                # segment in the backward pass (activation memory traded
+                # for recompute FLOPs — the TPU-meaningful analogue of
+                # the reference's liveness-based buffer reuse)
+                g = jax.checkpoint(g)
+
             param_vals = {p: env[p] for p in param_names}
             from .. import profiler as _prof
             _profiling = _prof.op_profiling_enabled() and not any(

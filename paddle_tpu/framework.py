@@ -358,6 +358,9 @@ class Program(object):
         self._version = 0
         self._fingerprint_cache = None
         self._op_role = 'forward'
+        # memory_optimize() hint: lowering wraps the forward segment in
+        # jax.checkpoint so backward rematerializes activations
+        self._remat = False
 
     # ---- structure --------------------------------------------------------------
     def global_block(self):
@@ -388,7 +391,8 @@ class Program(object):
     def fingerprint(self):
         if self._fingerprint_cache is None or \
                 self._fingerprint_cache[0] != self._version:
-            desc = json.dumps([b._desc() for b in self.blocks],
+            desc = json.dumps([self._remat] +
+                              [b._desc() for b in self.blocks],
                               default=str, sort_keys=True)
             h = hashlib.sha1(desc.encode()).hexdigest()
             self._fingerprint_cache = (self._version, h)
@@ -398,6 +402,7 @@ class Program(object):
     def clone(self, for_test=False):
         p = Program()
         p.random_seed = self.random_seed
+        p._remat = self._remat
         p.blocks = []
         memo = {}
         for b in self.blocks:

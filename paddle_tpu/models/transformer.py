@@ -28,7 +28,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ['TransformerConfig', 'init_params', 'forward', 'loss_fn',
-           'make_train_step', 'param_specs', 'ring_attention']
+           'make_train_step', 'param_specs', 'ring_attention',
+           'stack_pipeline_params', 'unstack_pipeline_params',
+           'make_pipeline_fn', 'forward_pipelined',
+           'pipeline_param_specs', 'make_pipeline_train_step',
+           'shard_params', 'init_adam_state']
 
 
 class TransformerConfig(object):
@@ -301,9 +305,6 @@ def make_pipeline_fn(cfg, mesh, attn_fn, n_micro, axis_name='pp'):
         from ..ops.pallas_kernels import flash_attention
         attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
 
-    def leaf_spec(x):
-        return P(*((axis_name,) + (None,) * (x.ndim - 1)))
-
     def run(layers, x):
         # layers leaves arrive [1, per, ...]; x arrives [B_local, T, D]
         layers = jax.tree_util.tree_map(lambda v: v[0], layers)
@@ -349,10 +350,7 @@ def make_pipeline_fn(cfg, mesh, attn_fn, n_micro, axis_name='pp'):
             axis_name)
         return outbuf.reshape(B, T, D)
 
-    sample_layers = jax.eval_shape(
-        lambda: stack_pipeline_params(init_params(cfg, 0), cfg,
-                                      S))['layers']
-    layers_specs = jax.tree_util.tree_map(leaf_spec, sample_layers)
+    layers_specs = _stacked_layer_specs(cfg, S, axis_name)
     batch_axis = 'dp' if axes.get('dp', 1) > 1 else None
     return functools.partial(
         jax.shard_map, mesh=mesh,
@@ -374,8 +372,18 @@ def forward_pipelined(params, tokens, cfg, pipe_fn, pos_offset=0):
     return (x @ params['embed'].astype(dt).T).astype(jnp.float32)
 
 
-def pipeline_param_specs(cfg, n_stages, mesh=None):
-    """PartitionSpecs for the stacked form: stage dim over 'pp',
+def _stacked_layer_specs(cfg, n_stages, axis_name='pp'):
+    """PartitionSpec tree for stack_pipeline_params' 'layers' entry:
+    stage dim over `axis_name`, everything else replicated."""
+    sample = jax.eval_shape(
+        lambda: stack_pipeline_params(init_params(cfg, 0), cfg,
+                                      n_stages))['layers']
+    return jax.tree_util.tree_map(
+        lambda x: P(*((axis_name,) + (None,) * (x.ndim - 1))), sample)
+
+
+def pipeline_param_specs(cfg, n_stages, mesh=None, axis_name='pp'):
+    """PartitionSpecs for the stacked form: stage dim over `axis_name`,
     everything else from param_specs' non-layer entries (axis names
     absent from `mesh` degrade to replicated)."""
     base = param_specs(cfg)
@@ -385,24 +393,21 @@ def pipeline_param_specs(cfg, n_stages, mesh=None):
         specs = jax.tree_util.tree_map(
             lambda s: P(*clean_spec(tuple(s), mesh)), specs,
             is_leaf=lambda x: isinstance(x, P))
-    sample = jax.eval_shape(
-        lambda: stack_pipeline_params(init_params(cfg, 0), cfg,
-                                      n_stages))['layers']
-    specs['layers'] = jax.tree_util.tree_map(
-        lambda x: P(*(('pp',) + (None,) * (x.ndim - 1))), sample)
+    specs['layers'] = _stacked_layer_specs(cfg, n_stages, axis_name)
     return specs
 
 
-def make_pipeline_train_step(cfg, mesh, lr=1e-3, n_micro=4):
+def make_pipeline_train_step(cfg, mesh, lr=1e-3, n_micro=4,
+                             axis_name='pp'):
     """(stacked_params, opt, inputs, targets) -> (loss, params', opt')
     with pipeline parallelism over the mesh's 'pp' axis (+ dp batch
     sharding). v1 scope: dp x pp meshes (tensor/sequence axes compose
     via make_train_step instead)."""
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    assert axes.get('pp', 1) > 1, "mesh has no pp axis"
-    pipe_fn = make_pipeline_fn(cfg, mesh, None, n_micro)
+    assert axes.get(axis_name, 1) > 1, "mesh has no %s axis" % axis_name
+    pipe_fn = make_pipeline_fn(cfg, mesh, None, n_micro, axis_name)
 
-    pspecs = pipeline_param_specs(cfg, axes['pp'], mesh)
+    pspecs = pipeline_param_specs(cfg, axes[axis_name], mesh, axis_name)
     param_sh = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), pspecs,
         is_leaf=lambda x: isinstance(x, P))

@@ -135,12 +135,22 @@ class DistributeTranspilerSimple(DistributeTranspiler):
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level=0):
-    """Parity: memory_optimization_transpiler.memory_optimize. Buffer
-    liveness/reuse is handled by XLA; donation of persistable state is
-    already performed by the Executor. No-op that keeps the API."""
+    """Parity: memory_optimization_transpiler.memory_optimize.
+
+    Buffer liveness/reuse is XLA's job and persistable state is already
+    donated by the Executor; what the TPU stack CAN still trade is
+    activation memory for recompute. This marks the program for
+    rematerialization: the lowering wraps the forward segment of a
+    training step in ``jax.checkpoint``, so the backward pass
+    recomputes activations instead of keeping them live — the moral
+    equivalent of the reference's in-place variable reuse, aimed at the
+    memory that actually dominates on TPU."""
+    input_program._remat = True
+    input_program._bump_version()
     if print_log:
-        print("[paddle_tpu] memory_optimize: buffer reuse delegated to "
-              "XLA; persistable state donated by the executor.")
+        print("[paddle_tpu] memory_optimize: forward segment marked for "
+              "rematerialization (jax.checkpoint); buffer reuse is "
+              "XLA's, persistable state donated by the executor.")
     return input_program
 
 

@@ -137,3 +137,41 @@ def test_lr_scheduler_decays():
             v, = exe.run(main, feed={'x': xv}, fetch_list=[lr])
             vals.append(float(np.ravel(v)[0]))
     assert vals[0] > vals[1] > vals[2]
+
+
+def test_memory_optimize_remat_matches_plain_training():
+    """fluid.memory_optimize marks the program for rematerialization;
+    the checkpointed step must produce identical losses (the trade is
+    memory for recompute, not numerics)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h = fluid.layers.fc(input=x, size=32, act='relu')
+            h = fluid.layers.fc(input=h, size=32, act='relu')
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(8, 16).astype('float32'),
+            'y': rng.randn(8, 1).astype('float32')}
+
+    def run(remat):
+        main, startup, loss = build()
+        if remat:
+            before = main.fingerprint()
+            fluid.memory_optimize(main)
+            assert main._remat and main.fingerprint() != before
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            return [float(np.asarray(exe.run(
+                main, feed=feed, fetch_list=[loss])[0]).mean())
+                for _ in range(5)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)

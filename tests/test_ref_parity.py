@@ -502,3 +502,51 @@ def test_send_marker_lowers_as_identity():
         exe.run(startup)
         out = exe.run(main, feed={'x': xs}, fetch_list=[got_var])[0]
     np.testing.assert_allclose(np.asarray(out), xs * 2.0)
+
+
+def test_dynamic_gru_gate_packing_urc():
+    """Weight [H, 3H] = {W_u, W_r | W_c}; candidate sees r*h_prev;
+    h = (1-u)*h_prev + u*c (gru_op.cc doc / gru_kernel.h)."""
+    rng = np.random.RandomState(17)
+    Hd = 3
+    lens = [4, 2]
+    x_rows = rng.randn(sum(lens), 3 * Hd).astype('float32')
+    w = (rng.randn(Hd, 3 * Hd) * 0.5).astype('float32')
+    b = (rng.randn(1, 3 * Hd) * 0.1).astype('float32')
+
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data(name='x', shape=[3 * Hd],
+                                dtype='float32', lod_level=1)
+        h = fluid.layers.dynamic_gru(input=xin, size=Hd)
+    gru = [op for op in main.global_block().ops
+           if op.type == 'dynamic_gru'][0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set_var(gru.inputs['Weight'][0], w)
+        scope.set_var(gru.inputs['Bias'][0], b)
+        got = exe.run(main,
+                      feed={'x': create_lod_tensor(x_rows, [lens])},
+                      fetch_list=[h])[0]
+    got_rows = got.to_dense_rows() if isinstance(got, SequenceTensor) \
+        else np.asarray(got)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    ref_rows, row = [], 0
+    for L in lens:
+        hp = np.zeros(Hd)
+        for t in range(L):
+            xg = x_rows[row] + b[0]
+            g = sig(xg[:2 * Hd] + hp.dot(w[:, :2 * Hd]))
+            u, r = g[:Hd], g[Hd:]
+            c = np.tanh(xg[2 * Hd:] + (r * hp).dot(w[:, 2 * Hd:]))
+            hp = (1 - u) * hp + u * c
+            ref_rows.append(hp.copy())
+            row += 1
+    np.testing.assert_allclose(got_rows, np.array(ref_rows),
+                               rtol=1e-4, atol=1e-4)
