@@ -378,3 +378,111 @@ def _im2sequence(ctx):
     seq = patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)
     ctx.set_output('Out', SequenceTensor(
         seq, jnp.full((n,), oh * ow, dtype='int32')))
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+@register_kernel('conv3d')
+def _conv3d(ctx):
+    """NCDHW conv. Parity: operators/conv_op.cc REGISTER conv3d (no
+    python layer exists at this reference version; op-level parity).
+    Honors the NHWC layout mode as channels-last NDHWC."""
+    x = unwrap(ctx.input('Input'))
+    w = unwrap(ctx.input('Filter'))
+    strides = _triple(ctx.attr('strides', [1, 1, 1]))
+    pads = _triple(ctx.attr('paddings', [0, 0, 0]))
+    dilations = _triple(ctx.attr('dilations', [1, 1, 1]))
+    groups = ctx.attr('groups', 1) or 1
+    from ..core.amp import mxu_compute, conv_layout
+    cl = conv_layout() == 'NHWC'
+
+    def conv(a, b):
+        if cl:
+            a = a.transpose(0, 2, 3, 4, 1)
+            b = b.transpose(2, 3, 4, 1, 0)
+        out = jax.lax.conv_general_dilated(
+            a, b, window_strides=strides,
+            padding=[(p, p) for p in pads],
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=('NDHWC', 'DHWIO', 'NDHWC') if cl
+            else ('NCDHW', 'OIDHW', 'NCDHW'))
+        return out.transpose(0, 4, 1, 2, 3) if cl else out
+
+    ctx.set_output('Output', mxu_compute(conv, x, w))
+
+
+@register_kernel('conv3d_transpose')
+def _conv3d_transpose(ctx):
+    """Parity: conv_transpose_op.cc conv3d_transpose — grad-of-conv
+    formulation (lhs-dilated conv with flipped kernel); grouped filters
+    ([in_c, out_c/g, ...]) convolve per group and concat on channels."""
+    x = unwrap(ctx.input('Input'))
+    w = unwrap(ctx.input('Filter'))  # [in_c, out_c/g, kd, kh, kw]
+    strides = _triple(ctx.attr('strides', [1, 1, 1]))
+    pads = _triple(ctx.attr('paddings', [0, 0, 0]))
+    dilations = _triple(ctx.attr('dilations', [1, 1, 1]))
+    groups = ctx.attr('groups', 1) or 1
+    ks = w.shape[2:]
+    pad = [(dilations[i] * (ks[i] - 1) - pads[i],) * 2 for i in range(3)]
+
+    def one(xg, wg):
+        return jax.lax.conv_general_dilated(
+            xg, jnp.flip(wg, (2, 3, 4)).swapaxes(0, 1),
+            window_strides=(1, 1, 1), padding=pad,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+
+    if groups == 1:
+        out = one(x, w)
+    else:
+        cg = x.shape[1] // groups
+        out = jnp.concatenate(
+            [one(x[:, g * cg:(g + 1) * cg], w[g * cg:(g + 1) * cg])
+             for g in range(groups)], axis=1)
+    ctx.set_output('Output', out)
+
+
+@register_kernel('pool3d')
+def _pool3d(ctx):
+    """Parity: pool_op.cc pool3d / math/pooling.cc 3D kernels (avg
+    divides by the window clipped to the image)."""
+    x = unwrap(ctx.input('X'))
+    ptype = ctx.attr('pooling_type', 'max')
+    ksize = _triple(ctx.attr('ksize', [2, 2, 2]))
+    strides = _triple(ctx.attr('strides', [1, 1, 1]))
+    pads = _triple(ctx.attr('paddings', [0, 0, 0]))
+    ceil_mode = bool(ctx.attr('ceil_mode', False))
+    if ctx.attr('global_pooling', False):
+        ksize = x.shape[2:]
+        pads = (0, 0, 0)
+    dims = (1, 1) + ksize
+    strd = (1, 1) + strides
+    spatial_pads = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if ceil_mode:
+        for i in range(3):
+            in_sz = x.shape[2 + i]
+            k, s, p = ksize[i], strides[i], pads[i]
+            ceil_out = -(-(in_sz - k + 2 * p) // s) + 1
+            floor_out = (in_sz - k + 2 * p) // s + 1
+            if ceil_out > floor_out:
+                lo, hi = spatial_pads[2 + i]
+                spatial_pads[2 + i] = (lo, hi + s)
+    if ptype == 'max':
+        out = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, dims, strd, spatial_pads)
+    else:
+        s = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, dims, strd, spatial_pads)
+        if ctx.attr('exclusive', True) and any(pads):
+            # divide by the window clipped to the image (pooling.cc)
+            ones = jnp.ones(x.shape[:1] + (1,) + x.shape[2:], x.dtype)
+            cnt = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, dims, strd, spatial_pads)
+            out = s / jnp.maximum(cnt, 1.0)
+        else:
+            out = s / float(ksize[0] * ksize[1] * ksize[2])
+    ctx.set_output('Out', out)

@@ -550,3 +550,85 @@ def test_dynamic_gru_gate_packing_urc():
             row += 1
     np.testing.assert_allclose(got_rows, np.array(ref_rows),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_matches_naive():
+    rng = np.random.RandomState(18)
+    x = rng.randn(1, 2, 5, 6, 5).astype('float32')
+    w = rng.randn(3, 2, 3, 3, 3).astype('float32')
+    got = np.asarray(run_op(
+        'conv3d', {'Input': x, 'Filter': w},
+        {'strides': [1, 2, 1], 'paddings': [1, 1, 0],
+         'dilations': [1, 1, 1], 'groups': 1},
+        out_slots=('Output',))[0])
+    N, C, D, H, W = x.shape
+    O = w.shape[0]
+    sd, sh, sw = 1, 2, 1
+    pd, ph, pw = 1, 1, 0
+    kd, kh, kw = 3, 3, 3
+    xp = np.zeros((N, C, D + 2 * pd, H + 2 * ph, W + 2 * pw))
+    xp[:, :, pd:pd + D, ph:ph + H, pw:pw + W] = x
+    Do = (D + 2 * pd - kd) // sd + 1
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    ref = np.zeros((N, O, Do, Ho, Wo))
+    for o in range(O):
+        for d in range(Do):
+            for i in range(Ho):
+                for j in range(Wo):
+                    win = xp[0, :, d * sd:d * sd + kd,
+                             i * sh:i * sh + kh, j * sw:j * sw + kw]
+                    ref[0, o, d, i, j] = (win * w[o]).sum()
+    np.testing.assert_allclose(got, ref.astype('float32'), rtol=1e-4,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize('ptype', ['max', 'avg'])
+def test_pool3d_clipped_divisor(ptype):
+    rng = np.random.RandomState(19)
+    x = rng.randn(1, 2, 5, 5, 5).astype('float32')
+    got = np.asarray(run_op(
+        'pool3d', {'X': x},
+        {'pooling_type': ptype, 'ksize': [3, 3, 3],
+         'strides': [2, 2, 2], 'paddings': [1, 1, 1]})[0])
+    D = H = W = 5
+    k, s, p = 3, 2, 1
+    Do = (D + 2 * p - k) // s + 1
+    ref = np.zeros((1, 2, Do, Do, Do))
+    for d in range(Do):
+        ds_, de = max(d * s - p, 0), min(d * s - p + k, D)
+        for i in range(Do):
+            hs, he = max(i * s - p, 0), min(i * s - p + k, H)
+            for j in range(Do):
+                ws, we = max(j * s - p, 0), min(j * s - p + k, W)
+                win = x[0, :, ds_:de, hs:he, ws:we]
+                if ptype == 'max':
+                    ref[0, :, d, i, j] = win.max(axis=(1, 2, 3))
+                else:
+                    ref[0, :, d, i, j] = win.mean(axis=(1, 2, 3))
+    np.testing.assert_allclose(got, ref.astype('float32'), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv3d_transpose_scatter():
+    rng = np.random.RandomState(20)
+    x = rng.randn(1, 2, 3, 3, 3).astype('float32')
+    w = rng.randn(2, 3, 3, 3, 3).astype('float32')   # [Cin,Cout,k,k,k]
+    s, p = 2, 1
+    got = np.asarray(run_op(
+        'conv3d_transpose', {'Input': x, 'Filter': w},
+        {'strides': [s] * 3, 'paddings': [p] * 3,
+         'dilations': [1, 1, 1]}, out_slots=('Output',))[0])
+    D = 3
+    k = 3
+    Do = (D - 1) * s - 2 * p + k
+    full = np.zeros((1, 3, Do + 2 * p, Do + 2 * p, Do + 2 * p))
+    for d in range(D):
+        for i in range(D):
+            for j in range(D):
+                patch = np.tensordot(x[0, :, d, i, j], w, axes=(0, 0))
+                full[0, :, d * s:d * s + k, i * s:i * s + k,
+                     j * s:j * s + k] += patch
+    ref = full[:, :, p:p + Do, p:p + Do, p:p + Do]
+    np.testing.assert_allclose(got, ref.astype('float32'), rtol=1e-4,
+                               atol=1e-4)
