@@ -64,3 +64,10 @@ def test_recognize_digits_mlp_script(fresh_programs):
 def test_word2vec_script(fresh_programs):
     mod = _load('test_word2vec.py')
     mod.main(use_cuda=False, is_sparse=False, is_parallel=False)
+
+
+def test_recognize_digits_parallel_do_script(fresh_programs):
+    """parallel=True exercises get_places + ParallelDo from the
+    unchanged reference script."""
+    mod = _load('test_recognize_digits.py')
+    mod.train('mlp', use_cuda=False, parallel=True, save_dirname=None)
