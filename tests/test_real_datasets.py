@@ -161,3 +161,34 @@ def test_corrupt_caches_fall_back_to_synthetic(data_home):
     with pytest.warns(UserWarning):
         word_idx = ds.imikolov.build_dict()
     assert len(word_idx) == 2074
+
+
+def test_imdb_tar_roundtrip(data_home):
+    (data_home / 'imdb').mkdir()
+    docs = {
+        'aclImdb/train/pos/0_9.txt': b"Great movie, great acting!",
+        'aclImdb/train/pos/1_8.txt': b"great fun. great great.",
+        'aclImdb/train/neg/0_2.txt': b"terrible film; great waste",
+        'aclImdb/test/pos/0_10.txt': b"great",
+        'aclImdb/test/neg/0_1.txt': b"bad",
+    }
+    with tarfile.open(data_home / 'imdb' / 'aclImdb_v1.tar.gz',
+                      'w:gz') as tf:
+        for name, payload in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    ds.imdb._DOCS.clear()
+    word_idx = ds.imdb.build_dict(cutoff=1)
+    toks = {k: v for k, v in word_idx.items()}
+    # 'great' appears 7x across train+test > cutoff; punctuation stripped
+    assert 'great' in toks and toks['<unk>'] == len(toks) - 1
+    got = list(ds.imdb.train(word_idx)())
+    assert len(got) == 3
+    labels = sorted(g[1] for g in got)
+    assert labels == [0, 0, 1]          # 2 pos, 1 neg
+    for doc, _label in got:
+        assert all(isinstance(w, int) for w in doc)
+    # test split reads the test/ members
+    got_t = list(ds.imdb.test(word_idx)())
+    assert len(got_t) == 2
