@@ -793,9 +793,16 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
 def sequence_pool(input, pool_type):
     helper = LayerHelper('sequence_pool', **{})
     dtype = input.dtype
-    out_shape = (input.shape[0],) + tuple(input.shape[2:]) \
-        if len(input.shape) > 2 else input.shape
-    pool_out = helper.create_tmp_variable(dtype, shape=out_shape)
+    if getattr(input, 'lod_level', 1) >= 2:
+        # pooling drops the innermost LoD level: still a sequence (now
+        # level-1) of rows with the same feature dims — the declared
+        # [-1, feat...] shape is unchanged, only the lod level drops
+        pool_out = helper.create_tmp_variable(dtype, shape=input.shape,
+                                              lod_level=1)
+    else:
+        out_shape = (input.shape[0],) + tuple(input.shape[2:]) \
+            if len(input.shape) > 2 else input.shape
+        pool_out = helper.create_tmp_variable(dtype, shape=out_shape)
     max_index = helper.create_tmp_variable(dtype='int32',
                                            stop_gradient=True)
     helper.append_op(type="sequence_pool",

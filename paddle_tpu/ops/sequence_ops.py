@@ -43,13 +43,14 @@ def masked_reverse(data, lengths):
 
 
 # ---- pooling --------------------------------------------------------------------
-@register_kernel('sequence_pool')
-def _sequence_pool(ctx):
-    st = _seq(ctx.input('X'))
-    pool = (ctx.attr('pooltype', 'AVERAGE') or 'AVERAGE').upper()
-    x = jnp.asarray(st.data)
-    m = _mask(st, x.ndim - 2)
-    L = jnp.maximum(jnp.asarray(st.lengths), 1).astype(x.dtype)
+def _pool_core(x, lengths, pool):
+    """Level-1 pooling over axis 1 of [N, T, feat...]; empty sequences
+    (length 0) pool to 0 like the reference's pad_value default.
+    Returns (out [N, feat...], max_index or None)."""
+    m = (jnp.arange(x.shape[1])[None, :] <
+         jnp.asarray(lengths)[:, None]).astype(x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    L = jnp.maximum(jnp.asarray(lengths), 1).astype(x.dtype)
     Lb = L.reshape((-1,) + (1,) * (x.ndim - 2))
     max_index = None
     if pool == 'SUM':
@@ -66,12 +67,49 @@ def _sequence_pool(ctx):
     elif pool == 'FIRST':
         out = x[:, 0]
     elif pool == 'LAST':
-        idx = (jnp.asarray(st.lengths) - 1).clip(0).astype('int32')
+        idx = (jnp.asarray(lengths) - 1).clip(0).astype('int32')
         out = jnp.take_along_axis(
             x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1,
             mode='clip')[:, 0]
     else:
         raise ValueError("unknown pooltype %r" % pool)
+    # empty sequences pool to pad_value (0), incl. MAX's -3.4e38 leak
+    empty = (jnp.asarray(lengths) <= 0)
+    zmask = jnp.where(empty, 0.0, 1.0).astype(x.dtype)
+    out = out * zmask.reshape((-1,) + (1,) * (out.ndim - 1))
+    if max_index is not None:
+        max_index = max_index * (1 - empty.astype(jnp.int32)).reshape(
+            (-1,) + (1,) * (max_index.ndim - 1))
+    return out, max_index
+
+
+@register_kernel('sequence_pool')
+def _sequence_pool(ctx):
+    st = _seq(ctx.input('X'))
+    pool = (ctx.attr('pooltype', 'AVERAGE') or 'AVERAGE').upper()
+    x = jnp.asarray(st.data)
+    if st.sub_lengths is not None:
+        # level-2 LoD: the reference pools the INNERMOST sequences and
+        # drops that LoD level (sequence_pooling.cc pools over lod[-1]):
+        # [B, O, I, feat] -> level-1 [B, O, feat]. Same core as level-1
+        # on the flattened outer groups; outer padding rows (>=
+        # st.lengths) have sub_lengths 0 and already pool to 0.
+        B, O = x.shape[0], x.shape[1]
+        out, max_index = _pool_core(
+            x.reshape((B * O,) + x.shape[2:]),
+            jnp.asarray(st.sub_lengths).reshape(-1), pool)
+        out = out.reshape((B, O) + out.shape[1:])
+        if ctx.output_names('MaxIndex'):
+            if max_index is None:
+                max_index = jnp.zeros(out.shape, jnp.int32)
+            else:
+                max_index = max_index.reshape((B, O) +
+                                              max_index.shape[1:])
+            ctx.set_output('MaxIndex',
+                           SequenceTensor(max_index, st.lengths))
+        ctx.set_output('Out', SequenceTensor(out, st.lengths))
+        return
+    out, max_index = _pool_core(x, st.lengths, pool)
     if ctx.output_names('MaxIndex'):
         if max_index is None:
             max_index = jnp.zeros(out.shape, jnp.int32)
