@@ -192,3 +192,49 @@ def test_imdb_tar_roundtrip(data_home):
     # test split reads the test/ members
     got_t = list(ds.imdb.test(word_idx)())
     assert len(got_t) == 2
+
+
+def test_wmt14_tar_roundtrip(data_home):
+    (data_home / 'wmt14').mkdir()
+    src_vocab = ['<s>', '<e>', '<unk>', 'hello', 'world']
+    trg_vocab = ['<s>', '<e>', '<unk>', 'bonjour', 'monde']
+    pairs = ["hello world\tbonjour monde",
+             "world\tmonde",
+             "hello " + " ".join(['x'] * 90) + "\tbonjour"]  # filtered
+    with tarfile.open(data_home / 'wmt14' / 'wmt14.tgz', 'w:gz') as tf:
+        for name, text in [
+                ('wmt14/src.dict', '\n'.join(src_vocab) + '\n'),
+                ('wmt14/trg.dict', '\n'.join(trg_vocab) + '\n'),
+                ('wmt14/train/train', '\n'.join(pairs) + '\n')]:
+            payload = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    got = list(ds.wmt14.train(dict_size=5)())
+    assert len(got) == 2                       # len>80 pair filtered
+    src_ids, trg_ids, trg_next = got[0]
+    # <s> hello world <e> framing on source
+    assert src_ids == [0, 3, 4, 1]
+    assert trg_ids == [0, 3, 4]                # <s> + target
+    assert trg_next == [3, 4, 1]               # target + <e>
+    src_d, trg_d = ds.wmt14.get_dict(5)
+    assert len(src_d) == 5
+
+
+def test_wmt14_missing_split_falls_back(data_home):
+    """An archive with only a train split serves synthetic TEST data
+    (with a warning) instead of an empty 'real' reader."""
+    (data_home / 'wmt14').mkdir()
+    vocab = ['<s>', '<e>', '<unk>', 'a']
+    with tarfile.open(data_home / 'wmt14' / 'wmt14.tgz', 'w:gz') as tf:
+        for name, text in [('wmt14/src.dict', '\n'.join(vocab)),
+                           ('wmt14/trg.dict', '\n'.join(vocab)),
+                           ('wmt14/train/train', "a\ta\n")]:
+            payload = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    ds.wmt14._DICTS.clear()
+    with pytest.warns(UserWarning):
+        reader = ds.wmt14.test(dict_size=4)
+    assert len(list(reader())) > 0      # synthetic stream, not empty
