@@ -28,18 +28,20 @@ def _read_to_dict(tar_file, dict_size):
     if key in _DICTS:
         return _DICTS[key]
     result = _parse_dicts(tar_file, dict_size)
-    _DICTS.clear()
+    if len(_DICTS) > 8:
+        _DICTS.clear()
     _DICTS[key] = result
     return result
 
 
 def _parse_dicts(tar_file, dict_size):
     def to_dict(fd, size):
+        # str keys at the API surface (the reference reads text mode)
         out = {}
         for line_count, line in enumerate(fd):
             if line_count >= size:
                 break
-            out[line.strip()] = line_count
+            out[line.strip().decode('utf-8', 'ignore')] = line_count
         return out
 
     with tarfile.open(tar_file, mode='r') as f:
@@ -56,10 +58,7 @@ def _real_reader(file_name, dict_size):
         return None
     try:
         src_dict, trg_dict = _read_to_dict(path, dict_size)
-        s_tok = START.encode() if any(
-            isinstance(k, bytes) for k in src_dict) else START
-        e_tok = END.encode() if isinstance(s_tok, bytes) else END
-        if s_tok not in trg_dict or e_tok not in trg_dict:
+        if START not in trg_dict or END not in trg_dict:
             raise IOError("trg.dict lacks %r/%r" % (START, END))
         with tarfile.open(path, mode='r') as f:
             names = [m.name for m in f if m.name.endswith(file_name)]
@@ -76,20 +75,20 @@ def _real_reader(file_name, dict_size):
             names = [m.name for m in f if m.name.endswith(file_name)]
             for name in names:
                 for line in f.extractfile(name):
-                    parts = line.strip().split(b'\t' if isinstance(
-                        s_tok, bytes) else '\t')
+                    parts = line.strip().decode(
+                        'utf-8', 'ignore').split('\t')
                     if len(parts) != 2:
                         continue
                     src_words = parts[0].split()
                     src_ids = [src_dict.get(w, UNK_IDX) for w in
-                               [s_tok] + src_words + [e_tok]]
+                               [START] + src_words + [END]]
                     trg_words = parts[1].split()
                     trg_ids = [trg_dict.get(w, UNK_IDX)
                                for w in trg_words]
                     if len(src_ids) > 80 or len(trg_ids) > 80:
                         continue
-                    trg_next = trg_ids + [trg_dict[e_tok]]
-                    trg_ids = [trg_dict[s_tok]] + trg_ids
+                    trg_next = trg_ids + [trg_dict[END]]
+                    trg_ids = [trg_dict[START]] + trg_ids
                     yield src_ids, trg_ids, trg_next
     return reader
 

@@ -238,3 +238,29 @@ def test_wmt14_missing_split_falls_back(data_home):
     with pytest.warns(UserWarning):
         reader = ds.wmt14.test(dict_size=4)
     assert len(list(reader())) > 0      # synthetic stream, not empty
+
+
+def test_wmt16_tar_roundtrip(data_home):
+    (data_home / 'wmt16').mkdir()
+    train = "the cat\tdie katze\nthe dog\tder hund\n"
+    val = "the cat\tdie katze\n"
+    with tarfile.open(data_home / 'wmt16' / 'wmt16.tar.gz',
+                      'w:gz') as tf:
+        for name, text in [('wmt16/train', train), ('wmt16/val', val),
+                           ('wmt16/test', val)]:
+            payload = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    ds.wmt16._DICTS.clear()
+    src_d = ds.wmt16.get_dict('en', 6)
+    # marks lead, then by descending frequency ('the' == 2 occurrences)
+    assert src_d['<s>'] == 0 and src_d['<e>'] == 1 \
+        and src_d['<unk>'] == 2
+    assert src_d['the'] == 3
+    got = list(ds.wmt16.train(6, 6)())
+    assert len(got) == 2
+    src_ids, trg_ids, trg_next = got[0]
+    assert src_ids[0] == 0 and src_ids[-1] == 1      # <s> ... <e>
+    assert trg_ids[0] == 0 and trg_next[-1] == 1
+    assert len(list(ds.wmt16.validation(6, 6)())) == 1
