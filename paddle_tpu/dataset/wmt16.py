@@ -51,7 +51,14 @@ def _build_dict(path, dict_size, lang):
     return word_dict
 
 
+def _check_lang(src_lang):
+    if src_lang not in ('en', 'de'):
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de (for Germany).")
+
+
 def _real_reader(file_name, src_dict_size, trg_dict_size, src_lang):
+    _check_lang(src_lang)
     path = cached_path('wmt16', _ARCHIVE)
     if path is None:
         return None

@@ -264,3 +264,33 @@ def test_wmt16_tar_roundtrip(data_home):
     assert src_ids[0] == 0 and src_ids[-1] == 1      # <s> ... <e>
     assert trg_ids[0] == 0 and trg_next[-1] == 1
     assert len(list(ds.wmt16.validation(6, 6)())) == 1
+
+
+def test_movielens_zip_roundtrip(data_home):
+    import zipfile
+    (data_home / 'movielens').mkdir()
+    movies = "1::Toy Story (1995)::Animation|Comedy\n" \
+             "2::Heat (1995)::Action|Crime\n"
+    users = "1::M::25::12::12345\n2::F::1::7::54321\n"
+    ratings = "1::1::5::978300760\n2::2::3::978300761\n" \
+              "1::2::4::978300762\n"
+    with zipfile.ZipFile(data_home / 'movielens' / 'ml-1m.zip',
+                         'w') as z:
+        z.writestr('ml-1m/movies.dat', movies)
+        z.writestr('ml-1m/users.dat', users)
+        z.writestr('ml-1m/ratings.dat', ratings)
+    ds.movielens._META.clear()
+    assert ds.movielens.max_movie_id() == 2
+    assert ds.movielens.max_user_id() == 2
+    cats = ds.movielens.movie_categories()
+    assert set(cats) == {'Animation', 'Comedy', 'Action', 'Crime'}
+    titles = ds.movielens.get_movie_title_dict()
+    assert {'toy', 'story', 'heat'} <= set(titles)
+    samples = list(ds.movielens.train()()) + \
+        list(ds.movielens.test()())
+    assert len(samples) == 3     # split is a partition of all ratings
+    s = [x for x in samples if x[0] == 1 and x[4] == 1][0]
+    # [uid, gender(M=0), age_bucket(25->2), job, mid, cats, title, [r]]
+    assert s[1] == 0 and s[2] == 2 and s[3] == 12
+    assert s[5] == [cats['Animation'], cats['Comedy']]
+    assert s[7] == [5.0 * 2 - 5.0]
