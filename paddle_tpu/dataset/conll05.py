@@ -1,8 +1,21 @@
-"""CoNLL-05 SRL. Parity: python/paddle/dataset/conll05.py (synthetic
-fallback with the same 8-slot schema + BIO label space)."""
+"""CoNLL-05 SRL. Parity: python/paddle/dataset/conll05.py — cached
+files under <data_home>/conll05st/ (wordDict.txt, verbDict.txt,
+targetDict.txt, conll05st-tests.tar.gz) are parsed when present with
+the reference's semantics: dict files line->index, label dict built
+from B-/I- tag pairs with 'O' last, the words/props gz pair expanded
+per-predicate with bracket-format label decoding, 5-window predicate
+marks and context features. Otherwise a synthetic fallback with the
+same 9-slot schema + BIO label space. get_embedding() stays synthetic
+(the reference returns a path to a binary v1 paddle file)."""
+import gzip
+import itertools
+import tarfile
+import warnings
+
 import numpy as np
 
 from . import _synth
+from .common import cached_path, file_key
 
 __all__ = ['get_dict', 'get_embedding', 'test']
 
@@ -10,9 +23,67 @@ _WORD_VOCAB = 44068
 _PRED_VOCAB = 3162
 _LABEL_COUNT = 59
 _MARK_DICT_LEN = 2
+UNK_IDX = 0
+
+_MODULE = 'conll05st'
+_DATA_ARCHIVE = 'conll05st-tests.tar.gz'
+_WORDS_NAME = 'conll05st-release/test.wsj/words/test.wsj.words.gz'
+_PROPS_NAME = 'conll05st-release/test.wsj/props/test.wsj.props.gz'
+_DICTS = {}
+
+
+def _load_dict(path):
+    d = {}
+    with open(path, 'r') as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def _load_label_dict(path):
+    tag_set = set()
+    with open(path, 'r') as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith('B-') or line.startswith('I-'):
+                tag_set.add(line[2:])
+    d = {}
+    index = 0
+    for tag in sorted(tag_set):   # deterministic (ref iterates a set)
+        d['B-' + tag] = index
+        index += 1
+        d['I-' + tag] = index
+        index += 1
+    d['O'] = index
+    return d
+
+
+def _real_dicts():
+    wd = cached_path(_MODULE, 'wordDict.txt')
+    vd = cached_path(_MODULE, 'verbDict.txt')
+    td = cached_path(_MODULE, 'targetDict.txt')
+    if not (wd and vd and td):
+        return None
+    key = (file_key(wd), file_key(vd), file_key(td))
+    if key in _DICTS:
+        return _DICTS[key]
+    try:
+        dicts = (_load_dict(wd), _load_dict(vd), _load_label_dict(td))
+    except Exception as e:
+        warnings.warn("conll05 dicts unreadable (%s); using synthetic "
+                      "fallback" % e)
+        return None
+    if len(_DICTS) > 8:
+        _DICTS.clear()
+    _DICTS[key] = dicts
+    return dicts
 
 
 def get_dict():
+    real = _real_dicts()
+    if real is not None:
+        _synth.mark_real_data()
+        return real
     word_dict = {('w%d' % i): i for i in range(_WORD_VOCAB)}
     verb_dict = {('v%d' % i): i for i in range(_PRED_VOCAB)}
     label_dict = {('l%d' % i): i for i in range(_LABEL_COUNT)}
@@ -21,6 +92,117 @@ def get_dict():
 
 def get_embedding():
     return _synth.rng('conll05_emb').rand(_WORD_VOCAB, 32).astype('float32')
+
+
+def _corpus_reader(data_path, words_name, props_name):
+    """Per-predicate (sentence_words, verb, BIO labels) tuples, decoded
+    from the bracket format exactly like the reference corpus_reader."""
+    def reader():
+        with tarfile.open(data_path) as tf:
+            wf = tf.extractfile(words_name)
+            pf = tf.extractfile(props_name)
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentences, one_seg = [], []
+                for word, label in itertools.zip_longest(words_file,
+                                                         props_file):
+                    word = (word or b'').decode('utf-8',
+                                                'ignore').strip()
+                    label = (label or b'').decode(
+                        'utf-8', 'ignore').strip().split()
+                    if len(label) == 0:   # end of sentence
+                        if not one_seg:
+                            continue
+                        labels = [[x[i] for x in one_seg]
+                                  for i in range(len(one_seg[0]))]
+                        verb_list = [x for x in labels[0] if x != '-']
+                        for i, lbl in enumerate(labels[1:]):
+                            cur_tag, in_bracket = 'O', False
+                            lbl_seq = []
+                            for item in lbl:
+                                if item == '*' and not in_bracket:
+                                    lbl_seq.append('O')
+                                elif item == '*' and in_bracket:
+                                    lbl_seq.append('I-' + cur_tag)
+                                elif item == '*)':
+                                    lbl_seq.append('I-' + cur_tag)
+                                    in_bracket = False
+                                elif '(' in item and ')' in item:
+                                    cur_tag = item[1:item.find('*')]
+                                    lbl_seq.append('B-' + cur_tag)
+                                    in_bracket = False
+                                elif '(' in item and ')' not in item:
+                                    cur_tag = item[1:item.find('*')]
+                                    lbl_seq.append('B-' + cur_tag)
+                                    in_bracket = True
+                                else:
+                                    raise RuntimeError(
+                                        'Unexpected label: %s' % item)
+                            yield sentences, verb_list[i], lbl_seq
+                        sentences, one_seg = [], []
+                    else:
+                        sentences.append(word)
+                        one_seg.append(label)
+    return reader
+
+
+def _real_reader():
+    dicts = _real_dicts()
+    data = cached_path(_MODULE, _DATA_ARCHIVE)
+    if dicts is None or data is None:
+        return None
+    word_dict, predicate_dict, label_dict = dicts
+    try:
+        corpus = _corpus_reader(data, _WORDS_NAME, _PROPS_NAME)
+        next(iter(corpus()))   # validate eagerly: archive + members
+    except StopIteration:
+        warnings.warn("conll05 corpus contains no complete sentences; "
+                      "using synthetic fallback")
+        return None
+    except Exception as e:
+        warnings.warn("conll05 corpus unreadable (%s); using synthetic "
+                      "fallback" % e)
+        return None
+    _synth.mark_real_data()
+
+    def reader():
+        for sentence, predicate, labels in corpus():
+            sen_len = len(sentence)
+            verb_index = labels.index('B-V')
+            mark = [0] * len(labels)
+            if verb_index > 0:
+                mark[verb_index - 1] = 1
+                ctx_n1 = sentence[verb_index - 1]
+            else:
+                ctx_n1 = 'bos'
+            if verb_index > 1:
+                mark[verb_index - 2] = 1
+                ctx_n2 = sentence[verb_index - 2]
+            else:
+                ctx_n2 = 'bos'
+            mark[verb_index] = 1
+            ctx_0 = sentence[verb_index]
+            if verb_index < len(labels) - 1:
+                mark[verb_index + 1] = 1
+                ctx_p1 = sentence[verb_index + 1]
+            else:
+                ctx_p1 = 'eos'
+            if verb_index < len(labels) - 2:
+                mark[verb_index + 2] = 1
+                ctx_p2 = sentence[verb_index + 2]
+            else:
+                ctx_p2 = 'eos'
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            yield (word_idx,
+                   [word_dict.get(ctx_n2, UNK_IDX)] * sen_len,
+                   [word_dict.get(ctx_n1, UNK_IDX)] * sen_len,
+                   [word_dict.get(ctx_0, UNK_IDX)] * sen_len,
+                   [word_dict.get(ctx_p1, UNK_IDX)] * sen_len,
+                   [word_dict.get(ctx_p2, UNK_IDX)] * sen_len,
+                   [predicate_dict.get(predicate)] * sen_len,
+                   mark,
+                   [label_dict.get(w) for w in labels])
+    return reader
 
 
 def _sampler(name, n, salt=0):
@@ -46,6 +228,9 @@ def _sampler(name, n, salt=0):
 
 
 def test():
+    real = _real_reader()
+    if real is not None:
+        return real
     return _sampler('conll05_test', 1024, salt=1)
 
 

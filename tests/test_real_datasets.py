@@ -294,3 +294,45 @@ def test_movielens_zip_roundtrip(data_home):
     assert s[1] == 0 and s[2] == 2 and s[3] == 12
     assert s[5] == [cats['Animation'], cats['Comedy']]
     assert s[7] == [5.0 * 2 - 5.0]
+
+
+def test_conll05_cache_roundtrip(data_home):
+    import gzip as gz
+    (data_home / 'conll05st').mkdir()
+    (data_home / 'conll05st' / 'wordDict.txt').write_text(
+        "<unk>\nthe\ncat\nsat\nhere\nbos\neos\n")
+    (data_home / 'conll05st' / 'verbDict.txt').write_text("sat\nran\n")
+    (data_home / 'conll05st' / 'targetDict.txt').write_text(
+        "B-A0\nI-A0\nB-V\nI-V\nO\n")
+    # words/props in the bracket format: "the cat sat here", verb 'sat'
+    words = "the\ncat\nsat\nhere\n\n"
+    props = "-\t(A0*\n-\t*)\nsat\t(V*)\n-\t*\n\n"
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gz.GzipFile(fileobj=wbuf, mode='wb') as f:
+        f.write(words.encode())
+    with gz.GzipFile(fileobj=pbuf, mode='wb') as f:
+        f.write(props.encode())
+    with tarfile.open(data_home / 'conll05st' /
+                      'conll05st-tests.tar.gz', 'w:gz') as tf:
+        for name, buf in [
+                ('conll05st-release/test.wsj/words/test.wsj.words.gz',
+                 wbuf), 
+                ('conll05st-release/test.wsj/props/test.wsj.props.gz',
+                 pbuf)]:
+            payload = buf.getvalue()
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    ds.conll05._DICTS.clear()
+    word_d, verb_d, label_d = ds.conll05.get_dict()
+    assert word_d['the'] == 1 and verb_d['sat'] == 0
+    assert label_d['O'] == max(label_d.values())      # 'O' last
+    got = list(ds.conll05.test()())
+    assert len(got) == 1
+    w, c2, c1, c0, p1, p2, pred, mark, lab = got[0]
+    assert w == [1, 2, 3, 4]                          # the cat sat here
+    assert pred == [verb_d['sat']] * 4
+    assert lab == [label_d['B-A0'], label_d['I-A0'],
+                   label_d['B-V'], label_d['O']]
+    assert mark == [1, 1, 1, 1]                       # 5-window marks
+    assert c0 == [word_d['sat']] * 4                  # ctx_0 = verb word
