@@ -83,7 +83,8 @@ def get_dict():
     real = _real_dicts()
     if real is not None:
         _synth.mark_real_data()
-        return real
+        # copies: callers must not be able to corrupt the memo
+        return (dict(real[0]), dict(real[1]), dict(real[2]))
     word_dict = {('w%d' % i): i for i in range(_WORD_VOCAB)}
     verb_dict = {('v%d' % i): i for i in range(_PRED_VOCAB)}
     label_dict = {('l%d' % i): i for i in range(_LABEL_COUNT)}
@@ -91,7 +92,9 @@ def get_dict():
 
 
 def get_embedding():
-    return _synth.rng('conll05_emb').rand(_WORD_VOCAB, 32).astype('float32')
+    # sized to the ACTIVE word dict (real caches are rarely 44068 rows)
+    n = len(get_dict()[0])
+    return _synth.rng('conll05_emb').rand(n, 32).astype('float32')
 
 
 def _corpus_reader(data_path, words_name, props_name):
@@ -206,16 +209,22 @@ def _real_reader():
 
 
 def _sampler(name, n, salt=0):
+    # ids drawn within the ACTIVE dict sizes, so a real cache with a
+    # smaller vocab cannot make synthetic train() emit out-of-range ids
+    word_dict, verb_dict, label_dict = get_dict()
+    n_words, n_preds = len(word_dict), len(verb_dict)
+    n_labels = len(label_dict)
+
     def reader():
         r = _synth.rng(name, salt)
         for _ in range(n):
             length = int(r.randint(5, 30))
-            word = [int(w) for w in r.randint(0, _WORD_VOCAB, size=length)]
+            word = [int(w) for w in r.randint(0, n_words, size=length)]
             pred_idx = int(r.randint(length))
-            predicate = [int(r.randint(0, _PRED_VOCAB))] * length
+            predicate = [int(r.randint(0, n_preds))] * length
             mark = [1 if i == pred_idx else 0 for i in range(length)]
             # label depends on distance to predicate: learnable
-            label = [int(min(_LABEL_COUNT - 1, abs(i - pred_idx)))
+            label = [int(min(n_labels - 1, abs(i - pred_idx)))
                      for i in range(length)]
             ctx_n2 = [word[max(0, pred_idx - 2)]] * length
             ctx_n1 = [word[max(0, pred_idx - 1)]] * length

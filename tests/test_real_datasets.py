@@ -336,3 +336,30 @@ def test_conll05_cache_roundtrip(data_home):
                    label_d['B-V'], label_d['O']]
     assert mark == [1, 1, 1, 1]                       # 5-window marks
     assert c0 == [word_d['sat']] * 4                  # ctx_0 = verb word
+
+
+def test_sentiment_movie_reviews_roundtrip(data_home):
+    d = data_home / 'corpora' / 'movie_reviews'
+    (d / 'neg').mkdir(parents=True)
+    (d / 'pos').mkdir(parents=True)
+    (d / 'neg' / 'cv000_1.txt').write_text("bad bad film")
+    (d / 'neg' / 'cv001_2.txt').write_text("awful film")
+    (d / 'pos' / 'cv000_3.txt').write_text("good good good film")
+    (d / 'pos' / 'cv001_4.txt').write_text("great film")
+    import paddle_tpu.dataset.sentiment as snt
+    snt._CACHE.clear()
+    wd = dict(snt.get_word_dict())
+    # frequency ranking: film(4) > good(3) > bad(2) > awful/great(1)
+    assert wd['film'] == 0 and wd['good'] == 1 and wd['bad'] == 2
+    orig_train = snt.NUM_TRAINING_INSTANCES
+    try:
+        snt.NUM_TRAINING_INSTANCES = 2
+        samples = list(snt.train()())
+        assert len(samples) == 2
+        # interleaved neg/pos: labels alternate 0,1
+        assert [s[1] for s in samples] == [0, 1]
+        assert samples[0][0] == [wd['bad'], wd['bad'], wd['film']]
+        rest = list(snt.test()())
+        assert len(rest) == 2 and [s[1] for s in rest] == [0, 1]
+    finally:
+        snt.NUM_TRAINING_INSTANCES = orig_train
