@@ -92,8 +92,10 @@ def get_dict():
 
 
 def get_embedding():
-    # sized to the ACTIVE word dict (real caches are rarely 44068 rows)
-    n = len(get_dict()[0])
+    # sized to the ACTIVE word dict (real caches are rarely 44068 rows);
+    # _real_dicts so the synthetic embedding never flips is_synthetic()
+    real = _real_dicts()
+    n = len(real[0]) if real is not None else _WORD_VOCAB
     return _synth.rng('conll05_emb').rand(n, 32).astype('float32')
 
 
@@ -210,10 +212,16 @@ def _real_reader():
 
 def _sampler(name, n, salt=0):
     # ids drawn within the ACTIVE dict sizes, so a real cache with a
-    # smaller vocab cannot make synthetic train() emit out-of-range ids
-    word_dict, verb_dict, label_dict = get_dict()
-    n_words, n_preds = len(word_dict), len(verb_dict)
-    n_labels = len(label_dict)
+    # smaller vocab cannot make synthetic train() emit out-of-range
+    # ids. _real_dicts (not get_dict) so serving SYNTHETIC samples
+    # never flips is_synthetic().
+    real = _real_dicts()
+    if real is not None:
+        n_words, n_preds, n_labels = (len(real[0]), len(real[1]),
+                                      len(real[2]))
+    else:
+        n_words, n_preds = _WORD_VOCAB, _PRED_VOCAB
+        n_labels = _LABEL_COUNT
 
     def reader():
         r = _synth.rng(name, salt)

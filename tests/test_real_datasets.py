@@ -363,3 +363,72 @@ def test_sentiment_movie_reviews_roundtrip(data_home):
         assert len(rest) == 2 and [s[1] for s in rest] == [0, 1]
     finally:
         snt.NUM_TRAINING_INSTANCES = orig_train
+
+
+def test_voc2012_tar_roundtrip(data_home):
+    from PIL import Image
+    (data_home / 'voc2012').mkdir()
+    rng = np.random.RandomState(5)
+
+    def png_bytes(arr, mode):
+        buf = io.BytesIO()
+        Image.fromarray(arr, mode).save(buf, 'PNG')
+        return buf.getvalue()
+
+    def jpg_bytes(arr):
+        buf = io.BytesIO()
+        Image.fromarray(arr, 'RGB').save(buf, 'JPEG')
+        return buf.getvalue()
+
+    img = rng.randint(0, 256, (20, 24, 3)).astype('uint8')
+    mask = rng.randint(0, 21, (20, 24)).astype('uint8')
+    files = {
+        'VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt':
+            b'im0\n',
+        'VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt': b'im0\n',
+        'VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt': b'im0\n',
+        'VOCdevkit/VOC2012/JPEGImages/im0.jpg': jpg_bytes(img),
+        'VOCdevkit/VOC2012/SegmentationClass/im0.png':
+            png_bytes(mask, 'L'),
+    }
+    with tarfile.open(data_home / 'voc2012' /
+                      'VOCtrainval_11-May-2012.tar', 'w') as tf:
+        for name, payload in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    got = list(ds.voc2012.train()())
+    assert len(got) == 1
+    data, label = got[0]
+    assert data.shape == (20, 24, 3) and data.dtype == np.uint8
+    np.testing.assert_array_equal(label, mask)
+
+
+def test_flowers_roundtrip(data_home):
+    from PIL import Image
+    import scipy.io as scio
+    (data_home / 'flowers').mkdir()
+    rng = np.random.RandomState(6)
+    with tarfile.open(data_home / 'flowers' / '102flowers.tgz',
+                      'w:gz') as tf:
+        for i in (1, 2):
+            arr = rng.randint(0, 256, (300, 280, 3)).astype('uint8')
+            buf = io.BytesIO()
+            Image.fromarray(arr, 'RGB').save(buf, 'JPEG')
+            payload = buf.getvalue()
+            info = tarfile.TarInfo('jpg/image_%05d.jpg' % i)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    scio.savemat(data_home / 'flowers' / 'imagelabels.mat',
+                 {'labels': np.array([[5, 9]])})
+    scio.savemat(data_home / 'flowers' / 'setid.mat',
+                 {'tstid': np.array([[1, 2]]),
+                  'trnid': np.array([[2]]),
+                  'valid': np.array([[1]])})
+    got = list(ds.flowers.train()())
+    assert len(got) == 2                       # tstid drives train()
+    sample, label = got[0]
+    assert sample.shape == (3, 224, 224) and sample.dtype == np.float32
+    assert label == 5 - 1                      # labels 0-based
+    got_t = list(ds.flowers.test()())
+    assert len(got_t) == 1 and got_t[0][1] == 9 - 1
