@@ -291,12 +291,29 @@ def _auc(ctx):
 
 @register_kernel('bilinear_interp')
 def _bilinear_interp(ctx):
+    """Corner-aligned bilinear resize: ratio = (in-1)/(out-1), like
+    bilinear_interp_op.h (jax.image.resize is half-pixel-aligned and
+    diverges at every non-corner sample)."""
     x = unwrap(ctx.input('X'))
-    out_h = ctx.attr('out_h')
-    out_w = ctx.attr('out_w')
+    out_h = int(ctx.attr('out_h'))
+    out_w = int(ctx.attr('out_w'))
     n, c, h, w = x.shape
-    out = jax.image.resize(x, (n, c, out_h, out_w), method='bilinear')
-    ctx.set_output('Out', out)
+    ratio_h = (h - 1.0) / (out_h - 1.0) if out_h > 1 else 0.0
+    ratio_w = (w - 1.0) / (out_w - 1.0) if out_w > 1 else 0.0
+    sy = jnp.arange(out_h, dtype=jnp.float32) * ratio_h
+    sx = jnp.arange(out_w, dtype=jnp.float32) * ratio_w
+    y0 = jnp.floor(sy).astype(jnp.int32)
+    x0 = jnp.floor(sx).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    dy = (sy - y0).reshape(1, 1, out_h, 1).astype(x.dtype)
+    dx = (sx - x0).reshape(1, 1, 1, out_w).astype(x.dtype)
+    # separable: vertical lerp at the narrow (.., out_h, w) size first,
+    # then two column gathers — half the gather/multiply work
+    rows = jnp.take(x, y0, axis=2) * (1 - dy) + \
+        jnp.take(x, y1, axis=2) * dy
+    ctx.set_output('Out', jnp.take(rows, x0, axis=3) * (1 - dx) +
+                   jnp.take(rows, x1, axis=3) * dx)
 
 
 @register_kernel('label_smooth')

@@ -632,3 +632,154 @@ def test_conv3d_transpose_scatter():
     ref = full[:, :, p:p + Do, p:p + Do, p:p + Do]
     np.testing.assert_allclose(got, ref.astype('float32'), rtol=1e-4,
                                atol=1e-4)
+
+
+# ---- second table wave: shape/indexing/interp ops --------------------------
+def test_cast_dtype_matrix():
+    x = np.array([[1.7, -2.3], [0.0, 4.9]], dtype='float32')
+    got = run_op('cast', {'X': x}, {'out_dtype': 'int32'})[0]
+    np.testing.assert_array_equal(np.asarray(got),
+                                  x.astype('int32'))   # truncation
+    xi = np.array([[1, 0], [3, 5]], dtype='int64')
+    got = run_op('cast', {'X': xi}, {'out_dtype': 'float32'})[0]
+    np.testing.assert_allclose(np.asarray(got), xi.astype('float32'))
+
+
+def test_gather_rows():
+    rng = np.random.RandomState(23)
+    x = rng.randn(7, 4).astype('float32')
+    idx = np.array([6, 0, 3, 3], dtype='int32')
+    got = run_op('gather', {'X': x, 'Index': idx}, {})[0]
+    np.testing.assert_allclose(np.asarray(got), x[idx])
+
+
+def test_cumsum_axis():
+    rng = np.random.RandomState(24)
+    x = rng.randn(3, 5).astype('float32')
+    got = run_op('cumsum', {'X': x}, {'axis': 1})[0]
+    np.testing.assert_allclose(np.asarray(got), np.cumsum(x, 1),
+                               rtol=1e-5)
+
+
+def test_argmax_argmin():
+    rng = np.random.RandomState(25)
+    x = rng.randn(4, 6).astype('float32')
+    got = run_op('arg_max', {'X': x}, {'axis': 1})[0]
+    np.testing.assert_array_equal(np.asarray(got), x.argmax(1))
+    got = run_op('arg_min', {'X': x}, {'axis': 0})[0]
+    np.testing.assert_array_equal(np.asarray(got), x.argmin(0))
+
+
+def test_expand_tiles():
+    rng = np.random.RandomState(26)
+    x = rng.randn(2, 3).astype('float32')
+    got = run_op('expand', {'X': x}, {'expand_times': [2, 3]})[0]
+    np.testing.assert_allclose(np.asarray(got), np.tile(x, (2, 3)))
+
+
+def test_crop_with_offsets():
+    rng = np.random.RandomState(27)
+    x = rng.randn(4, 6).astype('float32')
+    got = run_op('crop', {'X': x},
+                 {'offsets': [1, 2], 'shape': [2, 3]})[0]
+    np.testing.assert_allclose(np.asarray(got), x[1:3, 2:5])
+
+
+def test_bilinear_interp_align():
+    """Reference bilinear_interp_op.cc: scale = (in-1)/(out-1) corner
+    alignment."""
+    x = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    got = np.asarray(run_op('bilinear_interp', {'X': x},
+                            {'out_h': 7, 'out_w': 7})[0])
+    ratio = (4.0 - 1.0) / (7.0 - 1.0)
+    ref = np.zeros((1, 1, 7, 7), np.float32)
+    for i in range(7):
+        for j in range(7):
+            sy, sx = i * ratio, j * ratio
+            y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+            y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+            dy, dx = sy - y0, sx - x0
+            ref[0, 0, i, j] = (
+                x[0, 0, y0, x0] * (1 - dy) * (1 - dx) +
+                x[0, 0, y1, x0] * dy * (1 - dx) +
+                x[0, 0, y0, x1] * (1 - dy) * dx +
+                x[0, 0, y1, x1] * dy * dx)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cos_sim_rows():
+    rng = np.random.RandomState(28)
+    x = rng.randn(5, 8).astype('float32')
+    y = rng.randn(5, 8).astype('float32')
+    got = np.asarray(run_op('cos_sim', {'X': x, 'Y': y}, {},
+                            extra_outs=('XNorm', 'YNorm'))[0])
+    ref = (x * y).sum(1) / (np.linalg.norm(x, axis=1) *
+                            np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(got.reshape(-1), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_clip_by_norm():
+    rng = np.random.RandomState(29)
+    x = (rng.randn(4, 4) * 3).astype('float32')
+    mn = 2.0
+    got = np.asarray(run_op('clip_by_norm', {'X': x},
+                            {'max_norm': mn})[0])
+    norm = np.linalg.norm(x)
+    ref = x * mn / norm if norm > mn else x
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_elementwise_pow_max_min():
+    rng = np.random.RandomState(30)
+    x = (rng.rand(3, 4) + 0.5).astype('float32')
+    y = (rng.rand(3, 4) * 2).astype('float32')
+    for op, npf in [('elementwise_pow', np.power),
+                    ('elementwise_max', np.maximum),
+                    ('elementwise_min', np.minimum)]:
+        got = run_op(op, {'X': x, 'Y': y}, {})[0]
+        np.testing.assert_allclose(np.asarray(got), npf(x, y),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    """ref bilinear_tensor_product_op.h: out[:, i] = x W_i y^T + bias."""
+    rng = np.random.RandomState(31)
+    B, M, N, K = 3, 4, 5, 2
+    x = rng.randn(B, M).astype('float32')
+    y = rng.randn(B, N).astype('float32')
+    w = rng.randn(K, M, N).astype('float32')
+    b = rng.randn(1, K).astype('float32')
+    got = np.asarray(run_op(
+        'bilinear_tensor_product',
+        {'X': x, 'Y': y, 'Weight': w, 'Bias': b}, {})[0])
+    ref = np.stack([(x @ w[k] * y).sum(1) for k in range(K)], 1) + b
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_dice_loss():
+    """ref dice_loss: 1 - 2*inter/(union) per the layer formula."""
+    rng = np.random.RandomState(32)
+    p = rng.rand(4, 6).astype('float32')
+    lab = (rng.rand(4, 6) > 0.5).astype('float32')
+    got = np.asarray(run_op('dice_loss', {'X': p, 'Label': lab}, {})[0])
+    inter = (p * lab).sum(-1)
+    union = p.sum(-1) + lab.sum(-1)
+    # reference layers/nn.py dice_loss: eps in the denominator only,
+    # then reduce_mean to a [1] scalar
+    ref = np.mean(1.0 - 2 * inter / (union + 1e-5))
+    np.testing.assert_allclose(got.reshape(-1), [ref], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_im2sequence_patches():
+    """ref im2sequence_op.h: sliding patches flattened row-major."""
+    x = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    got = run_op('im2sequence', {'X': x},
+                 {'kernels': [2, 2], 'strides': [2, 2],
+                  'paddings': [0, 0, 0, 0]})[0]
+    rows = got.to_dense_rows() if hasattr(got, 'to_dense_rows') \
+        else np.asarray(got).reshape(-1, 4)
+    ref = np.array([[0, 1, 4, 5], [2, 3, 6, 7],
+                    [8, 9, 12, 13], [10, 11, 14, 15]], np.float32)
+    np.testing.assert_allclose(np.asarray(rows).reshape(-1, 4), ref)
