@@ -783,3 +783,124 @@ def test_im2sequence_patches():
     ref = np.array([[0, 1, 4, 5], [2, 3, 6, 7],
                     [8, 9, 12, 13], [10, 11, 14, 15]], np.float32)
     np.testing.assert_allclose(np.asarray(rows).reshape(-1, 4), ref)
+
+
+# ---- third table wave: activations + formula ops ---------------------------
+@pytest.mark.parametrize('op,attrs,ref', [
+    ('brelu', {'t_min': 1.0, 't_max': 4.0},
+     lambda x: np.clip(x, 1.0, 4.0)),
+    ('leaky_relu', {'alpha': 0.1},
+     lambda x: np.where(x >= 0, x, 0.1 * x)),
+    ('soft_relu', {'threshold': 40.0},
+     lambda x: np.log1p(np.exp(np.clip(x, -40.0, 40.0)))),
+    ('elu', {'alpha': 0.5},
+     lambda x: np.where(x > 0, x, 0.5 * (np.exp(x) - 1))),
+    ('relu6', {'threshold': 6.0}, lambda x: np.clip(x, 0, 6.0)),
+    ('pow', {'factor': 2.0}, lambda x: np.power(x, 2.0)),
+    ('stanh', {'scale_a': 0.67, 'scale_b': 1.7159},
+     lambda x: 1.7159 * np.tanh(0.67 * x)),
+    ('hard_shrink', {'threshold': 0.6},
+     lambda x: np.where(np.abs(x) > 0.6, x, 0.0)),
+    ('softshrink', {'lambda': 0.4},
+     lambda x: np.where(x > 0.4, x - 0.4,
+                        np.where(x < -0.4, x + 0.4, 0.0))),
+    ('thresholded_relu', {'threshold': 0.8},
+     lambda x: np.where(x > 0.8, x, 0.0)),
+    ('hard_sigmoid', {'slope': 0.2, 'offset': 0.5},
+     lambda x: np.clip(0.2 * x + 0.5, 0.0, 1.0)),
+])
+def test_activation_formulas(op, attrs, ref):
+    rng = np.random.RandomState(33)
+    x = (rng.randn(4, 6) * 2).astype('float32')
+    got = run_op(op, {'X': x}, attrs)[0]
+    np.testing.assert_allclose(np.asarray(got), ref(x), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_l2_normalize_axis():
+    rng = np.random.RandomState(34)
+    x = rng.randn(3, 5, 2).astype('float32')
+    got = run_op('l2_normalize', {'X': x}, {'axis': 1},
+                 extra_outs=('Norm',))[0]
+    ref = x / np.maximum(
+        np.sqrt((x ** 2).sum(1, keepdims=True)), 1e-10)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_iou_similarity_matrix():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    got = np.asarray(run_op('iou_similarity', {'X': x, 'Y': y}, {})[0])
+    # [box0 vs y0]=1, [box0 vs y1]=0; [box1 vs y0]=1/7, [box1 vs y1]=1/7
+    ref = np.array([[1.0, 0.0], [1.0 / 7, 1.0 / 7]], np.float32)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_shift_circular():
+    """ref conv_shift_op: out[b, j] = sum_k x[b, (j + k - n//2) % m]
+    * y[b, k] (circular correlation)."""
+    rng = np.random.RandomState(35)
+    b, m, n = 2, 7, 3
+    x = rng.randn(b, m).astype('float32')
+    y = rng.randn(b, n).astype('float32')
+    got = np.asarray(run_op('conv_shift', {'X': x, 'Y': y}, {})[0])
+    half = (n - 1) // 2
+    ref = np.zeros((b, m), np.float32)
+    for j in range(m):
+        for k in range(n):
+            ref[:, j] += x[:, (j + k - half) % m] * y[:, k]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_row_conv_lookahead_ragged():
+    """ref row_conv_op: out[t] = sum_j x[t+j] * W[j], truncated at each
+    sequence's end."""
+    rng = np.random.RandomState(36)
+    lens = [4, 2]
+    rows = rng.randn(sum(lens), 3).astype('float32')
+    w = rng.randn(2, 3).astype('float32')    # lookahead 1
+    got = run_op('row_conv',
+                 {'X': create_lod_tensor(rows, [lens]), 'Filter': w},
+                 {}, lod_levels={'X': 1})[0]
+    got_rows = got.to_dense_rows()
+    expected, off = [], 0
+    for L in lens:
+        seq = rows[off:off + L]
+        out = np.zeros_like(seq)
+        for t in range(L):
+            for j in range(2):
+                if t + j < L:
+                    out[t] += seq[t + j] * w[j]
+        expected.append(out)
+        off += L
+    np.testing.assert_allclose(got_rows, np.concatenate(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_label_smooth():
+    rng = np.random.RandomState(37)
+    x = rng.rand(4, 5).astype('float32')
+    x /= x.sum(1, keepdims=True)
+    got = np.asarray(run_op('label_smooth', {'X': x},
+                            {'epsilon': 0.2})[0])
+    np.testing.assert_allclose(got, 0.8 * x + 0.2 / 5, rtol=1e-5)
+
+
+def test_lrn_window():
+    """ref lrn_op: out = x / (k + alpha * sum_window x^2)^beta over a
+    cross-channel window of n."""
+    rng = np.random.RandomState(38)
+    x = rng.randn(2, 6, 3, 3).astype('float32')
+    n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    got = np.asarray(run_op('lrn', {'X': x},
+                            {'n': n, 'k': k, 'alpha': alpha,
+                             'beta': beta}, extra_outs=('MidOut',))[0])
+    sq = x ** 2
+    acc = np.zeros_like(x)
+    half = n // 2
+    for c in range(6):
+        lo, hi = max(0, c - half), min(6, c + half + 1)
+        acc[:, c] = sq[:, lo:hi].sum(1)
+    ref = x / (k + alpha * acc) ** beta
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
