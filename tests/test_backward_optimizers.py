@@ -175,3 +175,86 @@ def test_memory_optimize_remat_matches_plain_training():
                 for _ in range(5)]
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+# ---- exact single-step update formulas (convergence is tested above;
+# these pin the reference update math: eps placement, bias correction,
+# nesterov form) --------------------------------------------------------
+def _one_step(opt_factory, steps=1):
+    """Train p on loss = mean(p * x) so dL/dp is exactly x/N."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        p = fluid.layers.create_parameter(
+            shape=[4], dtype='float32', name='p_exact',
+            default_initializer=fluid.initializer.Constant(0.5))
+        loss = fluid.layers.mean(
+            fluid.layers.elementwise_mul(x, p))
+        opt_factory().minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.array([[1.0, -2.0, 3.0, 0.5]], np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            exe.run(main, feed={'x': xs}, fetch_list=[loss])
+        from paddle_tpu.executor import global_scope
+        return np.asarray(global_scope().find_var('p_exact')).copy()
+
+
+def test_sgd_exact_step():
+    got = _one_step(lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    g = np.array([1.0, -2.0, 3.0, 0.5], np.float32) / 4.0
+    np.testing.assert_allclose(got, 0.5 - 0.1 * g, rtol=1e-5)
+
+
+def test_momentum_exact_two_steps():
+    got = _one_step(lambda: fluid.optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9), steps=2)
+    g = np.array([1.0, -2.0, 3.0, 0.5], np.float32) / 4.0
+    v1 = g
+    p1 = 0.5 - 0.1 * v1
+    v2 = 0.9 * v1 + g
+    np.testing.assert_allclose(got, p1 - 0.1 * v2, rtol=1e-5)
+
+
+def test_momentum_nesterov_exact_step():
+    got = _one_step(lambda: fluid.optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9, use_nesterov=True))
+    g = np.array([1.0, -2.0, 3.0, 0.5], np.float32) / 4.0
+    v1 = g
+    # ref momentum_op.h nesterov: p -= (g + mu*v_new) * lr
+    np.testing.assert_allclose(got, 0.5 - (g + 0.9 * v1) * 0.1,
+                               rtol=1e-5)
+
+
+def test_adam_exact_step_bias_correction():
+    got = _one_step(lambda: fluid.optimizer.Adam(
+        learning_rate=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8))
+    g = np.array([1.0, -2.0, 3.0, 0.5], np.float32) / 4.0
+    m1 = 0.1 * g
+    m2 = 0.001 * g * g
+    # ref adam_op.h: lr_t = lr*sqrt(1-b2^t)/(1-b1^t); eps OUTSIDE sqrt
+    lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    np.testing.assert_allclose(
+        got, 0.5 - lr_t * m1 / (np.sqrt(m2) + 1e-8), rtol=1e-5)
+
+
+def test_adagrad_exact_step():
+    got = _one_step(lambda: fluid.optimizer.Adagrad(
+        learning_rate=0.1, epsilon=1e-6))
+    g = np.array([1.0, -2.0, 3.0, 0.5], np.float32) / 4.0
+    m = g * g
+    # ref adagrad_op.h: eps outside the sqrt
+    np.testing.assert_allclose(got, 0.5 - 0.1 * g / (np.sqrt(m) + 1e-6),
+                               rtol=1e-5)
+
+
+def test_rmsprop_exact_step():
+    got = _one_step(lambda: fluid.optimizer.RMSProp(
+        learning_rate=0.1, rho=0.95, epsilon=1e-6, momentum=0.0))
+    g = np.array([1.0, -2.0, 3.0, 0.5], np.float32) / 4.0
+    ms = 0.05 * g * g
+    # ref rmsprop_op.h: eps INSIDE the sqrt
+    np.testing.assert_allclose(got, 0.5 - 0.1 * g / np.sqrt(ms + 1e-6),
+                               rtol=1e-4)
