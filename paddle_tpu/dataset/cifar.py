@@ -2,6 +2,7 @@
 cifar-{10,100}-python.tar.gz is parsed when present (pickled batches,
 samples /255.0 like the reference); otherwise the synthetic fallback
 (images flattened 3*32*32 in [-1, 1])."""
+import os
 import pickle
 import tarfile
 import warnings
@@ -26,7 +27,8 @@ def _tar_reader(archive, sub_name):
         if key not in _PARSED:
             samples = []
             with tarfile.open(path, mode='r') as f:
-                names = [m.name for m in f if sub_name in m.name]
+                names = [m.name for m in f if os.path.basename(
+                    m.name).startswith(sub_name)]
                 assert names, "no %r members" % sub_name
                 for name in sorted(names):
                     batch = pickle.load(f.extractfile(name),

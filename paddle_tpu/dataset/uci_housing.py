@@ -36,19 +36,18 @@ def _load_real(feature_num=14, ratio=0.8):
 
 
 def _parse_real(path, key, feature_num, ratio):
-    if True:
-        _REAL.clear()   # content changed: drop stale parses
-        data = np.fromfile(path, sep=' ')
-        data = data.reshape(data.shape[0] // feature_num, feature_num)
-        maximums = data.max(axis=0)
-        minimums = data.min(axis=0)
-        avgs = data.sum(axis=0) / data.shape[0]
-        for i in range(feature_num - 1):
-            data[:, i] = (data[:, i] - avgs[i]) / (
-                maximums[i] - minimums[i])
-        offset = int(data.shape[0] * ratio)
-        _REAL[key] = (data[:offset], data[offset:])
-        _synth.mark_real_data()
+    _REAL.clear()   # content changed: drop stale parses
+    data = np.fromfile(path, sep=' ')
+    data = data.reshape(data.shape[0] // feature_num, feature_num)
+    maximums = data.max(axis=0)
+    minimums = data.min(axis=0)
+    avgs = data.sum(axis=0) / data.shape[0]
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (
+            maximums[i] - minimums[i])
+    offset = int(data.shape[0] * ratio)
+    _REAL[key] = (data[:offset], data[offset:])
+    _synth.mark_real_data()
 
 
 def _real_reader(split_idx):

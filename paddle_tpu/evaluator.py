@@ -126,12 +126,24 @@ class DetectionMAP(Evaluator):
     eval batch, then eval() for the exact accumulated mAP.
     """
 
-    def __init__(self, input, gt_label, gt_box, class_num,
-                 background_label=0, overlap_threshold=0.5,
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
                  evaluate_difficult=True, ap_version='integral'):
         super(DetectionMAP, self).__init__("map_eval")
         from .ops.detection_map_ref import DetectionMAPState
-        label = layers.concat([gt_label, gt_box], axis=1)
+        if class_num is None:
+            raise ValueError(
+                "DetectionMAP requires class_num; note gt_difficult "
+                "precedes class_num in the signature (reference "
+                "evaluator.py:314-323)")
+        gt_label = layers.cast(x=gt_label, dtype=gt_box.dtype)
+        if gt_difficult is not None:
+            # 6-col [label, difficult, xmin..ymax] layout, matching the
+            # reference evaluator (python/paddle/fluid/evaluator.py:326-331).
+            gt_difficult = layers.cast(x=gt_difficult, dtype=gt_box.dtype)
+            label = layers.concat([gt_label, gt_difficult, gt_box], axis=1)
+        else:
+            label = layers.concat([gt_label, gt_box], axis=1)
         map_out = layers.detection_map(
             input, label, class_num, background_label=background_label,
             overlap_threshold=overlap_threshold,

@@ -152,15 +152,21 @@ class Executor(object):
         # scope chain gains/loses vars. The memo lives ON the scope so
         # it dies with it (no id()-reuse aliasing, no unbounded growth
         # in a long-lived Executor).
-        census = 0
+        census, name_hash = 0, 0
         s = scope
         while s is not None:
             census += len(s.vars)
+            for n in s.vars:
+                # Order-independent fold over the chain's var NAMES, so
+                # replacing a var with a differently-named one (count
+                # unchanged) still invalidates. census guards the
+                # duplicate-name-across-scopes xor cancellation.
+                name_hash ^= hash(n)
             s = s.parent
         memo = getattr(scope, '_state_names_memo', None)
         if memo is None:
             memo = scope._state_names_memo = {}
-        key = (program.fingerprint(), census)
+        key = (program.fingerprint(), census, name_hash)
         hit = memo.get(key)
         if hit is not None:
             return hit

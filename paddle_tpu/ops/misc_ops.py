@@ -425,8 +425,10 @@ def _recv_marker(ctx):
         var = ctx.runner.block._find_var_recursive(name)
         if var is not None and var.shape:
             from ..core.lowering import runtime_dtype
-            ctx.env[name] = jnp.zeros(
-                var.shape, runtime_dtype(var.dtype))
+            # Declared recv shapes may carry -1 (dynamic) dims; substitute
+            # 1 so the placeholder still materialises instead of raising.
+            shape = tuple(d if d > 0 else 1 for d in var.shape)
+            ctx.env[name] = jnp.zeros(shape, runtime_dtype(var.dtype))
 
 
 @register_kernel('listen_and_serv_marker', side_effect=True)

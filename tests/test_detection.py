@@ -287,3 +287,40 @@ def test_detection_map_sequence_tensor_input():
                       fetch_list=[m])[0]
     np.testing.assert_allclose(float(np.asarray(got)), expected,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_detection_map_evaluator_gt_difficult_positional():
+    """Reference evaluator signature places gt_difficult BEFORE class_num
+    (python/paddle/fluid/evaluator.py:314-323); passing it positionally
+    must build the 6-col label layout and honor difficult boxes."""
+    from paddle_tpu.evaluator import DetectionMAP
+    from paddle_tpu.ops.detection_map_ref import detection_map_numpy
+    rng = np.random.RandomState(3)
+    dets, gts = _random_map_case(rng, n_img=1, class_num=3, six_col=True)
+    det, gt = dets[0], gts[0]            # one image, 2-D tensors
+    gt[0, 1] = 1.0                       # mark a difficult box
+    expected = detection_map_numpy(
+        [det], [gt], overlap_threshold=0.5, evaluate_difficult=False,
+        ap_version='integral')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = fluid.layers.data(name='d', shape=[6], dtype='float32')
+        lbl = fluid.layers.data(name='lbl', shape=[1], dtype='float32')
+        dif = fluid.layers.data(name='dif', shape=[1], dtype='float32')
+        box = fluid.layers.data(name='box', shape=[4], dtype='float32')
+        ev = DetectionMAP(d, lbl, box, dif, 3,
+                          evaluate_difficult=False)
+        cur_map, _ = ev.get_map_var()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ev.reset(exe)
+        got, = exe.run(main, feed={
+            'd': det.astype('float32'),
+            'lbl': gt[:, :1].astype('float32'),
+            'dif': gt[:, 1:2].astype('float32'),
+            'box': gt[:, 2:].astype('float32'),
+        }, fetch_list=[cur_map])
+    np.testing.assert_allclose(float(np.asarray(got)), expected,
+                               rtol=1e-4, atol=1e-5)

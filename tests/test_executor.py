@@ -106,3 +106,25 @@ def test_inference_programs_prune_to_fetches():
         l, = exe.run(main, feed={'x': xs, 't': np.ones((2, 1), 'float32')},
                      fetch_list=[loss])
         assert np.isfinite(l).all()
+
+
+def test_state_names_memo_invalidates_on_same_count_rename():
+    # Regression: replacing one scope var with a differently-named one
+    # keeps the var COUNT equal; the memo must still invalidate
+    # (keyed on a name-set hash, not the census alone).
+    main, startup, y = _build_mul()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        names_in, _ = exe._state_names(main, scope)
+        victim = names_in[0]
+        val = scope.vars.pop(victim)
+        scope.set_var(victim + '_renamed', val)   # count unchanged
+        names_in2, _ = exe._state_names(main, scope)
+        assert victim not in names_in2
+        # restore and confirm it comes back
+        scope.vars.pop(victim + '_renamed')
+        scope.set_var(victim, val)
+        names_in3, _ = exe._state_names(main, scope)
+        assert victim in names_in3

@@ -432,3 +432,54 @@ def test_flowers_roundtrip(data_home):
     assert label == 5 - 1                      # labels 0-based
     got_t = list(ds.flowers.test()())
     assert len(got_t) == 1 and got_t[0][1] == 9 - 1
+
+
+def _letor_line(rel, qid, vec, doc):
+    feats = " ".join("%d:%.6f" % (i + 1, v) for i, v in enumerate(vec))
+    return "%d qid:%d %s #docid = %s\n" % (rel, qid, feats, doc)
+
+
+def test_mq2007_letor_roundtrip(data_home):
+    rng = np.random.RandomState(11)
+    d = data_home / 'mq2007'
+    d.mkdir()
+    # q1: rels 2,0,1 -> 3 ordered pairs; q2: all-zero rels -> filtered
+    v = rng.rand(5, 46)
+    with open(d / 'train.txt', 'w') as f:
+        f.write(_letor_line(2, 1, v[0], 'GX0'))
+        f.write(_letor_line(0, 1, v[1], 'GX1'))
+        f.write(_letor_line(1, 1, v[2], 'GX2'))
+        f.write(_letor_line(0, 2, v[3], 'GX3'))
+        f.write(_letor_line(0, 2, v[4], 'GX4'))
+    ds.mq2007._REAL.clear()
+    pairs = list(ds.mq2007.train(format="pairwise")())
+    # ranked q1: [v0(2), v2(1), v1(0)] -> (v0,v2), (v0,v1), (v2,v1)
+    assert len(pairs) == 3
+    for lab, left, right in pairs:
+        assert np.asarray(lab).ravel()[0] == 1
+        assert left.shape == (46,) and right.shape == (46,)
+    np.testing.assert_allclose(pairs[0][1], v[0], atol=1e-6)
+    np.testing.assert_allclose(pairs[0][2], v[2], atol=1e-6)
+    np.testing.assert_allclose(pairs[2][1], v[2], atol=1e-6)
+    np.testing.assert_allclose(pairs[2][2], v[1], atol=1e-6)
+    # pointwise/listwise: ONE item per surviving query (reference quirk)
+    points = list(ds.mq2007.train(format="pointwise")())
+    assert len(points) == 1 and points[0][0] == 2
+    rels, feats = next(iter(ds.mq2007.train(format="listwise")()))
+    assert rels.shape == (3, 1) and feats.shape == (3, 46)
+    assert list(rels.ravel()) == [2, 1, 0]
+    # no test-split cache -> synthetic fallback
+    lab, a, b = next(iter(ds.mq2007.test()()))
+    assert a.shape == (46,)
+
+
+def test_mq2007_corrupt_cache_falls_back(data_home):
+    d = data_home / 'mq2007'
+    d.mkdir()
+    (d / 'train.txt').write_text("not letor at all\n")
+    ds.mq2007._REAL.clear()
+    import warnings as w
+    with w.catch_warnings():
+        w.simplefilter("ignore")
+        lab, a, b = next(iter(ds.mq2007.train()()))
+    assert a.shape == (46,)
