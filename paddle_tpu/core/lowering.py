@@ -122,11 +122,17 @@ class OpCtx(object):
 
 
 class BlockRunner(object):
-    """Executes a Block's op list into an environment of traced values."""
+    """Executes a Block's op list into an environment of traced values.
 
-    def __init__(self, block, grad_mode=False):
+    ``dynamic`` marks the eager dynamic-program mode (executor runs the
+    whole block unjitted with host control flow — beam decode); kernels
+    branch on it for representations that cannot thread a lax loop
+    (list-backed tensor arrays, packed-LoD rows)."""
+
+    def __init__(self, block, grad_mode=False, dynamic=False):
         self.block = block
         self.grad_mode = grad_mode
+        self.dynamic = dynamic
 
     def run_ops(self, ops, env):
         from ..debugging import nan_checks_enabled
@@ -214,7 +220,7 @@ def _find_marker(ops):
 
 
 def lower_block(program, block, feed_names, fetch_names, state_in_names,
-                state_out_names):
+                state_out_names, dynamic=False):
     """Build ``fn(feeds, state) -> (fetches, new_state)`` for jit.
 
     ``feeds``/``state`` are dicts name->array (SequenceTensor allowed).
@@ -228,7 +234,7 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
         env.update(state)
         env.update(feeds)
         if marker_idx < 0:
-            BlockRunner(block).run_ops(ops, env)
+            BlockRunner(block, dynamic=dynamic).run_ops(ops, env)
         else:
             marker = ops[marker_idx]
             param_names = [p for p in marker.attrs['params']]
@@ -241,7 +247,8 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
             def g(param_vals):
                 genv = dict(base_env)
                 genv.update(param_vals)
-                BlockRunner(block, grad_mode=True).run_ops(pre, genv)
+                BlockRunner(block, grad_mode=True,
+                            dynamic=dynamic).run_ops(pre, genv)
                 loss = genv[loss_name]
                 return jnp.sum(loss), genv
 
@@ -274,7 +281,7 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
                 if scale is not None and scale != 1.0:
                     gval = gval * scale
                 env[gname] = gval
-            BlockRunner(block).run_ops(post, env)
+            BlockRunner(block, dynamic=dynamic).run_ops(post, env)
 
         fetches = [env[n] for n in fetch_names]
         new_state = {}

@@ -5,8 +5,10 @@ the reference's semantics: dict files line->index, label dict built
 from B-/I- tag pairs with 'O' last, the words/props gz pair expanded
 per-predicate with bracket-format label decoding, 5-window predicate
 marks and context features. Otherwise a synthetic fallback with the
-same 9-slot schema + BIO label space. get_embedding() stays synthetic
-(the reference returns a path to a binary v1 paddle file)."""
+same 9-slot schema + BIO label space. get_embedding() returns a file
+PATH like the reference (16-byte header + f32 rows): a cached real
+<data_home>/conll05st/emb is served as-is, else a deterministic
+synthetic file keyed by the active dict size is materialized."""
 import gzip
 import itertools
 import tarfile
@@ -92,11 +94,33 @@ def get_dict():
 
 
 def get_embedding():
-    # sized to the ACTIVE word dict (real caches are rarely 44068 rows);
-    # _real_dicts so the synthetic embedding never flips is_synthetic()
+    """Path of the pretrained-embedding file, like the reference
+    (python/paddle/dataset/conll05.py:214 returns the downloaded file).
+    Format: 16-byte header + f32 rows (book scripts read it via
+    np.fromfile after f.read(16)). A cached real file is served as-is;
+    otherwise a deterministic synthetic one is materialized, sized to
+    the ACTIVE word dict."""
+    import os
+    from .common import data_home
+    real_path = os.path.join(data_home(), 'conll05st', 'emb')
+    if os.path.exists(real_path):
+        return real_path
     real = _real_dicts()
     n = len(real[0]) if real is not None else _WORD_VOCAB
-    return _synth.rng('conll05_emb').rand(n, 32).astype('float32')
+    # distinct filename keyed by the ACTIVE dict size, so a later real
+    # cache (or a different dict) is never shadowed by a stale synth file
+    path = os.path.join(data_home(), 'conll05st',
+                        'emb.synthetic.%d' % n)
+    if os.path.exists(path):
+        return path
+    emb = _synth.rng('conll05_emb').rand(n, 32).astype('float32')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + '.tmp'
+    with open(tmp, 'wb') as f:
+        f.write(b'\x00' * 16)
+        emb.tofile(f)
+    os.replace(tmp, path)
+    return path
 
 
 def _corpus_reader(data_path, words_name, props_name):

@@ -59,7 +59,9 @@ def word_dict():
 def build_dict(pattern=None, cutoff=150):
     path = cached_path('imdb', _ARCHIVE)
     if path is None:
-        return {('w%d' % i): i for i in range(_VOCAB)}
+        d = {('w%d' % i): i for i in range(_VOCAB - 1)}
+        d['<unk>'] = _VOCAB - 1   # reference dicts end with <unk>
+        return d
     try:
         pattern = pattern or re.compile(r"aclImdb/((train)|(test))/((pos)|"
                                         r"(neg))/.*\.txt$")
@@ -78,7 +80,9 @@ def build_dict(pattern=None, cutoff=150):
     except Exception as e:
         warnings.warn("imdb cache unreadable (%s); using synthetic "
                       "vocab" % e)
-        return {('w%d' % i): i for i in range(_VOCAB)}
+        d = {('w%d' % i): i for i in range(_VOCAB - 1)}
+        d['<unk>'] = _VOCAB - 1   # reference dicts end with <unk>
+        return d
 
 
 def _real_reader(pos_pattern, neg_pattern, word_idx):

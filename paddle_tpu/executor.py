@@ -26,6 +26,56 @@ __all__ = ['Executor', 'global_scope', 'scope_guard', 'switch_scope',
            'fetch_var', 'as_numpy']
 
 
+class VarBinding(object):
+    """Live handle to a scope slot. Parity: the runtime ``Variable``
+    returned by ``Scope::FindVar`` — reference scripts write pretrained
+    params through ``find_var(name).get_tensor().set(np, place)``
+    (book/test_label_semantic_roles.py:204-208). Reads delegate to the
+    current value, so jax-array attributes (``sharding``,
+    ``addressable_shards``, ``shape``) keep working on the handle."""
+
+    __slots__ = ('_scope', '_name')
+
+    def __init__(self, scope, name):
+        object.__setattr__(self, '_scope', scope)
+        object.__setattr__(self, '_name', name)
+
+    def value(self):
+        return self._scope.raw(self._name)
+
+    def get_tensor(self):
+        return self
+
+    def set(self, array, place=None):
+        import jax.numpy as jnp
+        val = self.value()
+        if isinstance(val, SequenceTensor):
+            val.set(array, place)
+            return
+        arr = np.asarray(array)
+        if val is not None and hasattr(val, 'dtype'):
+            arr = arr.astype(val.dtype)
+        # write into the scope that actually owns the slot
+        s = self._scope
+        while s is not None and self._name not in s.vars:
+            s = s.parent
+        (s or self._scope).vars[self._name] = jnp.asarray(arr)
+
+    def lod(self):
+        val = self.value()
+        return val.lod() if isinstance(val, SequenceTensor) else []
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(as_numpy(self.value()))
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __getattr__(self, attr):
+        return getattr(self.value(), attr)
+
+    def __repr__(self):
+        return "VarBinding(%r -> %r)" % (self._name, self.value())
+
+
 class Scope(object):
     """name -> runtime value (jax array / SequenceTensor). Parity: Scope."""
 
@@ -33,11 +83,22 @@ class Scope(object):
         self.vars = {}
         self.parent = parent
 
-    def find_var(self, name):
+    def raw(self, name):
+        """The stored runtime value (internal fast path)."""
         s = self
         while s is not None:
             if name in s.vars:
                 return s.vars[name]
+            s = s.parent
+        return None
+
+    def find_var(self, name):
+        """Reference-style handle (or None): supports ``.get_tensor()``
+        ``.set(np, place)`` and delegates reads to the live value."""
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return VarBinding(self, name)
             s = s.parent
         return None
 
@@ -79,7 +140,16 @@ def scope_guard(scope):
 
 
 def as_numpy(value):
+    if isinstance(value, VarBinding):
+        value = value.value()
     if isinstance(value, SequenceTensor):
+        if value.lengths is None:
+            # packed/dense-wrapped mode: preserve offsets, not lengths
+            out = SequenceTensor(np.asarray(value.data), None)
+            out._packed = out.data
+            out._offsets = None if value._offsets is None else \
+                [list(level) for level in value._offsets]
+            return out
         return SequenceTensor(np.asarray(value.data),
                               np.asarray(value.lengths),
                               None if value.sub_lengths is None
@@ -91,7 +161,7 @@ def as_numpy(value):
 
 def fetch_var(name, scope=None, return_numpy=True):
     scope = scope or global_scope()
-    val = scope.find_var(name)
+    val = scope.raw(name)
     if return_numpy and val is not None:
         return as_numpy(val)
     return val
@@ -110,17 +180,63 @@ def _spec(val):
     return (tuple(arr.shape), str(arr.dtype))
 
 
+def _block_has(block, types):
+    for op in block.ops:
+        if op.type in types:
+            return True
+        sub = op.attrs.get('sub_block')
+        if sub is not None and _block_has(sub, types):
+            return True
+    return False
+
+
+def _is_dynamic_program(program):
+    """True when a While sub-block contains beam search: beam topology is
+    data-dependent (reference runs it op-by-op on host), so the program
+    executes EAGERLY — host control flow + concrete values, exactly the
+    reference Executor's model — instead of one jitted XLA computation.
+    Training/static-decode programs keep the jitted whole-block path."""
+    for b in program.blocks:
+        for op in b.ops:
+            sub = op.attrs.get('sub_block')
+            if op.type == 'while' and sub is not None and _block_has(
+                    sub, ('beam_search',)):
+                return True
+    return False
+
+
 class Executor(object):
     def __init__(self, place=None):
         self.place = place or _places.TPUPlace(0)
         self._cache = {}
 
     # -------------------------------------------------------------------------
-    def _prepare_feed(self, program, feed):
+    def _prepare_feed(self, program, feed, dynamic=False):
         block = program.global_block()
         out = {}
         for name, val in feed.items():
             var = block._find_var_recursive(name)
+            if dynamic and isinstance(val, SequenceTensor) and \
+                    val._packed is not None and val._offsets and \
+                    len(val._offsets) >= 2:
+                # eager dynamic programs consume 2-level (beam-world)
+                # feeds in the reference's packed-rows + offset-LoD
+                # layout directly; level-1 sequence feeds keep the
+                # padded layout for the scan-based sequence kernels
+                out[name] = SequenceTensor.from_packed(
+                    jnp.asarray(val._packed), val._offsets)
+                continue
+            if isinstance(val, SequenceTensor) and val.lengths is None:
+                # imperative LoDTensor with set() but no set_lod():
+                # a plain dense tensor in reference semantics
+                val = val.data
+            elif isinstance(val, SequenceTensor) and \
+                    val._packed is not None and var is not None and \
+                    not getattr(var, 'lod_level', 0):
+                # LoD metadata on a feed whose var is declared dense
+                # (lod_level 0): reference semantics treat the lod as
+                # row bookkeeping over the same packed data — drop it
+                val = val._packed
             if isinstance(val, SequenceTensor):
                 if isinstance(val.data, jax.Array):
                     # Device-resident sequence feed: no host round-trip.
@@ -231,7 +347,12 @@ class Executor(object):
 
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
-        feed = self._prepare_feed(program, feed)
+        dynamic = program.__dict__.setdefault(
+            '_dynamic_memo', {}).get(program.fingerprint())
+        if dynamic is None:
+            dynamic = _is_dynamic_program(program)
+            program._dynamic_memo[program.fingerprint()] = dynamic
+        feed = self._prepare_feed(program, feed, dynamic=dynamic)
         state_in_names, state_out_names = self._state_names(program, scope)
         if scope.find_var(RNG_KEY) is None:
             scope.set_var(RNG_KEY,
@@ -252,10 +373,12 @@ class Executor(object):
             lower_prog = self._maybe_prune(program, fetch_names)
             fn = lower_block(lower_prog, lower_prog.global_block(),
                              sorted(feed.keys()), fetch_names,
-                             state_in_names, state_out_names)
-            if profiling:
-                # Per-op profiling: run UN-jitted so the lowering
-                # executes (and times) op by op on the device.
+                             state_in_names, state_out_names,
+                             dynamic=dynamic)
+            if profiling or dynamic:
+                # Per-op profiling and dynamic (beam-decode) programs run
+                # UN-jitted: the lowering executes op by op on the device
+                # with concrete values and host control flow.
                 jitted = fn
             elif guard:
                 # Debug mode: functionalize the per-op NaN/Inf checks.
@@ -269,10 +392,10 @@ class Executor(object):
         else:
             jitted = entry
 
-        state = {n: scope.find_var(n) for n in state_in_names}
+        state = {n: scope.raw(n) for n in state_in_names}
 
         with jax.default_device(self.place.jax_device()):
-            if guard and not profiling:
+            if guard and not (profiling or dynamic):
                 err, (fetches, new_state) = jitted(feed, state)
                 err.throw()
             else:
@@ -282,6 +405,11 @@ class Executor(object):
             scope.set_var(n, v)
         if return_numpy:
             fetches = [as_numpy(f) for f in fetches]
+        else:
+            # reference contract: fetches are LoDTensors; a dense fetch
+            # still answers .lod() (with []) — wrap bare arrays
+            fetches = [SequenceTensor(f, None) if isinstance(
+                f, (jax.Array, np.ndarray)) else f for f in fetches]
         return fetches
 
     def close(self):

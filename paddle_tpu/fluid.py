@@ -9,7 +9,7 @@ Reference scripts do `import paddle.fluid as fluid`; with paddle_tpu:
 __path__ = []
 from . import (framework, layers, initializer, regularizer, clip, optimizer,  # noqa
                backward, unique_name, io, nets, metrics, evaluator, average,
-               profiler, core)
+               profiler, core, param_attr, executor, transpiler)
 from .framework import (Program, Block, Variable, Operator,  # noqa
                         default_startup_program, default_main_program,
                         program_guard, switch_startup_program,

@@ -39,7 +39,7 @@ def _save_var_list(executor, dirname, var_names, scope=None, filename=None):
     os.makedirs(dirname, exist_ok=True)
     arrays = {}
     for name in var_names:
-        val = scope.find_var(name)
+        val = scope.raw(name)
         if val is None:
             continue
         arrays[name] = np.asarray(as_numpy(val))
@@ -269,7 +269,7 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
         scope = global_scope()
         state = {}
         for var in filter(is_persistable, program.list_vars()):
-            val = scope.find_var(var.name)
+            val = scope.raw(var.name)
             if val is None:
                 continue
             # jax.Arrays go to orbax directly so sharded saves stay

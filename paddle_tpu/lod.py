@@ -38,13 +38,77 @@ def bucket_length(n):
 
 
 class SequenceTensor(object):
-    """Dense padded sequences + lengths. Registered as a JAX pytree."""
+    """Dense padded sequences + lengths. Registered as a JAX pytree.
 
-    def __init__(self, data, lengths, sub_lengths=None):
+    Also constructible the reference's imperative way
+    (book/test_machine_translation.py:157-171):
+    ``t = fluid.LoDTensor(); t.set(rows, place); t.set_lod([offsets])``
+    — packed rows + offset LoD are converted to the padded layout. With
+    ``set`` but no ``set_lod`` the tensor behaves as a plain dense array
+    (lengths is None); the feed path unwraps it.
+    """
+
+    def __init__(self, data=None, lengths=None, sub_lengths=None):
         self.data = data
         self.lengths = lengths
         # level-2 LoD support: lengths of inner sequences, [batch, padded_outer]
         self.sub_lengths = sub_lengths
+        self._packed = None
+        self._offsets = None
+
+    @classmethod
+    def from_packed(cls, rows, offsets):
+        """Packed-mode tensor: reference layout [sum_rows, *feat] + offset
+        LoD, no padded conversion. Used by the eager dynamic-decode path
+        (host-interpreted While + beam search), where row counts change
+        per step and the reference's own packed representation is the
+        natural one."""
+        st = cls()
+        st.data = rows
+        st.lengths = None
+        st._packed = rows
+        st._offsets = [list(level) for level in offsets]
+        return st
+
+    @property
+    def packed_mode(self):
+        return self.lengths is None and self._offsets is not None
+
+    def offsets(self):
+        """Absolute offset LoD (packed mode), or computed from lengths."""
+        if self._offsets is not None:
+            return [list(level) for level in self._offsets]
+        return self.lod()
+
+    def set(self, array, place=None):
+        """Reference LoDTensor.set(np_array, place): packed rows."""
+        self._packed = np.asarray(array)
+        self._rebuild()
+
+    def set_lod(self, lod):
+        """Reference LoDTensor.set_lod(offset_lod): per-level offsets."""
+        self._offsets = [list(level) for level in lod]
+        self._rebuild()
+
+    def _rebuild(self):
+        if self._packed is None:
+            return
+        if not self._offsets:
+            self.data = self._packed
+            self.lengths = None
+            return
+        lens = [[off[i + 1] - off[i] for i in range(len(off) - 1)]
+                for off in self._offsets]
+        built = create_lod_tensor(self._packed, lens)
+        self.data = built.data
+        self.lengths = built.lengths
+        self.sub_lengths = built.sub_lengths
+
+    def __array__(self, dtype=None, copy=None):
+        """np.array(t) recovers the reference's packed-rows layout."""
+        arr = (np.asarray(self.data) if self.lengths is None
+               else self.to_dense_rows())
+        return arr.astype(dtype) if dtype is not None else arr
 
     @property
     def shape(self):
@@ -70,8 +134,16 @@ class SequenceTensor(object):
 
     def lod(self):
         """Reference-style offset LoD (for compatibility display)."""
+        if self.lengths is None:
+            return [list(level) for level in (self._offsets or [])]
         lens = np.asarray(self.lengths)
-        return [np.concatenate([[0], np.cumsum(lens)]).tolist()]
+        out = [np.concatenate([[0], np.cumsum(lens)]).tolist()]
+        if self.sub_lengths is not None:
+            sub = np.asarray(self.sub_lengths)
+            inner = [int(sub[i, j]) for i in range(len(lens))
+                     for j in range(int(lens[i]))]
+            out.append(np.concatenate([[0], np.cumsum(inner)]).tolist())
+        return out
 
     def to_dense_rows(self):
         """Back to the reference's packed [sum(lengths), ...] layout (host)."""

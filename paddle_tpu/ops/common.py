@@ -11,6 +11,8 @@ def unwrap(v):
 
 def rewrap(template, data):
     if isinstance(template, SequenceTensor):
+        if template.packed_mode:
+            return SequenceTensor.from_packed(data, template.offsets())
         return SequenceTensor(data, template.lengths, template.sub_lengths)
     return data
 

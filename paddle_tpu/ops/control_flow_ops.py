@@ -30,11 +30,31 @@ _DEFAULT_CAP = int(os.environ.get('PADDLE_TPU_ARRAY_CAP', 128))
 
 # ---- tensor arrays --------------------------------------------------------------
 def _is_array(v):
-    return isinstance(v, dict) and 'buf' in v and 'len' in v
+    return isinstance(v, dict) and (('buf' in v) or ('list' in v)) \
+        and 'len' in v
+
+
+def _is_list_array(v):
+    return isinstance(v, dict) and 'list' in v
 
 
 def make_array(buf, length):
     return {'buf': buf, 'len': jnp.asarray(length, jnp.int32)}
+
+
+def _list_to_buf(arr):
+    """Promote a list-backed array to the uniform buffer form (needed
+    when a traced index reaches it inside lax control flow). Elements
+    must share a shape by then — true for static decode paths. Gaps
+    left by non-contiguous writes become zero elements."""
+    elems = [None if e is None else
+             (jnp.asarray(e.data) if isinstance(e, SequenceTensor)
+              else jnp.asarray(e)) for e in arr['list']]
+    proto = next((e for e in elems if e is not None), None)
+    if proto is None:
+        raise ValueError("cannot promote an all-empty tensor array")
+    elems = [jnp.zeros_like(proto) if e is None else e for e in elems]
+    return make_array(jnp.stack(elems), len(elems))
 
 
 @register_kernel('write_to_array')
@@ -43,13 +63,28 @@ def _write_to_array(ctx):
     i = jnp.asarray(ctx.input('I')).reshape(()).astype(jnp.int32)
     name = ctx.output_name('Out')
     arr = ctx.env.get(name)
-    x = jnp.asarray(x.data) if isinstance(x, SequenceTensor) else \
-        jnp.asarray(x)
     concrete_i = None
     try:
         concrete_i = int(i)
     except Exception:
         pass  # traced index (inside a loop): capacity must already fit
+    if ctx.runner.dynamic and concrete_i is not None and (
+            arr is None or not _is_array(arr) or _is_list_array(arr)):
+        # Eager dynamic mode only: host-indexed writes keep a LIST of
+        # heterogeneous elements — the reference's LoDTensorArray.
+        # Shapes and LoD may differ per step (dynamic beam decode);
+        # SequenceTensors survive intact. Jitted/profiling runs keep
+        # the uniform buffer so lax loops can carry the array.
+        lst = list(arr['list']) if _is_list_array(arr) else []
+        while len(lst) <= concrete_i:
+            lst.append(None)
+        lst[concrete_i] = x
+        ctx.env[name] = {'list': lst, 'len': len(lst)}
+        return
+    if _is_list_array(arr):
+        arr = _list_to_buf(arr)
+    x = jnp.asarray(x.data) if isinstance(x, SequenceTensor) else \
+        jnp.asarray(x)
     if not _is_array(arr):
         cap = _DEFAULT_CAP if concrete_i is None else \
             max(_DEFAULT_CAP, concrete_i + 1)
@@ -71,6 +106,14 @@ def _read_from_array(ctx):
     if not _is_array(arr):
         raise TypeError("read_from_array on a non-array value")
     i = jnp.asarray(ctx.input('I')).reshape(()).astype(jnp.int32)
+    if _is_list_array(arr):
+        try:
+            # clamp like the buffer path (dynamic_index_in_dim semantics)
+            idx = min(max(int(i), 0), len(arr['list']) - 1)
+            ctx.set_output('Out', arr['list'][idx])
+            return
+        except jax.errors.TracerIntegerConversionError:
+            arr = _list_to_buf(arr)
     ctx.set_output('Out', jax.lax.dynamic_index_in_dim(
         arr['buf'], i, 0, keepdims=False))
 
@@ -78,7 +121,8 @@ def _read_from_array(ctx):
 @register_kernel('lod_array_length')
 def _lod_array_length(ctx):
     arr = ctx.input('X')
-    ctx.set_output('Out', jnp.reshape(arr['len'], (1,)))
+    ctx.set_output('Out', jnp.reshape(
+        jnp.asarray(arr['len'], jnp.int32), (1,)))
 
 
 # ---- LoD rank table machinery ---------------------------------------------------
@@ -193,8 +237,8 @@ def _written_names(block):
     return names
 
 
-def _run_sub_block(block, env, grad_mode):
-    runner = BlockRunner(block, grad_mode=grad_mode)
+def _run_sub_block(block, env, grad_mode, dynamic=False):
+    runner = BlockRunner(block, grad_mode=grad_mode, dynamic=dynamic)
     runner.run_ops(list(block.ops), env)
     return env
 
@@ -207,6 +251,24 @@ def _while(ctx):
     block = ctx.attr('sub_block')
     cond_name = ctx.input_name('Condition')
     env = ctx.env
+    cond0 = env.get(cond_name)
+    if ctx.runner.dynamic and cond0 is not None and \
+            not isinstance(cond0, jax.core.Tracer):
+        # Eager dynamic mode (reference while_op semantics): the
+        # condition is concrete, so interpret the loop on the host.
+        # Each iteration runs with its OWN shapes — beam widths and
+        # row counts may grow step to step (dynamic decode). The policy
+        # deciding which programs run this way lives in ONE place:
+        # executor._is_dynamic_program.
+        grad_mode = ctx.runner.grad_mode
+        iters = 0
+        while bool(jnp.asarray(env[cond_name]).reshape(())):
+            _run_sub_block(block, env, grad_mode, dynamic=True)
+            iters += 1
+            if iters > 100000:
+                raise RuntimeError("while: >100000 host iterations — "
+                                   "non-terminating loop?")
+        return
     carry_names = [n for n in _written_names(block) if n in env]
     if cond_name not in carry_names:
         if cond_name not in env:
@@ -253,7 +315,8 @@ def _conditional_block(ctx):
     written = _written_names(block)
     old = {n: env[n] for n in written if n in env}
     benv = dict(env)
-    _run_sub_block(block, benv, ctx.runner.grad_mode)
+    _run_sub_block(block, benv, ctx.runner.grad_mode,
+                   dynamic=ctx.runner.dynamic)
     scalar = bool(ctx.attr('is_scalar_condition', False))
     for n in written:
         if n not in benv:

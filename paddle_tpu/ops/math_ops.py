@@ -21,12 +21,24 @@ def _elementwise(name, fn):
         xd, yd = unwrap(x), unwrap(y)
         axis = ctx.attr('axis', -1)
         from ..lod import SequenceTensor
-        if (isinstance(x, SequenceTensor)
+        if (isinstance(x, SequenceTensor) and not x.packed_mode
                 and not isinstance(y, SequenceTensor)
                 and axis not in (None, -1) and axis >= 1):
             # IR shapes follow the reference's packed [total, ...] layout;
             # runtime data is padded [B, T, ...] so dims >= 1 shift by one.
+            # packed-mode data IS the reference layout: no shift.
             axis += 1
+        if (isinstance(x, SequenceTensor) and not x.packed_mode
+                and not isinstance(y, SequenceTensor) and axis == 0
+                and getattr(yd, 'ndim', 0) >= 1 and xd.ndim >= 2
+                and _prod(yd.shape) == xd.shape[0] * xd.shape[1]):
+            # reference row-broadcast: y is one value per PACKED row
+            # ([total]); padded rows are [B, T] row-major, same order
+            # (attention weight scaling in benchmark/fluid
+            # machine_translation's simple_attention)
+            yd = jnp.asarray(yd).reshape(
+                (xd.shape[0], xd.shape[1]) + (1,) * (xd.ndim - 2))
+            axis = -1
         yd = bcast_y(xd, yd, axis)
         out = fn(jnp.asarray(xd), yd)
         if ctx.attr('scale', None) not in (None, 1.0):
@@ -214,8 +226,9 @@ def _mul(ctx):
     yd = ctx.attr('y_num_col_dims', 1)
     from ..lod import SequenceTensor
     is_seq = isinstance(x_in, SequenceTensor)
-    if is_seq:
+    if is_seq and not x_in.packed_mode:
         xd += 1  # [B, T] both count as row dims
+    # packed mode keeps the reference's [total, D] layout: xd stays 1
     xs, ys = x.shape, y.shape
     x2 = x.reshape((_prod(xs[:xd]), _prod(xs[xd:])))
     y2 = y.reshape((_prod(ys[:yd]), _prod(ys[yd:])))

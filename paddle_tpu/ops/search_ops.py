@@ -24,8 +24,73 @@ def _rows(v):
     return jnp.asarray(d)
 
 
+def _beam_search_dynamic(ctx, pre):
+    """Reference-exact dynamic path (operators/beam_search_op.cc):
+    2-level LoD candidates, per-source top-K across live beams, finished
+    beams pruned so row counts SHRINK. Engaged on the eager executor
+    (host-interpreted While) where values are concrete and shapes may
+    change every step; the static [B*K] path below covers jitted decodes.
+    """
+    import numpy as np
+    ids = np.asarray(_rows(ctx.input('ids')))
+    scores = np.asarray(_rows(ctx.input('scores')), np.float32)
+    if ids.ndim == 1:
+        ids = ids[:, None]
+    if scores.ndim == 1:
+        scores = scores[:, None]
+    K = int(ctx.attr('beam_size'))
+    end_id = int(ctx.attr('end_id'))
+    level = int(ctx.attr('level', 0))
+    offs = pre.offsets()
+    # ToAbsOffset (beam_search_op.cc:30): level-0 entries index the next
+    # level's entries, not rows; compose down to absolute ROW offsets so
+    # every live beam row of a source is scanned (from step 2 on,
+    # lod[0]=[0,1,2] over lod[1]=[0,K,2K] must become [0,K,2K])
+    high = [int(o) for o in offs[level]]
+    for lv in range(level + 1, len(offs)):
+        nxt = offs[lv]
+        high = [int(nxt[i]) for i in high]
+    N, C = ids.shape
+    pre_data = np.asarray(pre.data).reshape(-1)
+
+    buckets = [[] for _ in range(N)]   # per parent row, selected items
+    for s in range(len(high) - 1):
+        items = [(r, int(ids[r, d]), float(scores[r, d]))
+                 for r in range(high[s], high[s + 1]) for d in range(C)]
+        items.sort(key=lambda it: -it[2])
+        for it in items[:K]:
+            buckets[it[0]].append(it)
+    for r in range(N):                 # PruneEndidCandidates
+        if int(pre_data[r]) == end_id:
+            buckets[r] = []
+
+    out_ids, out_scores, parents, low = [], [], [], [0]
+    for r in range(N):
+        for it in sorted(buckets[r], key=lambda it: (it[0], it[1])):
+            out_ids.append(it[1])
+            out_scores.append(it[2])
+            parents.append(r)
+        low.append(len(out_ids))
+    # output lod[0] = the ABS high_level (parent-row offsets — also the
+    # index space of lod[1]'s buckets), exactly like the reference
+    lod = [high, low]
+    ctx.set_output('selected_ids', SequenceTensor.from_packed(
+        jnp.asarray(np.array(out_ids, np.int32).reshape(-1, 1)), lod))
+    ctx.set_output('selected_scores', SequenceTensor.from_packed(
+        jnp.asarray(np.array(out_scores, np.float32).reshape(-1, 1)), lod))
+    if ctx.output_names('parent_idx'):
+        ctx.set_output('parent_idx', SequenceTensor.from_packed(
+            jnp.asarray(np.array(parents, np.int32).reshape(-1, 1)), lod))
+
+
 @register_kernel('beam_search')
 def _beam_search(ctx):
+    pre = ctx.input('pre_ids')
+    if isinstance(pre, SequenceTensor) and pre.packed_mode and \
+            len(pre.offsets()) >= 2 and \
+            not isinstance(pre.data, jax.core.Tracer):
+        _beam_search_dynamic(ctx, pre)
+        return
     pre_ids = _rows(ctx.input('pre_ids')).reshape(-1)          # [B*K]
     ids = _rows(ctx.input('ids'))                              # [B*K, C]
     scores = _rows(ctx.input('scores'))                        # [B*K, C]
@@ -61,6 +126,74 @@ def _beam_search(ctx):
         ctx.set_output('parent_idx', parent.reshape(BK, 1))
 
 
+def _beam_search_decode_dynamic(ctx, ids_list, scores_list):
+    """Reference-exact PackAllSteps (operators/beam_search_decode_op.h):
+    walk the per-step LoD trees, closing a sentence when a prefix has no
+    children; emit all sentences per source with a fresh 2-level LoD."""
+    import numpy as np
+    steps = []
+    for st_i, st_s in zip(ids_list, scores_list):
+        offs = st_i.offsets() if isinstance(st_i, SequenceTensor) else None
+        ivals = np.asarray(
+            st_i.data if isinstance(st_i, SequenceTensor) else st_i
+        ).reshape(-1)
+        svals = np.asarray(
+            st_s.data if isinstance(st_s, SequenceTensor) else st_s,
+            np.float32).reshape(-1)
+        steps.append((ivals, svals, offs))
+    src_num = len(steps[0][2][0]) - 1
+
+    def make_sentence(node):
+        words, scs = [], []
+        while node is not None:
+            words.append(node[0])
+            scs.append(node[1])
+            node = node[2]
+        return words[::-1], scs[::-1]
+
+    prefixes = []                      # per source: list of leaf nodes
+    sentences = [[] for _ in range(src_num)]
+    for ivals, svals, offs in steps:   # PackTwoSteps per step
+        high, low = offs[0], offs[1] if len(offs) > 1 else None
+        new_prefixes = []
+        for s in range(src_num):
+            src_start, src_end = int(high[s]), int(high[s + 1])
+            nodes = []
+            if not prefixes:           # first step: roots
+                for r in range(src_start, src_end):
+                    nodes.append((int(ivals[r]), float(svals[r]), None))
+            else:
+                pref = prefixes[s]
+                for pi, prefix in enumerate(pref):
+                    c0 = int(low[src_start + pi])
+                    c1 = int(low[src_start + pi + 1])
+                    if c0 == c1:       # finished: collect the sentence
+                        sentences[s].append(make_sentence(prefix))
+                    else:
+                        for r in range(c0, c1):
+                            nodes.append((int(ivals[r]), float(svals[r]),
+                                          prefix))
+            new_prefixes.append(nodes)
+        prefixes = new_prefixes
+    for s in range(src_num):           # append surviving prefixes
+        for node in prefixes[s]:
+            sentences[s].append(make_sentence(node))
+
+    src_lod, sent_lod = [0], [0]
+    id_data, sc_data = [], []
+    for s in range(src_num):
+        for words, scs in sentences[s]:
+            id_data.extend(words)
+            sc_data.extend(scs)
+            sent_lod.append(sent_lod[-1] + len(words))
+        src_lod.append(src_lod[-1] + len(sentences[s]))
+    lod = [src_lod, sent_lod]
+    ctx.set_output('SentenceIds', SequenceTensor.from_packed(
+        jnp.asarray(np.array(id_data, np.int32)), lod))
+    ctx.set_output('SentenceScores', SequenceTensor.from_packed(
+        jnp.asarray(np.array(sc_data, np.float32)), lod))
+
+
 @register_kernel('beam_search_decode')
 def _beam_search_decode(ctx):
     """Backtrack tensor arrays of (ids, scores, parents) written once per
@@ -70,6 +203,19 @@ def _beam_search_decode(ctx):
     ids_arr = ctx.input('Ids')
     scores_arr = ctx.input('Scores')
     parents_arr = ctx.input('Parents')
+    if isinstance(ids_arr, dict) and 'list' in ids_arr:
+        ids_list = [e for e in ids_arr['list'] if e is not None]
+        sc_list = [e for e in scores_arr['list'] if e is not None]
+        if ids_list and isinstance(ids_list[0], SequenceTensor) and \
+                ids_list[0].packed_mode:
+            _beam_search_decode_dynamic(ctx, ids_list, sc_list)
+            return
+        # uniform elements: fall through to the static backtrack
+        from .control_flow_ops import _list_to_buf
+        ids_arr = _list_to_buf(ids_arr)
+        scores_arr = _list_to_buf(scores_arr)
+        if isinstance(parents_arr, dict) and 'list' in parents_arr:
+            parents_arr = _list_to_buf(parents_arr)
     if not (isinstance(ids_arr, dict) and 'buf' in ids_arr):
         raise TypeError("beam_search_decode expects tensor arrays "
                         "(array_write the step outputs)")

@@ -141,7 +141,21 @@ def _sequence_expand(ctx):
     Canonical NMT use: x [B, D] dense -> broadcast each row over y's
     timesteps; x a SequenceTensor -> re-lengthed to y's lengths."""
     x_in = ctx.input('X')
-    y = _seq(ctx.input('Y'), 'Y')
+    y_in = ctx.input('Y')
+    if isinstance(y_in, SequenceTensor) and y_in.packed_mode:
+        # packed-rows path (operators/sequence_expand_op.h): repeat x row
+        # i by the i-th size of y's ref lod level (default: last level)
+        ref_level = int(ctx.attr('ref_level', -1))
+        offs = y_in.offsets()
+        ref = offs[ref_level if ref_level >= 0 else len(offs) - 1]
+        xd = jnp.asarray(x_in.data if isinstance(x_in, SequenceTensor)
+                         else x_in)
+        repeats = [int(ref[i + 1] - ref[i]) for i in range(len(ref) - 1)]
+        out = jnp.repeat(xd, jnp.asarray(repeats), axis=0,
+                         total_repeat_length=int(sum(repeats)))
+        ctx.set_output('Out', SequenceTensor.from_packed(out, offs))
+        return
+    y = _seq(y_in, 'Y')
     T = y.data.shape[1]
     if isinstance(x_in, SequenceTensor):
         xd = jnp.asarray(x_in.data)
@@ -190,7 +204,16 @@ def _lod_reset(ctx):
     """Re-segment x's packed rows into new sequence lengths.
     Parity: operators/lod_reset_op.* — there it only swaps the offset
     table; in the padded layout the rows must actually be regrouped."""
-    packed = _to_packed(ctx.input('X'))
+    x_in = ctx.input('X')
+    y_in = ctx.input('Y') if ctx.has_input('Y') else None
+    if isinstance(y_in, SequenceTensor) and y_in.packed_mode:
+        # packed world: exactly the reference — same rows, y's offsets
+        xd = jnp.asarray(x_in.data if isinstance(x_in, SequenceTensor)
+                         else x_in)
+        ctx.set_output('Out', SequenceTensor.from_packed(
+            xd, y_in.offsets()))
+        return
+    packed = _to_packed(x_in)
     T_out = None
     if ctx.has_input('Y'):
         y = ctx.input('Y')

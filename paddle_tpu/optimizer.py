@@ -479,7 +479,7 @@ class ModelAverage(Optimizer):
         scope = scope or global_scope()
         program = framework.default_main_program()
         for p in program.global_block().all_parameters():
-            val = scope.find_var(p.name)
+            val = scope.raw(p.name)
             if val is None:
                 continue
             arr = np.asarray(val)
@@ -505,7 +505,7 @@ class ModelAverage(Optimizer):
             scope = global_scope()
             self._backup = {}
             for name, total in self._sums.items():
-                cur = scope.find_var(name)
+                cur = scope.raw(name)
                 if cur is None or self._num == 0:
                     continue
                 self._backup[name] = cur

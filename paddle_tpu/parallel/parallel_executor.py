@@ -123,7 +123,7 @@ class ParallelExecutor(object):
                     donate_argnums=(1,))
             self._cache[key] = jitted
 
-        state = {n: scope.find_var(n) for n in state_in}
+        state = {n: scope.raw(n) for n in state_in}
         with self._mesh:
             if guard:
                 err, (fetches, new_state) = jitted(feed, state)

@@ -216,12 +216,12 @@ class InferenceTranspiler(object):
             vals = {}
             ok = True
             for slot in ('Scale', 'Bias', 'Mean', 'Variance'):
-                v = scope.find_var(bn.inputs[slot][0])
+                v = scope.raw(bn.inputs[slot][0])
                 if v is None:
                     ok = False
                     break
                 vals[slot] = np.asarray(v, np.float32)
-            w_val = scope.find_var(w_name)
+            w_val = scope.raw(w_name)
             if not ok or w_val is None:
                 i += 1
                 continue

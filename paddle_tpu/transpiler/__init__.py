@@ -1,0 +1,8 @@
+"""Transpiler package facade. Parity: python/paddle/fluid/transpiler/
+(__init__ re-exports; implementations live in paddle_tpu.parallel)."""
+from ..parallel.transpiler import (DistributeTranspiler,  # noqa
+                                   InferenceTranspiler, memory_optimize,
+                                   release_memory)
+
+__all__ = ['DistributeTranspiler', 'InferenceTranspiler',
+           'memory_optimize', 'release_memory']

@@ -1,0 +1,6 @@
+"""Parity: python/paddle/fluid/transpiler/distribute_transpiler.py —
+module path kept for scripts importing it directly
+(benchmark/fluid/fluid_benchmark.py:26)."""
+from ..parallel.transpiler import DistributeTranspiler  # noqa
+
+__all__ = ['DistributeTranspiler']

@@ -1,0 +1,4 @@
+"""Parity: python/paddle/fluid/transpiler/inference_transpiler.py."""
+from ..parallel.transpiler import InferenceTranspiler  # noqa
+
+__all__ = ['InferenceTranspiler']

@@ -1,0 +1,4 @@
+"""Parity: python/paddle/fluid/transpiler/memory_optimization_transpiler.py."""
+from ..parallel.transpiler import memory_optimize, release_memory  # noqa
+
+__all__ = ['memory_optimize', 'release_memory']

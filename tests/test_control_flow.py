@@ -192,3 +192,36 @@ def test_lod_rank_table_array_round_trip():
     off = np.concatenate([[0], np.cumsum(lens)])
     want = np.stack([data[off[i]:off[i + 1]].sum(0) for i in range(3)])
     np.testing.assert_allclose(np.asarray(pooled_v), want, rtol=1e-5)
+
+
+def test_while_with_arrays_under_profiler():
+    """Regression (r3 review): unjitted (profiling) execution makes
+    array indices concrete; list-backed arrays must NOT engage outside
+    eager-dynamic mode or the lax.while_loop carry breaks."""
+    import numpy as np
+    from paddle_tpu import profiler
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        i = fluid.layers.zeros(shape=[1], dtype='int64')
+        n = fluid.layers.fill_constant(shape=[1], dtype='int64', value=3)
+        arr = fluid.layers.create_array('float32')
+        fluid.layers.array_write(x, array=arr, i=i)
+        cond = fluid.layers.less_than(x=i, y=n)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            v = fluid.layers.array_read(array=arr, i=i)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.array_write(v * 2.0, array=arr, i=i)
+            fluid.layers.less_than(x=i, y=n, cond=cond)
+        out = fluid.layers.array_read(array=arr, i=n)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xs = np.ones((2, 4), 'float32')
+        ref, = exe.run(main, feed={'x': xs}, fetch_list=[out])
+        profiler.start_profiler('CPU')
+        got, = exe.run(main, feed={'x': xs}, fetch_list=[out])
+        profiler.stop_profiler()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
+        np.testing.assert_allclose(np.asarray(got), xs * 8.0)

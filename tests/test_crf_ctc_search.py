@@ -252,3 +252,77 @@ def test_beam_search_step_and_decode():
     assert list(np.asarray(ids_v).reshape(-1)) == [5, 7]
     np.testing.assert_allclose(np.asarray(sc_v).reshape(-1),
                                [-0.1, -0.5])
+
+
+class _FakeOp(object):
+    def __init__(self, inputs, outputs, attrs):
+        self.inputs, self.outputs, self.attrs = inputs, outputs, attrs
+
+
+class _FakeCtx(object):
+    """Minimal OpCtx stand-in to drive a kernel directly."""
+
+    def __init__(self, inputs, outputs, attrs, env):
+        self.op = _FakeOp(inputs, outputs, attrs)
+        self.env = env
+        self.runner = None
+
+    def input(self, slot, idx=0):
+        names = self.op.inputs.get(slot) or []
+        return self.env[names[idx]] if names else None
+
+    def attr(self, name, default=None):
+        return self.op.attrs.get(name, default)
+
+    def set_output(self, slot, val, idx=0):
+        self.env[self.op.outputs[slot][idx]] = val
+
+    def output_names(self, slot):
+        return self.op.outputs.get(slot, [])
+
+
+def test_dynamic_beam_search_reference_semantics():
+    """Hand-computed 2-step check of the eager dynamic path, including
+    the ToAbsOffset composition (beam_search_op.cc:30): from step 2 the
+    level-0 lod indexes lod[1], and EVERY live beam row must be scanned."""
+    from paddle_tpu.ops.search_ops import _beam_search_dynamic
+    from paddle_tpu.lod import SequenceTensor
+
+    def run(pre, ids, scores, K=2, end_id=9):
+        import jax.numpy as jnp
+        env = {'p': pre, 'i': jnp.asarray(np.asarray(ids, np.int32)),
+               's': jnp.asarray(np.asarray(scores, np.float32))}
+        ctx = _FakeCtx(
+            {'pre_ids': ['p'], 'ids': ['i'], 'scores': ['s']},
+            {'selected_ids': ['sid'], 'selected_scores': ['ssc'],
+             'parent_idx': []},
+            {'beam_size': K, 'end_id': end_id, 'level': 0}, env)
+        _beam_search_dynamic(ctx, pre)
+        return env['sid'], env['ssc']
+
+    # step 1: 2 sources, 1 root row each; lod [[0,1,2],[0,1,2]]
+    pre1 = SequenceTensor.from_packed(
+        np.array([[1], [1]], np.int32), [[0, 1, 2], [0, 1, 2]])
+    ids1 = [[5, 6, 7], [6, 5, 8]]
+    sc1 = [[0.9, 0.5, 0.1], [0.8, 0.7, 0.2]]
+    sid1, ssc1 = run(pre1, ids1, sc1)
+    # top-2 per source; within a parent bucket sorted by (row, id)
+    assert np.asarray(sid1.data).ravel().tolist() == [5, 6, 5, 6]
+    assert sid1.offsets() == [[0, 1, 2], [0, 2, 4]]
+
+    # step 2: pre = step-1 output (4 rows). lod[0]=[0,1,2] indexes
+    # lod[1]=[0,2,4]: abs row offsets are [0,2,4] -> rows 0..1 belong
+    # to source 0, rows 2..3 to source 1. Row 1 (id 6) finishes via
+    # end_id=6 -> pruned; row 3 candidates all lose to row 2's.
+    pre2 = SequenceTensor.from_packed(
+        np.array([[5], [6], [5], [4]], np.int32), [[0, 1, 2], [0, 2, 4]])
+    ids2 = [[3, 4, 9], [7, 7, 7], [2, 3, 9], [4, 2, 9]]
+    sc2 = [[0.9, 0.8, 0.1], [9.9, 9.9, 9.9],
+           [0.9, 0.2, 0.1], [0.85, 0.3, 0.1]]
+    sid2, ssc2 = run(pre2, ids2, sc2, end_id=6)
+    # src0: row1 pruned (pre id == end_id) AFTER selection; its 9.9
+    # candidates won the whole top-2, so src0 emits nothing this step.
+    # src1: top2 = (row2, 2, 0.9), (row3, 4, 0.85).
+    assert np.asarray(sid2.data).ravel().tolist() == [2, 4]
+    # lod[0] = ABS parent-row offsets, lod[1] = child ranges per parent
+    assert sid2.offsets() == [[0, 2, 4], [0, 0, 0, 1, 2]]

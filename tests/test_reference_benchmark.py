@@ -1,0 +1,111 @@
+"""Acceptance: the reference benchmark/fluid harness runs UNCHANGED.
+
+Executes /root/reference/benchmark/fluid/fluid_benchmark.py (py2-era ->
+lib2to3 at load time, like tests/test_reference_scripts.py) against the
+``paddle`` shim for every model in its BENCHMARK_MODELS list, with the
+harness's own CLI (--device CPU, tiny batch, 2 iterations). The harness
+exit(0)s after one pass; success == SystemExit(0).
+
+Ref: benchmark/fluid/fluid_benchmark.py, benchmark/fluid/models/*.py.
+"""
+import importlib.abc
+import importlib.machinery
+import importlib.util
+import os
+import sys
+import types
+
+import pytest
+
+import paddle  # noqa: F401
+import paddle.fluid as fluid
+
+from test_reference_scripts import _py2to3
+
+HARNESS = '/root/reference/benchmark/fluid'
+
+
+class _2to3Loader(importlib.machinery.SourceFileLoader):
+    def source_to_code(self, data, path, *, _optimize=-1):
+        src = _py2to3(data.decode() if isinstance(data, bytes) else data,
+                      path)
+        return compile(src, path, 'exec', optimize=_optimize)
+
+
+class _ModelsFinder(importlib.abc.MetaPathFinder):
+    """Resolves the harness's ``__import__("models.<name>")`` against the
+    reference checkout, passing each file through 2to3."""
+
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname == 'models':
+            fn = os.path.join(HARNESS, 'models', '__init__.py')
+            return importlib.util.spec_from_file_location(
+                fullname, fn, loader=_2to3Loader(fullname, fn),
+                submodule_search_locations=[os.path.join(HARNESS,
+                                                         'models')])
+        if fullname.startswith('models.'):
+            fn = os.path.join(HARNESS, 'models',
+                              fullname.split('.')[-1] + '.py')
+            if os.path.exists(fn):
+                return importlib.util.spec_from_file_location(
+                    fullname, fn, loader=_2to3Loader(fullname, fn))
+        return None
+
+
+@pytest.fixture
+def harness_env(tmp_path, monkeypatch):
+    if not os.path.exists(os.path.join(HARNESS, 'fluid_benchmark.py')):
+        pytest.skip('reference checkout not available')
+    finder = _ModelsFinder()
+    sys.meta_path.insert(0, finder)
+    for m in [m for m in sys.modules if m == 'models' or
+              m.startswith('models.')]:
+        del sys.modules[m]
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            yield
+    sys.meta_path.remove(finder)
+    for m in [m for m in sys.modules if m == 'models' or
+              m.startswith('models.')]:
+        del sys.modules[m]
+
+
+def _run_harness(model, extra=()):
+    path = os.path.join(HARNESS, 'fluid_benchmark.py')
+    with open(path) as f:
+        src = _py2to3(f.read(), path)
+    argv = ['fluid_benchmark.py', '--model', model, '--device', 'CPU',
+            '--batch_size', '8', '--iterations', '2',
+            '--skip_batch_num', '1', '--pass_num', '1'] + list(extra)
+    old_argv = sys.argv
+    sys.argv = argv
+    mod = types.ModuleType('refbench_' + model)
+    mod.__file__ = path
+    try:
+        exec(compile(src, path, 'exec'), mod.__dict__)
+        mod.main()
+    except SystemExit as e:   # the harness exit(0)s after one pass
+        assert not e.code, 'harness exited with %r' % e.code
+    finally:
+        sys.argv = old_argv
+
+
+def test_fluid_benchmark_mnist(harness_env):
+    _run_harness('mnist')
+
+
+def test_fluid_benchmark_resnet(harness_env):
+    _run_harness('resnet', ['--data_set', 'cifar10'])
+
+
+def test_fluid_benchmark_vgg(harness_env):
+    _run_harness('vgg', ['--data_set', 'cifar10'])
+
+
+def test_fluid_benchmark_stacked_dynamic_lstm(harness_env):
+    _run_harness('stacked_dynamic_lstm')
+
+
+def test_fluid_benchmark_machine_translation(harness_env):
+    _run_harness('machine_translation')
