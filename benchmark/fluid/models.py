@@ -146,6 +146,53 @@ def machine_translation(args):
     return fluid.layers.mean(x=cost), feed_fn, 'sentence_pairs/sec'
 
 
+def transformer(args, vocab=8192, d_model=1024, n_heads=16, n_layers=6,
+                d_ff=4096, seq=2048):
+    """Decoder-only transformer LM through the FLUID surface: the
+    flagship long-context path (layers.flash_attention -> Pallas kernel
+    on TPU) built as a Program and run by the Executor, so the
+    framework's lowering/executor is in the measured loop. Keyword dims
+    exist for small-shape CPU tests."""
+    tok = fluid.layers.data(name='data', shape=[seq], dtype='int64')
+    label = fluid.layers.data(name='label', shape=[seq, 1], dtype='int64')
+    pos = fluid.layers.data(name='pos', shape=[seq], dtype='int64')
+    x = fluid.layers.embedding(input=tok, size=[vocab, d_model])
+    p = fluid.layers.embedding(input=pos, size=[seq, d_model],
+                               param_attr='pos_table')
+    x = x + p
+    for i in range(n_layers):
+        ln = fluid.layers.layer_norm(x, begin_norm_axis=2)
+        q = fluid.layers.fc(input=ln, size=d_model, num_flatten_dims=2,
+                            bias_attr=False)
+        k = fluid.layers.fc(input=ln, size=d_model, num_flatten_dims=2,
+                            bias_attr=False)
+        v = fluid.layers.fc(input=ln, size=d_model, num_flatten_dims=2,
+                            bias_attr=False)
+        att = fluid.layers.flash_attention(q, k, v, num_heads=n_heads,
+                                           causal=True)
+        proj = fluid.layers.fc(input=att, size=d_model,
+                               num_flatten_dims=2, bias_attr=False)
+        x = x + proj
+        ln2 = fluid.layers.layer_norm(x, begin_norm_axis=2)
+        ff = fluid.layers.fc(input=ln2, size=d_ff, num_flatten_dims=2,
+                             act='relu')
+        ff2 = fluid.layers.fc(input=ff, size=d_model, num_flatten_dims=2)
+        x = x + ff2
+    x = fluid.layers.layer_norm(x, begin_norm_axis=2)
+    logits = fluid.layers.fc(input=x, size=vocab, num_flatten_dims=2)
+    loss = fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                   label=label)
+
+    def feed_fn(bs):
+        rng = np.random.RandomState(0)
+        return {'data': rng.randint(0, vocab, (bs, seq)).astype('int64'),
+                'label': rng.randint(0, vocab,
+                                     (bs, seq, 1)).astype('int64'),
+                'pos': np.tile(np.arange(seq, dtype='int64'), (bs, 1))}
+
+    return fluid.layers.mean(x=loss), feed_fn, 'tokens/sec'
+
+
 MODELS = {
     'mnist': mnist,
     'vgg': vgg,
@@ -153,4 +200,5 @@ MODELS = {
     'se_resnext': se_resnext,
     'stacked_dynamic_lstm': stacked_dynamic_lstm,
     'machine_translation': machine_translation,
+    'transformer': transformer,
 }

@@ -49,12 +49,48 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         params_and_grads.append((p, g))
         grad_names.append(g.name)
 
+    # sparse embedding params (layers.embedding(is_sparse=True)): record
+    # their lookup carriers so lowering differentiates the gathered ROWS
+    # and the optimizer updates only touched rows (SelectedRows analog)
+    sparse = {}
+    sparse_names = set(p.name for p in parameters
+                       if getattr(p, 'sparse_grad', False))
+    if sparse_names:
+        for op in block.ops:
+            if op.type == 'lookup_table' and \
+                    op.attrs.get('sparse_carrier'):
+                w = op.inputs['W'][0]
+                if w in sparse_names:
+                    sparse.setdefault(w, []).append(
+                        [op.inputs['Ids'][0],
+                         op.attrs['sparse_carrier']])
+        # a table consumed by any op OTHER than carrier-tagged lookups
+        # (weight tying, a mixed is_sparse=False lookup, a read inside
+        # a While/DynamicRNN sub-block) still needs the dense gradient:
+        # drop it from the sparse set
+        def _reads(op):
+            names = list(op.input_arg_names)
+            sub = op.attrs.get('sub_block')
+            if sub is not None:
+                for sop in sub.ops:
+                    names.extend(_reads(sop))
+            return names
+
+        for op in block.ops:
+            tagged_w = op.inputs['W'][0] if (
+                op.type == 'lookup_table' and
+                op.attrs.get('sparse_carrier')) else None
+            for n in _reads(op):
+                if n in sparse and n != tagged_w:
+                    del sparse[n]
+
     block.append_op(
         type='backward_marker',
         inputs={'Loss': [loss]},
         outputs={},
         attrs={'params': [p.name for p in parameters],
-               'grads': grad_names})
+               'grads': grad_names,
+               'sparse': sparse})
 
     if callbacks is not None:
         for cb in callbacks:

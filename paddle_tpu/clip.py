@@ -130,7 +130,9 @@ def append_gradient_clip_ops(param_grad):
     create_op_callbacks = []
     for p, g in param_grad:
         clip_attr = getattr(p, 'gradient_clip_attr', None)
-        if clip_attr is None:
+        if clip_attr is None or getattr(p, 'sparse_grad', False):
+            # sparse row-grads pass through unclipped (ref: clip ops are
+            # LoDTensor-only)
             clip_attr = NullGradientClipAttr()
         if not isinstance(clip_attr, BaseGradientClipAttr):
             raise TypeError(

@@ -56,13 +56,35 @@ def set_conv_layout(layout):
     _STATE['conv_layout'] = layout
 
 
+def act_bf16():
+    """True when activations FLOW in bf16 between ops (AMP v2, default
+    under AMP). The r2 design cast every MXU output back to f32, so each
+    activation lived in HBM at 4 bytes and BN/relu did f32 traffic; on
+    v5e-class chips (197 bf16 TFLOP/s vs 819 GB/s -> ~240 flops/byte to
+    be compute-bound) ResNet-shaped training is HBM-bound, and halving
+    activation bytes is the single biggest lever (measured r3: 69 ->
+    ~50 ms/step). f32 master weights, f32 BN/moving stats, f32 losses
+    and optimizer state are unchanged. PADDLE_TPU_AMP_ACT=f32 restores
+    the r2 behavior."""
+    mode = _STATE.get('act')
+    if mode is None:
+        env = os.environ.get('PADDLE_TPU_AMP_ACT', 'bf16').lower()
+        mode = _STATE['act'] = env not in ('f32', 'fp32', 'float32')
+    return amp_enabled() and mode
+
+
+def set_amp_act(on):
+    _STATE['act'] = on
+
+
 def mxu_compute(fn, *operands):
     """Run ``fn(*operands)`` on the MXU in bf16 under AMP.
 
-    Operands are cast f32 -> bf16 and the result is cast back to f32, so
-    the surrounding graph (BN stats, losses, optimizer) stays f32. The
-    TPU MXU accumulates partial products in f32 internally regardless of
-    the bf16 I/O dtype, and JAX's conv/dot grad rules stay uniform-dtyped
+    Operands are cast f32 -> bf16; the result stays bf16 when act_bf16()
+    (activations flow at 2 bytes; loss/normalization kernels upcast
+    where f32 math matters) or is cast back to f32 otherwise. The TPU
+    MXU accumulates partial products in f32 internally regardless of the
+    bf16 I/O dtype, and JAX's conv/dot grad rules stay uniform-dtyped
     (mixed-dtype preferred_element_type breaks them).
     """
     import jax.numpy as jnp
@@ -71,4 +93,6 @@ def mxu_compute(fn, *operands):
     cast = [o.astype(jnp.bfloat16) if o.dtype == jnp.float32 else o
             for o in operands]
     out = fn(*cast)
-    return out.astype(jnp.float32) if out.dtype == jnp.bfloat16 else out
+    if out.dtype == jnp.bfloat16 and not act_bf16():
+        return out.astype(jnp.float32)
+    return out

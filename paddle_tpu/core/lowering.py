@@ -24,6 +24,25 @@ from ..framework import convert_np_dtype
 
 RNG_KEY = '__rng__'
 
+
+class SparseRows(object):
+    """Row-sparse gradient — the TPU-native SelectedRows (parity:
+    paddle/fluid/framework/selected_rows.h as a GRADIENT carrier).
+    ``items``: list of (rows [.., D], ids [..]) pairs, one per lookup
+    of the shared table; duplicate ids are NOT pre-merged (SGD's
+    scatter-add absorbs them; Adagrad/Adam merge via
+    ops/optim_ops._merge_rows)."""
+
+    __slots__ = ('items', 'vocab')
+
+    def __init__(self, items, vocab):
+        self.items = items
+        self.vocab = vocab
+
+    def __repr__(self):
+        return 'SparseRows(%d lookups, vocab=%d)' % (len(self.items),
+                                                     self.vocab)
+
 # Mesh for with_sharding_constraint on Variable.sharding-annotated values.
 # Set (only) by ParallelExecutor while tracing; the plain Executor lowers
 # identically but unconstrained.
@@ -219,6 +238,70 @@ def _find_marker(ops):
     return -1
 
 
+def _op_reads(op):
+    """All names an op (incl. nested sub-blocks) may read from the
+    enclosing environment."""
+    reads = list(op.input_arg_names)
+    sub = op.attrs.get('sub_block')
+    if sub is not None:
+        produced = set()
+        for sop in sub.ops:
+            reads.extend(n for n in _op_reads(sop) if n not in produced)
+            produced.update(sop.output_arg_names)
+    return reads
+
+
+def _op_writes(op):
+    writes = list(op.output_arg_names)
+    sub = op.attrs.get('sub_block')
+    if sub is not None:
+        for sop in sub.ops:
+            writes.extend(_op_writes(sop))
+    return writes
+
+
+def _run_remat_segments(block, ops, env, grad_mode):
+    """memory_optimize() path: execute the forward as ~sqrt(N) segments,
+    each under jax.checkpoint, so backward keeps only segment-boundary
+    activations and recomputes inside segments (classic sqrt-N remat).
+    A single whole-forward checkpoint would NOT shrink the peak — the
+    recompute re-materializes every activation at once (measured r3:
+    2360 -> 2263 MB only); segmentation is what trades FLOPs for peak
+    memory."""
+    import math
+    n_seg = max(2, int(math.sqrt(len(ops))))
+    bounds = [len(ops) * i // n_seg for i in range(n_seg + 1)]
+    for s in range(n_seg):
+        chunk = ops[bounds[s]:bounds[s + 1]]
+        if not chunk:
+            continue
+        produced = set()
+        reads, writes = [], []
+        for op in chunk:
+            for n in _op_reads(op):
+                if n not in produced and n in env and n not in reads:
+                    reads.append(n)
+            for n in _op_writes(op):
+                produced.add(n)
+                if n not in writes:
+                    writes.append(n)
+        if RNG_KEY in env and RNG_KEY not in reads:
+            reads.append(RNG_KEY)
+
+        def seg(vals, _chunk=tuple(chunk), _reads=tuple(reads),
+                _writes=tuple(writes)):
+            senv = dict(zip(_reads, vals))
+            BlockRunner(block, grad_mode=grad_mode).run_ops(
+                list(_chunk), senv)
+            return tuple(senv.get(n) for n in _writes)
+
+        outs = jax.checkpoint(seg)(tuple(env[n] for n in reads))
+        for n, v in zip(writes, outs):
+            if v is not None:
+                env[n] = v
+    return env
+
+
 def lower_block(program, block, feed_names, fetch_names, state_in_names,
                 state_out_names, dynamic=False):
     """Build ``fn(feeds, state) -> (fetches, new_state)`` for jit.
@@ -241,25 +324,52 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
             grad_names = list(marker.attrs['grads'])
             loss_name = marker.inputs['Loss'][0]
             pre, post = ops[:marker_idx], ops[marker_idx + 1:]
+            # sparse embedding tables: differentiate the gathered ROWS
+            # (zero carriers added to each lookup's output) instead of
+            # the [vocab, d] table; the optimizer sees SparseRows.
+            # Requires the ids to be live before the trace (feeds);
+            # mid-graph ids fall back to the dense path.
+            sparse_map = {
+                w: pairs
+                for w, pairs in (marker.attrs.get('sparse') or {}).items()
+                if w in env and all(p[0] in env for p in pairs)}
+            diff_names = [p for p in param_names if p not in sparse_map]
             base_env = {k: v for k, v in env.items()
-                        if k not in set(param_names)}
+                        if k not in set(diff_names)}
+
+            def _rows_of(ids_val):
+                from ..lod import SequenceTensor
+                data = ids_val.data if isinstance(ids_val,
+                                                  SequenceTensor) \
+                    else jnp.asarray(ids_val)
+                shp = tuple(data.shape)
+                if shp and shp[-1] == 1:
+                    shp = shp[:-1]
+                return data.reshape(shp), shp
+
+            remat = bool(getattr(program, '_remat', False))
 
             def g(param_vals):
                 genv = dict(base_env)
                 genv.update(param_vals)
-                BlockRunner(block, grad_mode=True,
-                            dynamic=dynamic).run_ops(pre, genv)
+                if remat:
+                    # memory_optimize() hint: sqrt-N segmented
+                    # rematerialization (the TPU-meaningful analogue of
+                    # the reference's liveness-based buffer reuse)
+                    _run_remat_segments(block, pre, genv, True)
+                else:
+                    BlockRunner(block, grad_mode=True,
+                                dynamic=dynamic).run_ops(pre, genv)
                 loss = genv[loss_name]
                 return jnp.sum(loss), genv
 
-            if getattr(program, '_remat', False):
-                # memory_optimize() hint: rematerialize the forward
-                # segment in the backward pass (activation memory traded
-                # for recompute FLOPs — the TPU-meaningful analogue of
-                # the reference's liveness-based buffer reuse)
-                g = jax.checkpoint(g)
-
-            param_vals = {p: env[p] for p in param_names}
+            param_vals = {p: env[p] for p in diff_names}
+            for w, pairs in sparse_map.items():
+                d = env[w].shape[1]
+                for ids_name, carrier in pairs:
+                    _, shp = _rows_of(env[ids_name])
+                    param_vals[carrier] = jnp.zeros(
+                        shp + (d,), env[w].dtype)
             from .. import profiler as _prof
             _profiling = _prof.op_profiling_enabled() and not any(
                 isinstance(v, jax.core.Tracer)
@@ -274,9 +384,20 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
                 _prof.record_op_event('fwd_bwd(value_and_grad)',
                                       time.perf_counter() - _t0)
             env = env2
-            env.update(param_vals)
+            env.update({p: param_vals[p] for p in diff_names})
             scale = marker.attrs.get('loss_scale', None)
             for p, gname in zip(param_names, grad_names):
+                if p in sparse_map:
+                    items = []
+                    for ids_name, carrier in sparse_map[p]:
+                        rows = pgrads[carrier]
+                        if scale is not None and scale != 1.0:
+                            rows = rows * scale
+                        ids, _ = _rows_of(env[ids_name])
+                        items.append((rows, ids))
+                    env[gname] = SparseRows(items,
+                                            int(env[p].shape[0]))
+                    continue
                 gval = pgrads[p]
                 if scale is not None and scale != 1.0:
                     gval = gval * scale

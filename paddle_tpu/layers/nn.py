@@ -30,6 +30,8 @@ __all__ = [
     'margin_rank_loss', 'hinge_loss', 'modified_huber_loss', 'unpool',
     'spp', 'max_pool2d_with_index', 'squared_l2_distance',
     'squared_l2_norm', 'l1_norm',
+    'flash_attention',
+    'sequence_concat',
 ]
 
 
@@ -86,8 +88,12 @@ def _prod(dims):
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype='float32'):
-    """Parity: layers/nn.py::embedding (lookup_table op). ``is_sparse`` is
-    accepted and ignored: on TPU dense gathers win (no SelectedRows)."""
+    """Parity: layers/nn.py::embedding (lookup_table op). ``is_sparse``
+    is honored (r3): the backward produces ROW gradients instead of a
+    dense [vocab, d] table gradient, and SGD/Adagrad/Adam update only
+    the touched rows (the TPU-native SelectedRows — ref
+    operators/lookup_table_op.cc:37 and the sgd/adam SelectedRows
+    paths). See core/lowering.py sparse-carrier machinery."""
     helper = LayerHelper('embedding', param_attr=param_attr)
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype, is_bias=False)
@@ -100,11 +106,17 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
                                      lod_level=input.lod_level)
     padding_idx = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    attrs = {'is_sparse': is_sparse, 'padding_idx': padding_idx}
+    if is_sparse:
+        w.sparse_grad = True
+        from .. import unique_name
+        # per-op grad carrier: rows differentiate instead of the table
+        attrs['sparse_carrier'] = unique_name.generate(
+            w.name + '@SCARRIER')
     helper.append_op(type='lookup_table',
                      inputs={'Ids': input, 'W': w},
                      outputs={'Out': tmp},
-                     attrs={'is_sparse': is_sparse,
-                            'padding_idx': padding_idx})
+                     attrs=attrs)
     return tmp
 
 
@@ -261,7 +273,8 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, use_mkldnn=False, name=None):
+           ceil_mode=False, use_mkldnn=False, name=None,
+           exclusive=True):
     if pool_type not in ["max", "avg"]:
         raise ValueError("pool_type must be 'max' or 'avg'")
     if global_pooling is False and pool_size == -1:
@@ -284,6 +297,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
     helper.append_op(type='pool2d', inputs={'X': input},
                      outputs={'Out': out},
                      attrs={'pooling_type': pool_type,
+                            'exclusive': exclusive,
                             'ksize': pool_size,
                             'global_pooling': global_pooling,
                             'strides': pool_stride,
@@ -935,14 +949,17 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,
                 is_reverse=False, gate_activation='sigmoid',
                 candidate_activation='tanh', h_0=None):
-    helper = LayerHelper('gru', param_attr=param_attr, bias_attr=bias_attr)
+    helper = LayerHelper('gru', param_attr=param_attr,
+                         bias_attr=None if bias_attr is False
+                         else bias_attr)
     dtype = input.dtype
     weight = helper.create_parameter(attr=helper.param_attr,
                                      shape=[size, 3 * size], dtype=dtype)
-    bias = helper.create_parameter(attr=helper.bias_attr,
-                                   shape=[1, 3 * size], dtype=dtype,
-                                   is_bias=True)
-    inputs = {'Input': input, 'Weight': weight, 'Bias': bias}
+    inputs = {'Input': input, 'Weight': weight}
+    if bias_attr is not False:
+        inputs['Bias'] = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype,
+            is_bias=True)
     if h_0 is not None:
         inputs['H0'] = h_0
     hidden = helper.create_tmp_variable(
@@ -1323,4 +1340,34 @@ def spp(x, pyramid_height, pool_type='max', name=None):
                      outputs={'Out': [out]},
                      attrs={'pyramid_height': pyramid_height,
                             'pooling_type': pool_type})
+    return out
+
+
+def flash_attention(q, k, v, num_heads=1, causal=True, name=None):
+    """Multi-head scaled-dot-product attention on the Pallas flash
+    kernel (paddle_tpu-native addition; the reference's composite is
+    nets.scaled_dot_product_attention). q/k/v: [B, T, D] variables; D
+    is split into ``num_heads``. Engages the blockwise Mosaic kernel on
+    TPU at long sequence lengths and the identical-math XLA reference
+    elsewhere (ops/pallas_kernels.py engagement policy)."""
+    helper = LayerHelper('flash_attention', **locals())
+    out = helper.create_tmp_variable(dtype=q.dtype, shape=q.shape)
+    helper.append_op(
+        type='flash_attention',
+        inputs={'Q': q, 'K': k, 'V': v},
+        outputs={'Out': out},
+        attrs={'num_heads': num_heads, 'causal': causal})
+    return out
+
+
+def sequence_concat(input, name=None):
+    """Concatenate corresponding sequences along time. Parity:
+    operators/sequence_concat_op.cc (axis-0, level-0 concat of LoD
+    tensors)."""
+    helper = LayerHelper('sequence_concat', name=name)
+    out = helper.create_tmp_variable(
+        dtype=helper.input_dtype(input_param_name='input'),
+        shape=input[0].shape, lod_level=input[0].lod_level)
+    helper.append_op(type='sequence_concat', inputs={'X': input},
+                     outputs={'Out': out})
     return out

@@ -9,6 +9,14 @@ def unwrap(v):
     return v.data if isinstance(v, SequenceTensor) else v
 
 
+def f32(x):
+    """Upcast a bf16 activation for kernels whose math wants f32
+    (losses, softmax, normalization statistics). No-op otherwise."""
+    import jax.numpy as jnp
+    return x.astype(jnp.float32) if getattr(x, 'dtype', None) == \
+        jnp.bfloat16 else x
+
+
 def rewrap(template, data):
     if isinstance(template, SequenceTensor):
         if template.packed_mode:

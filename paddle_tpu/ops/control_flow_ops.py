@@ -110,7 +110,15 @@ def _read_from_array(ctx):
         try:
             # clamp like the buffer path (dynamic_index_in_dim semantics)
             idx = min(max(int(i), 0), len(arr['list']) - 1)
-            ctx.set_output('Out', arr['list'][idx])
+            val = arr['list'][idx]
+            if val is None:
+                # gap left by a non-contiguous write: a zero element,
+                # matching the buffer path
+                proto = next(e for e in arr['list'] if e is not None)
+                val = jnp.zeros_like(
+                    proto.data if isinstance(proto, SequenceTensor)
+                    else jnp.asarray(proto))
+            ctx.set_output('Out', val)
             return
         except jax.errors.TracerIntegerConversionError:
             arr = _list_to_buf(arr)

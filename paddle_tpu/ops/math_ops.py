@@ -292,8 +292,9 @@ _reduce('reduce_prod', jnp.prod)
 
 @register_kernel('mean')
 def _mean(ctx):
+    from .common import f32
     x_in = ctx.input('X')
-    x = unwrap(x_in)
+    x = f32(unwrap(x_in))
     from ..lod import SequenceTensor
     if isinstance(x_in, SequenceTensor):
         # average over REAL tokens only (reference means over the packed

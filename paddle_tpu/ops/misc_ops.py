@@ -436,3 +436,27 @@ def _listen_and_serv_marker(ctx):
     """Parity: operators/listen_and_serv_op.cc (pserver gRPC loop). No
     server exists on the TPU stack; the op is a no-op placeholder so
     pserver-style launcher programs execute cleanly."""
+
+
+@register_kernel('flash_attention')
+def _flash_attention_op(ctx):
+    """paddle_tpu-native multi-head attention op backed by the Pallas
+    flash kernel (ops/pallas_kernels.py) — engaged on TPU at long seq
+    lens, identical-math XLA reference elsewhere. Inputs Q/K/V:
+    [B, T, D]; attr num_heads splits D. This is the op behind
+    layers.flash_attention, the fluid route to the flagship transformer
+    path (bench.py's headline)."""
+    from .pallas_kernels import flash_attention
+    q = unwrap(ctx.input('Q'))
+    k = unwrap(ctx.input('K'))
+    v = unwrap(ctx.input('V'))
+    heads = int(ctx.attr('num_heads', 1))
+    causal = bool(ctx.attr('causal', True))
+    B, T, D = q.shape
+    dh = D // heads
+    qh = q.reshape(B, T, heads, dh)
+    kh = k.reshape(B, T, heads, dh)
+    vh = v.reshape(B, T, heads, dh)
+    # NB: flash_attention applies the 1/sqrt(dh) logit scale itself
+    out = flash_attention(qh, kh, vh, causal=causal)
+    ctx.set_output('Out', out.reshape(B, T, D))

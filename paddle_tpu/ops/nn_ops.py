@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_kernel
-from .common import unwrap, rewrap
+from .common import unwrap, rewrap, f32
 
 
 def _pair(v):
@@ -108,7 +108,7 @@ def _batch_norm(ctx):
     """Train: batch stats + moving-average update (MeanOut/VarianceOut write
     back to the persistable stats). Test: moving stats.
     Parity: operators/batch_norm_op.cc."""
-    x = unwrap(ctx.input('X'))
+    x_in = unwrap(ctx.input('X'))
     scale = unwrap(ctx.input('Scale'))
     bias = unwrap(ctx.input('Bias'))
     mean = unwrap(ctx.input('Mean'))
@@ -116,6 +116,11 @@ def _batch_norm(ctx):
     momentum = ctx.attr('momentum', 0.9)
     eps = ctx.attr('epsilon', 1e-5)
     layout = ctx.attr('data_layout', 'NCHW')
+    # bf16 activation flow: statistics and the normalization math run in
+    # f32 (XLA fuses the casts into the reduction/elementwise kernels,
+    # so HBM traffic stays at 2 bytes/elem); output returns to bf16
+    bf16_io = x_in.dtype == jnp.bfloat16
+    x = x_in.astype(jnp.float32) if bf16_io else x_in
     axes = tuple(i for i in range(x.ndim)
                  if i != (1 if layout == 'NCHW' and x.ndim > 2 else
                           x.ndim - 1))
@@ -126,8 +131,14 @@ def _batch_norm(ctx):
     if ctx.is_test():
         use_mean, use_var = mean, var
     else:
+        # single-pass moments (E[x^2] - E[x]^2): one fused HBM read for
+        # both statistics instead of jnp.var's mean-then-deviations
+        # second pass; f32 accumulation keeps it well-conditioned for
+        # BN-scale data
         use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        use_var = jnp.maximum(
+            jnp.mean(jnp.square(x), axis=axes) - jnp.square(use_mean),
+            0.0)
         new_mean = mean * momentum + use_mean * (1.0 - momentum)
         new_var = var * momentum + use_var * (1.0 - momentum)
         ctx.set_output('MeanOut', jax.lax.stop_gradient(new_mean))
@@ -137,24 +148,29 @@ def _batch_norm(ctx):
     inv = jax.lax.rsqrt(use_var + eps)
     y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * \
         scale.reshape(bshape) + bias.reshape(bshape)
-    ctx.set_output('Y', y)
+    ctx.set_output('Y', y.astype(x_in.dtype) if bf16_io else y)
 
 
 @register_kernel('layer_norm')
 def _layer_norm(ctx):
-    x = unwrap(ctx.input('X'))
+    x_in = unwrap(ctx.input('X'))
     begin = ctx.attr('begin_norm_axis', 1)
     eps = ctx.attr('epsilon', 1e-5)
+    # bf16 activation flow: statistics/normalization in f32 (casts fuse;
+    # HBM traffic stays bf16), output returns to the input dtype
+    bf16_io = x_in.dtype == jnp.bfloat16
+    x = x_in.astype(jnp.float32) if bf16_io else x_in
     axes = tuple(range(begin, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
+    var = jnp.maximum(jnp.mean(jnp.square(x), axis=axes, keepdims=True)
+                      - jnp.square(mean), 0.0)
     y = (x - mean) * jax.lax.rsqrt(var + eps)
     norm_shape = x.shape[begin:]
     if ctx.has_input('Scale'):
         y = y * unwrap(ctx.input('Scale')).reshape(norm_shape)
     if ctx.has_input('Bias'):
         y = y + unwrap(ctx.input('Bias')).reshape(norm_shape)
-    ctx.set_output('Y', y)
+    ctx.set_output('Y', y.astype(x_in.dtype) if bf16_io else y)
     ctx.set_output('Mean', mean.reshape(x.shape[:begin] + (1,) * 0)
                    .reshape((-1,)))
     ctx.set_output('Variance', var.reshape((-1,)))
@@ -178,13 +194,14 @@ def _lrn(ctx):
 @register_kernel('softmax')
 def _softmax(ctx):
     x = ctx.input('X')
-    ctx.set_output('Out', rewrap(x, jax.nn.softmax(unwrap(x), axis=-1)))
+    ctx.set_output('Out', rewrap(x, jax.nn.softmax(f32(unwrap(x)),
+                                                   axis=-1)))
 
 
 @register_kernel('cross_entropy')
 def _cross_entropy(ctx):
     x_in = ctx.input('X')
-    x = unwrap(x_in)
+    x = f32(unwrap(x_in))
     label = unwrap(ctx.input('Label'))
     eps = 1e-8
     if ctx.attr('soft_label', False):
@@ -213,7 +230,7 @@ def _cross_entropy(ctx):
 
 @register_kernel('softmax_with_cross_entropy')
 def _softmax_with_cross_entropy(ctx):
-    logits = unwrap(ctx.input('Logits'))
+    logits = f32(unwrap(ctx.input('Logits')))
     label = unwrap(ctx.input('Label'))
     logp = jax.nn.log_softmax(logits, axis=-1)
     if ctx.attr('soft_label', False):
@@ -229,8 +246,8 @@ def _softmax_with_cross_entropy(ctx):
 
 @register_kernel('sigmoid_cross_entropy_with_logits')
 def _sigmoid_xent(ctx):
-    x = unwrap(ctx.input('X'))
-    label = unwrap(ctx.input('Label'))
+    x = f32(unwrap(ctx.input('X')))
+    label = f32(unwrap(ctx.input('Label')))
     loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
     ctx.set_output('Out', loss)
 

@@ -5,9 +5,11 @@ decayed_adagrad,adadelta,rmsprop,ftrl}_op.* — each writes ParamOut (and
 accumulator outs) back to the persistable state, so the whole update fuses
 into the step's single XLA program (no separate optimizer dispatch).
 """
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_kernel
+from ..core.lowering import SparseRows
 from .common import unwrap
 
 
@@ -16,9 +18,54 @@ def _lr(ctx):
     return lr.reshape(()) if hasattr(lr, 'reshape') else lr
 
 
+def _flat_items(g, d):
+    """[(rows [N, D], ids [N])] from a SparseRows' possibly-nested items."""
+    out = []
+    for rows, ids in g.items:
+        out.append((jnp.asarray(rows).reshape(-1, d),
+                    jnp.asarray(ids).reshape(-1).astype(jnp.int32)))
+    return out
+
+
+def _all_rows(g, d):
+    """One (rows, ids) pair spanning ALL lookups of the table, so the
+    moment update sees each id exactly once per step (reference
+    MergeAdd merges the whole SelectedRows, not per-lookup)."""
+    items = _flat_items(g, d)
+    if len(items) == 1:
+        return items[0]
+    return (jnp.concatenate([r for r, _ in items], axis=0),
+            jnp.concatenate([i for _, i in items], axis=0))
+
+
+def _merge_rows(rows, ids, vocab):
+    """Merge duplicate ids with STATIC shapes (TPU-native SelectedRows
+    merge, ref math/selected_rows_functor.cc MergeAdd): sort by id,
+    segment-sum each run onto its first occurrence, and emit id=vocab
+    (out of bounds -> dropped by XLA scatter) for non-start slots."""
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    sid = ids[order]
+    srow = rows[order]
+    start = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    first_idx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(start, jnp.arange(n), 0))
+    agg = jnp.zeros_like(srow).at[first_idx].add(srow)
+    out_ids = jnp.where(start, sid, vocab)
+    return agg, out_ids
+
+
 @register_kernel('sgd')
 def _sgd(ctx):
     p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
+    if isinstance(g, SparseRows):
+        # SelectedRows SGD (ref sgd_op.h sparse branch): touch only the
+        # gathered rows; duplicate ids accumulate in the scatter-add
+        lr = _lr(ctx)
+        for rows, ids in _flat_items(g, p.shape[1]):
+            p = p.at[ids].add((-lr * rows).astype(p.dtype))
+        ctx.set_output('ParamOut', p)
+        return
     ctx.set_output('ParamOut', p - _lr(ctx) * g.astype(p.dtype))
 
 
@@ -46,9 +93,28 @@ def _adam(ctx):
     b1, b2 = ctx.attr('beta1', 0.9), ctx.attr('beta2', 0.999)
     eps = ctx.attr('epsilon', 1e-8)
     lr = _lr(ctx)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    if isinstance(g, SparseRows):
+        # lazy-mode sparse Adam (ref adam_op.h SparseAdamFunctor):
+        # moments decay and the param moves ONLY on touched rows;
+        # duplicates are merged ACROSS all lookups of the table first
+        # (SelectedRows MergeAdd), so each id decays/steps once per step
+        rows, ids = _all_rows(g, p.shape[1])
+        agg, sids = _merge_rows(rows, ids, g.vocab)
+        sel = jnp.clip(sids, 0, g.vocab - 1)
+        m1r = b1 * m1[sel] + (1 - b1) * agg
+        m2r = b2 * m2[sel] + (1 - b2) * jnp.square(agg)
+        p = p.at[sids].set(
+            (p[sel] - lr_t * m1r / (jnp.sqrt(m2r) + eps))
+            .astype(p.dtype))
+        m1 = m1.at[sids].set(m1r)
+        m2 = m2.at[sids].set(m2r)
+        ctx.set_output('ParamOut', p)
+        ctx.set_output('Moment1Out', m1)
+        ctx.set_output('Moment2Out', m2)
+        return
     m1o = b1 * m1 + (1 - b1) * g
     m2o = b2 * m2 + (1 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     ctx.set_output('ParamOut', p - lr_t * m1o / (jnp.sqrt(m2o) + eps))
     ctx.set_output('Moment1Out', m1o)
     ctx.set_output('Moment2Out', m2o)
@@ -76,8 +142,24 @@ def _adagrad(ctx):
     p, g = unwrap(ctx.input('Param')), unwrap(ctx.input('Grad'))
     m = unwrap(ctx.input('Moment'))
     eps = ctx.attr('epsilon', 1e-6)
+    lr = _lr(ctx)
+    if isinstance(g, SparseRows):
+        # SelectedRows Adagrad (ref adagrad_op.h sparse branch): rows
+        # merged across all lookups accumulate into the moment and move
+        # only touched rows
+        rows, ids = _all_rows(g, p.shape[1])
+        agg, sids = _merge_rows(rows, ids, g.vocab)
+        sel = jnp.clip(sids, 0, g.vocab - 1)
+        m_r = m[sel] + jnp.square(agg)
+        p = p.at[sids].set(
+            (p[sel] - lr * agg / (jnp.sqrt(m_r) + eps))
+            .astype(p.dtype))
+        m = m.at[sids].set(m_r)
+        ctx.set_output('ParamOut', p)
+        ctx.set_output('MomentOut', m)
+        return
     m_out = m + jnp.square(g)
-    ctx.set_output('ParamOut', p - _lr(ctx) * g / (jnp.sqrt(m_out) + eps))
+    ctx.set_output('ParamOut', p - lr * g / (jnp.sqrt(m_out) + eps))
     ctx.set_output('MomentOut', m_out)
 
 

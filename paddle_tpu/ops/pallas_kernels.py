@@ -408,10 +408,10 @@ def _pick_block(T, target):
     return None
 
 
-# Below this seq len the XLA attention wins on TPU. Measured on v5e
-# (fwd+bwd train step): pallas 1.26x at T=512, 1.39x at T=2048, 2.0x at
-# T=4096; fwd-only loses below T=1024 but the blockwise backward more
-# than makes up for it.
+# Below this seq len the XLA attention wins on TPU. Break-even is
+# measured by bench.py::bench_flash_attention and recorded per round in
+# BENCH_r*.json (r3 on v5e, fwd+bwd: 0.98x at T=512, 1.40x at T=2048,
+# 1.90x at T=4096).
 _FLASH_MIN_T = 512
 
 

@@ -257,7 +257,13 @@ def _truncated_gaussian_random(ctx):
 @register_kernel('lookup_table')
 def _lookup_table(ctx):
     """Embedding. Parity: operators/lookup_table_op.* (padding_idx rows
-    return zeros). Sequence inputs keep their lengths."""
+    return zeros). Sequence inputs keep their lengths.
+
+    Sparse path (is_sparse=True, ref lookup_table_op.cc:37): during the
+    grad replay a zero 'carrier' with the OUTPUT's shape is added; the
+    carrier is a differentiated arg (core/lowering.py), so its gradient
+    IS the per-row cotangent and the dense [vocab, d] table gradient is
+    never materialized."""
     w = unwrap(ctx.input('W'))
     ids_in = ctx.input('Ids')
     ids = unwrap(ids_in).astype('int32')
@@ -266,6 +272,11 @@ def _lookup_table(ctx):
         ids = ids.reshape(ids.shape[:-1])
     padding_idx = ctx.attr('padding_idx', None)
     out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    carrier = ctx.attr('sparse_carrier')
+    if carrier and carrier in ctx.env:
+        # carrier joins BEFORE the padding mask, so the mask's autodiff
+        # zeroes padding-row cotangents exactly like the dense path
+        out = jax.lax.stop_gradient(out) + ctx.env[carrier]
     if padding_idx is not None and padding_idx >= 0:
         out = jnp.where((ids == padding_idx)[..., None],
                         jnp.zeros_like(out), out)

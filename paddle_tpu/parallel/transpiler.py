@@ -105,10 +105,15 @@ class DistributeTranspiler(object):
                     var = block._find_var_recursive(name)
                     if var is None or var.sharding is not None:
                         continue  # keep explicit (e.g. tp) shardings
-                    if len(var.shape) >= 1 and var.shape[0] % dp == 0 \
-                            and var.shape[0] >= dp:
-                        var.sharding = ('dp',)
-                        self.sliced_vars.append(name)
+                    # slice over the FIRST dp-divisible dim (r3: was
+                    # dim-0-only, which left odd-leading-dim
+                    # accumulators — biases, embeddings with ragged
+                    # vocab — fully replicated)
+                    for d, extent in enumerate(var.shape):
+                        if extent % dp == 0 and extent >= dp:
+                            var.sharding = (None,) * d + ('dp',)
+                            self.sliced_vars.append(name)
+                            break
         self._program._bump_version()
 
     def get_trainer_program(self):

@@ -55,7 +55,10 @@ def append_regularization_ops(parameters_and_grads, regularization=None):
     params_and_grads = []
     for param, grad in parameters_and_grads:
         regularization_term = param.regularizer or regularization
-        if grad is None or regularization_term is None:
+        if grad is None or regularization_term is None or \
+                getattr(param, 'sparse_grad', False):
+            # sparse (SelectedRows) grads skip weight decay, like the
+            # reference's LoDTensor-only regularization ops
             params_and_grads.append((param, grad))
             continue
         block = grad.block
