@@ -181,7 +181,8 @@ class BlockRunner(object):
                     except Exception:
                         pass
                     _prof.record_op_event(op.type,
-                                          time.perf_counter() - t0)
+                                          time.perf_counter() - t0,
+                                          start=t0)
             if guard:
                 _check_outputs(op, env)
             if self.grad_mode:
@@ -382,7 +383,8 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
                 # attribution inside it would be fiction
                 jax.block_until_ready(pgrads)
                 _prof.record_op_event('fwd_bwd(value_and_grad)',
-                                      time.perf_counter() - _t0)
+                                      time.perf_counter() - _t0,
+                                      start=_t0)
             env = env2
             env.update({p: param_vals[p] for p in diff_names})
             scale = marker.attrs.get('loss_scale', None)

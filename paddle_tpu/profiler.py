@@ -21,19 +21,21 @@ import os
 import time
 
 __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
-           'stop_profiler']
+           'stop_profiler', 'save_profile']
 
 _stats = {'runs': 0, 'wall': 0.0}
 _trace_dir = None
 _op_profiling = [False]
 _op_events = {}   # op_type -> [calls, total_s, max_s, min_s]
+_timeline = []    # raw (op_type, start_s, dur_s) while profiling
+_TIMELINE_CAP = 200000
 
 
 def op_profiling_enabled():
     return _op_profiling[0]
 
 
-def record_op_event(op_type, seconds):
+def record_op_event(op_type, seconds, start=None):
     ev = _op_events.get(op_type)
     if ev is None:
         _op_events[op_type] = [1, seconds, seconds, seconds]
@@ -42,6 +44,18 @@ def record_op_event(op_type, seconds):
         ev[1] += seconds
         ev[2] = max(ev[2], seconds)
         ev[3] = min(ev[3], seconds)
+    if start is not None and len(_timeline) < _TIMELINE_CAP:
+        _timeline.append((op_type, start, seconds))
+
+
+def save_profile(path):
+    """Write the raw per-op event stream as JSON for tools/timeline.py
+    (parity: the reference saves a profiler proto consumed by
+    tools/timeline.py into a chrome://tracing file)."""
+    import json
+    with open(path, 'w') as f:
+        json.dump({'events': [[n, s, d] for n, s, d in _timeline]}, f)
+    return path
 
 
 @contextlib.contextmanager
@@ -55,6 +69,7 @@ def reset_profiler():
     _stats['runs'] = 0
     _stats['wall'] = 0.0
     _op_events.clear()
+    del _timeline[:]
 
 
 def start_profiler(state='All', tracer_option=None,

@@ -12,10 +12,13 @@ __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
            'ComposeNotAligned', 'firstn', 'xmap_readers', 'batch']
 
 
-def batch(reader, batch_size, drop_last=True):
-    """Parity: python/paddle/batch.py. ``drop_last`` defaults True on TPU:
-    constant batch shapes avoid XLA recompiles (the reference keeps the
-    ragged tail; here that would trigger one extra compile per pass)."""
+def batch(reader, batch_size, drop_last=False):
+    """Parity: python/paddle/batch.py — the ragged tail batch IS yielded
+    (reference batch.py:34). r3: drop_last used to default True for
+    shape stability, but scripts whose datasets are smaller than one
+    batch (high-level-api cifar10_small_test_set) then see ZERO batches
+    and silently train nothing. A ragged tail costs one extra XLA
+    compile per program; pass drop_last=True to keep shapes constant."""
 
     def batch_reader():
         r = reader()

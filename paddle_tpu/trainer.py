@@ -194,7 +194,11 @@ class Trainer(object):
                 outs = exe.run(program=self.test_program,
                                feed=feeder.feed(data),
                                fetch_list=[v.name for v in fetch_list])
-                accumulated = [x[0] + x[1][0]
+                # first element per metric, as a PLAIN float: scripts do
+                # np.array(trainer.test(...)).mean(), which chokes on a
+                # mix of scalars and shaped arrays (hl recommender)
+                import numpy as np
+                accumulated = [x[0] + float(np.asarray(x[1]).ravel()[0])
                                for x in zip(accumulated, outs)]
                 count += 1
             return [x / count for x in accumulated]

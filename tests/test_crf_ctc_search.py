@@ -326,3 +326,36 @@ def test_dynamic_beam_search_reference_semantics():
     assert np.asarray(sid2.data).ravel().tolist() == [2, 4]
     # lod[0] = ABS parent-row offsets, lod[1] = child ranges per parent
     assert sid2.offsets() == [[0, 2, 4], [0, 0, 0, 1, 2]]
+
+
+def test_dynamic_beam_search_reference_unittest_case():
+    """The exact fixture of the reference's test_beam_search_op.py
+    (ids lod [[0,1,4],[0,1,2,3,4]], beam 2, end_id 0), with expectations
+    derived from beam_search_op.cc's actual algorithm: per-source top-2
+    over all rows, buckets sorted by (parent row, id), lod[0] = abs
+    high_level, lod[1] = per-parent-row child ranges."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.search_ops import _beam_search_dynamic
+    from paddle_tpu.lod import SequenceTensor
+
+    pre = SequenceTensor.from_packed(
+        np.array([[1], [2], [3], [4]], np.int32),
+        [[0, 1, 4], [0, 1, 2, 3, 4]])
+    ids = [[4, 2, 5], [2, 1, 3], [3, 5, 2], [8, 2, 1]]
+    scores = [[0.5, 0.3, 0.2], [0.6, 0.3, 0.1],
+              [0.9, 0.5, 0.1], [0.7, 0.5, 0.1]]
+    env = {'p': pre, 'i': jnp.asarray(np.asarray(ids, np.int32)),
+           's': jnp.asarray(np.asarray(scores, np.float32))}
+    ctx = _FakeCtx(
+        {'pre_ids': ['p'], 'ids': ['i'], 'scores': ['s']},
+        {'selected_ids': ['sid'], 'selected_scores': ['ssc'],
+         'parent_idx': []},
+        {'beam_size': 2, 'end_id': 0, 'level': 0}, env)
+    _beam_search_dynamic(ctx, pre)
+    sid, ssc = env['sid'], env['ssc']
+    # src0 (row 0): top2 = (2,.3),(4,.5) -> id-sorted [2,4]
+    # src1 (rows 1..3): top2 = (row2,3,.9),(row3,8,.7); row1 empty
+    assert np.asarray(sid.data).ravel().tolist() == [2, 4, 3, 8]
+    np.testing.assert_allclose(np.asarray(ssc.data).ravel(),
+                               [0.3, 0.5, 0.9, 0.7], rtol=1e-6)
+    assert sid.offsets() == [[0, 1, 4], [0, 2, 2, 3, 4]]

@@ -1,5 +1,7 @@
 """Per-op profiler (VERDICT r1 #9). Parity: platform/profiler.cc event
 table + python/paddle/fluid/profiler.py API."""
+import os
+
 import numpy as np
 
 import paddle_tpu.fluid as fluid
@@ -75,3 +77,46 @@ def test_profiling_does_not_pollute_normal_runs():
         exe.run(startup)
         exe.run(main, feed=feed, fetch_list=[loss])
     assert not profiler._op_events
+
+
+def test_timeline_tool_roundtrip(tmp_path):
+    """profiler.save_profile -> tools/timeline.py -> chrome trace JSON
+    (parity: reference tools/timeline.py over saved profiler protos)."""
+    import json
+    import subprocess
+    import sys
+    import numpy as np
+    from paddle_tpu import profiler
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=3, act='relu')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        profiler.reset_profiler()
+        profiler.start_profiler('CPU')
+        exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                fetch_list=[y])
+        profiler.stop_profiler()
+    prof = str(tmp_path / 'prof.json')
+    out = str(tmp_path / 'timeline.json')
+    profiler.save_profile(prof)
+    tool = os.path.join(os.path.dirname(__file__), '..', 'tools',
+                        'timeline.py')
+    subprocess.run([sys.executable, tool, '--profile_path', prof,
+                    '--timeline_path', out], check=True)
+    trace = json.load(open(out))
+    evs = [e for e in trace['traceEvents'] if e['ph'] == 'X']
+    assert evs and any(e['name'] == 'mul' for e in evs)
+    assert all('ts' in e and 'dur' in e for e in evs)
+    # multi-trainer spec form
+    out2 = str(tmp_path / 'timeline2.json')
+    subprocess.run([sys.executable, tool, '--profile_path',
+                    't1=%s,t2=%s' % (prof, prof),
+                    '--timeline_path', out2], check=True)
+    trace2 = json.load(open(out2))
+    pids = {e['pid'] for e in trace2['traceEvents']}
+    assert len(pids) == 2

@@ -170,3 +170,108 @@ def test_highlevel_word2vec_script(fresh_programs):
     """EndStepEvent + trainer.stop + Inferencer with 4 LoD word feeds."""
     mod = _load('word2vec/test_word2vec_new_api.py', REF_HL)
     mod.main(use_cuda=False, is_sparse=True)
+
+
+def test_recognize_digits_conv_script(fresh_programs):
+    """conv variant: simple_img_conv_pool stack from the same script."""
+    mod = _load('test_recognize_digits.py')
+    save = str(fresh_programs / 'digits_conv.model')
+    mod.train('conv', use_cuda=False, parallel=False, save_dirname=save)
+    mod.infer(use_cuda=False, save_dirname=save)
+
+
+def test_understand_sentiment_dynrnn_script(fresh_programs):
+    """notest_ script, dyn_rnn_lstm net: hand-built LSTM inside a
+    DynamicRNN block with Variable operator overloads (+, *)."""
+    mod = _load('notest_understand_sentiment.py')
+    word_dict = paddle.dataset.imdb.word_dict()
+    mod.main(word_dict, net_method=mod.dyn_rnn_lstm, use_cuda=False,
+             parallel=False)
+
+
+def test_highlevel_recognize_digits_conv_script(fresh_programs):
+    mod = _load('recognize_digits/test_recognize_digits_conv.py', REF_HL)
+    mod.main(use_cuda=False)
+
+
+def test_highlevel_understand_sentiment_conv_script(fresh_programs):
+    mod = _load('understand_sentiment/test_understand_sentiment_conv.py',
+                REF_HL)
+    mod.main(use_cuda=False)
+
+
+def test_highlevel_recommender_system_script(fresh_programs):
+    """Trainer API over the multi-tower movielens net; trainer.test
+    feeds the mixed dense/LoD orders."""
+    mod = _load('recommender_system/test_recommender_system_newapi.py',
+                REF_HL)
+    mod.main(use_cuda=False)
+
+
+def _write_tiny_cifar(home):
+    """A small VALID cifar-10-python.tar.gz so scripts that parse the
+    archive themselves (high-level-api cifar10_small_test_set) run on
+    environment-provided data. str pickle keys match what a py3
+    unpickler yields for the reference's py2-written batches."""
+    import io
+    import pickle
+    import tarfile
+    import numpy as np
+    d = home / 'cifar'
+    d.mkdir(parents=True, exist_ok=True)
+    rng = np.random.RandomState(0)
+
+    def batch(n):
+        return {'data': rng.randint(0, 256, (n, 3072)).astype('uint8'),
+                'labels': [int(x) for x in rng.randint(0, 10, n)]}
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode='w:gz') as tf:
+        for name, n in [('cifar-10-batches-py/data_batch_1', 64),
+                        ('cifar-10-batches-py/test_batch', 16)]:
+            payload = pickle.dumps(batch(n), protocol=2)
+            ti = tarfile.TarInfo(name)
+            ti.size = len(payload)
+            tf.addfile(ti, io.BytesIO(payload))
+    (d / 'cifar-10-python.tar.gz').write_bytes(buf.getvalue())
+
+
+def test_highlevel_image_classification_vgg_script(fresh_programs,
+                                                   monkeypatch,
+                                                   tmp_path):
+    """VGG16 via the Trainer API; the script's own
+    cifar10_small_test_set helper (py2 source -> lib2to3 import hook)
+    parses a pre-seeded cifar archive."""
+    import importlib.abc
+    import importlib.machinery
+    import importlib.util
+    import sys
+    home = tmp_path / 'data_home'
+    _write_tiny_cifar(home)
+    monkeypatch.setenv('PADDLE_TPU_DATA_HOME', str(home))
+    hlic = os.path.join(REF_HL, 'image_classification')
+
+    class _Loader(importlib.machinery.SourceFileLoader):
+        def source_to_code(self, data, path, *, _optimize=-1):
+            src = _py2to3(data.decode() if isinstance(data, bytes)
+                          else data, path)
+            return compile(src, path, 'exec', optimize=_optimize)
+
+    class _Finder(importlib.abc.MetaPathFinder):
+        def find_spec(self, fullname, path=None, target=None):
+            if fullname == 'cifar10_small_test_set':
+                fn = os.path.join(hlic, 'cifar10_small_test_set.py')
+                return importlib.util.spec_from_file_location(
+                    fullname, fn, loader=_Loader(fullname, fn))
+            return None
+
+    finder = _Finder()
+    sys.meta_path.insert(0, finder)
+    sys.modules.pop('cifar10_small_test_set', None)
+    try:
+        mod = _load('image_classification/'
+                    'test_image_classification_vgg.py', REF_HL)
+        mod.main(use_cuda=False)
+    finally:
+        sys.meta_path.remove(finder)
+        sys.modules.pop('cifar10_small_test_set', None)

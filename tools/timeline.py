@@ -1,0 +1,82 @@
+"""Convert saved paddle_tpu profiles into a chrome://tracing timeline.
+
+Parity: /root/reference/tools/timeline.py — same CLI shape
+(--profile_path accepts either one file or 'name1=file1,name2=file2'
+for multi-trainer runs; --timeline_path is the output). The input here
+is the JSON event stream written by
+``paddle_tpu.profiler.save_profile(path)`` (op name, start, duration in
+seconds) instead of the reference's profiler protobuf; the output is
+the same catapult trace-event format, loadable in chrome://tracing or
+https://ui.perfetto.dev.
+"""
+import argparse
+import json
+
+
+class ChromeTraceFormatter(object):
+    def __init__(self):
+        self._events = []
+        self._metadata = []
+
+    def emit_pid(self, name, pid):
+        self._metadata.append({
+            'ph': 'M', 'pid': pid, 'tid': 0,
+            'name': 'process_name', 'args': {'name': name}})
+
+    def emit_region(self, timestamp_us, duration_us, pid, tid, category,
+                    name, args):
+        self._events.append({
+            'ph': 'X', 'cat': category, 'name': name, 'pid': pid,
+            'tid': tid, 'ts': int(timestamp_us),
+            'dur': int(duration_us), 'args': args})
+
+    def format_to_string(self, pretty=False):
+        trace = {'traceEvents': self._metadata + self._events}
+        return json.dumps(trace, indent=4 if pretty else None,
+                          separators=None if pretty else (',', ':'))
+
+
+def _load_profiles(profile_path):
+    """{name: [(op, start_s, dur_s), ...]} from the CLI spec."""
+    out = {}
+    if '=' in profile_path:
+        for pair in profile_path.split(','):
+            name, _, path = pair.partition('=')
+            with open(path) as f:
+                out[name] = json.load(f)['events']
+    else:
+        with open(profile_path) as f:
+            out['trainer'] = json.load(f)['events']
+    return out
+
+
+def build_timeline(profiles):
+    tracer = ChromeTraceFormatter()
+    for pid, (name, events) in enumerate(sorted(profiles.items())):
+        tracer.emit_pid('%s(op kernels)' % name, pid)
+        if not events:
+            continue
+        base = min(ev[1] for ev in events)
+        for op, start, dur in events:
+            tracer.emit_region((start - base) * 1e6, dur * 1e6, pid, 0,
+                               'Op', op, {'name': op})
+    return tracer
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        '--profile_path', type=str, default='',
+        help='Input profile file name. If there are multiple files, the '
+             'format should be trainer1=file1,trainer2=file2,ps=file3')
+    parser.add_argument('--timeline_path', type=str, default='',
+                        help='Output timeline file name.')
+    args = parser.parse_args()
+    tracer = build_timeline(_load_profiles(args.profile_path))
+    with open(args.timeline_path, 'w') as f:
+        f.write(tracer.format_to_string())
+    print('timeline written to %s' % args.timeline_path)
+
+
+if __name__ == '__main__':
+    main()
