@@ -286,8 +286,14 @@ def _run_remat_segments(block, ops, env, grad_mode):
                 produced.add(n)
                 if n not in writes:
                     writes.append(n)
-        if RNG_KEY in env and RNG_KEY not in reads:
-            reads.append(RNG_KEY)
+        if RNG_KEY in env:
+            # Stochastic ops advance the key in-place (next_rng); the
+            # segment must both read it AND return the advanced key, or
+            # every segment/step would reuse the same dropout mask.
+            if RNG_KEY not in reads:
+                reads.append(RNG_KEY)
+            if RNG_KEY not in writes:
+                writes.append(RNG_KEY)
 
         def seg(vals, _chunk=tuple(chunk), _reads=tuple(reads),
                 _writes=tuple(writes)):

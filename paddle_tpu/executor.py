@@ -94,10 +94,16 @@ class Scope(object):
 
     def find_var(self, name):
         """Reference-style handle (or None): supports ``.get_tensor()``
-        ``.set(np, place)`` and delegates reads to the live value."""
+        ``.set(np, place)`` and delegates reads to the live value.
+
+        A slot whose stored value is None counts as NOT found — callers
+        (e.g. _state_names_uncached, RNG init) rely on the classic
+        'find_var(...) is not None' presence test."""
         s = self
         while s is not None:
             if name in s.vars:
+                if s.vars[name] is None:
+                    return None
                 return VarBinding(self, name)
             s = s.parent
         return None

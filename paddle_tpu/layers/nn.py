@@ -1364,7 +1364,7 @@ def sequence_concat(input, name=None):
     """Concatenate corresponding sequences along time. Parity:
     operators/sequence_concat_op.cc (axis-0, level-0 concat of LoD
     tensors)."""
-    helper = LayerHelper('sequence_concat', name=name)
+    helper = LayerHelper('sequence_concat', **locals())
     out = helper.create_tmp_variable(
         dtype=helper.input_dtype(input_param_name='input'),
         shape=input[0].shape, lod_level=input[0].lod_level)

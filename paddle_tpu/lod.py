@@ -158,12 +158,28 @@ class SequenceTensor(object):
                 np.asarray(self.lengths).shape))
 
 
+def _flatten_seq(s):
+    # Packed-mode offset LoD rides in the (hashable) aux data so a
+    # tensor crossing a jax transform — or a read-only tree traversal
+    # (profiler / NaN checks) — keeps its LoD instead of silently
+    # degrading to a plain dense tensor (ADVICE r3).
+    if s.packed_mode:
+        aux = tuple(tuple(int(o) for o in level) for level in s._offsets)
+    else:
+        aux = None
+    return (s.data, s.lengths, s.sub_lengths), aux
+
+
+def _unflatten_seq(aux, ch):
+    if aux is not None:
+        return SequenceTensor.from_packed(ch[0], aux)
+    return SequenceTensor(ch[0], ch[1], ch[2])
+
+
 def _register_pytree():
     import jax
     jax.tree_util.register_pytree_node(
-        SequenceTensor,
-        lambda s: ((s.data, s.lengths, s.sub_lengths), None),
-        lambda aux, ch: SequenceTensor(ch[0], ch[1], ch[2]))
+        SequenceTensor, _flatten_seq, _unflatten_seq)
 
 
 try:

@@ -66,6 +66,9 @@ def _beam_search_dynamic(ctx, pre):
 
     out_ids, out_scores, parents, low = [], [], [], [0]
     for r in range(N):
+        # beam_search_op.cc:64-69 re-sorts each parent bucket by
+        # (offset, id) before emitting; within a bucket offsets are
+        # equal, so the reference order is id-ascending
         for it in sorted(buckets[r], key=lambda it: (it[0], it[1])):
             out_ids.append(it[1])
             out_scores.append(it[2])

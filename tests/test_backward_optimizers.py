@@ -256,3 +256,37 @@ def test_rmsprop_exact_step():
     # ref rmsprop_op.h: eps INSIDE the sqrt
     np.testing.assert_allclose(got, 0.5 - 0.1 * g / np.sqrt(ms + 1e-6),
                                rtol=1e-4)
+
+
+def test_memory_optimize_remat_advances_rng():
+    """ADVICE r3 (high): remat segments must thread the PRNG key through,
+    or dropout masks repeat across segments and steps. With frozen params
+    (lr=0), per-step losses must VARY under memory_optimize because each
+    step draws fresh dropout masks."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[32], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = x
+        for _ in range(6):
+            h = fluid.layers.fc(h, size=32, act='relu')
+            h = fluid.layers.dropout(h, dropout_prob=0.5)
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+    fluid.memory_optimize(main)
+    assert main._remat
+
+    rng = np.random.RandomState(3)
+    feed = {'x': rng.randn(16, 32).astype('float32'),
+            'y': rng.randn(16, 1).astype('float32')}
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(
+            main, feed=feed, fetch_list=[loss])[0]).mean())
+            for _ in range(4)]
+    # params frozen -> any loss variation comes from fresh dropout masks
+    assert len(set(losses)) > 1, losses

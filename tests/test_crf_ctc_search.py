@@ -306,7 +306,8 @@ def test_dynamic_beam_search_reference_semantics():
     ids1 = [[5, 6, 7], [6, 5, 8]]
     sc1 = [[0.9, 0.5, 0.1], [0.8, 0.7, 0.2]]
     sid1, ssc1 = run(pre1, ids1, sc1)
-    # top-2 per source; within a parent bucket sorted by (row, id)
+    # top-2 per source; within a parent bucket sorted by (row, id) —
+    # the fill-in-data re-sort at beam_search_op.cc:64-69
     assert np.asarray(sid1.data).ravel().tolist() == [5, 6, 5, 6]
     assert sid1.offsets() == [[0, 1, 2], [0, 2, 4]]
 
@@ -332,7 +333,8 @@ def test_dynamic_beam_search_reference_unittest_case():
     """The exact fixture of the reference's test_beam_search_op.py
     (ids lod [[0,1,4],[0,1,2,3,4]], beam 2, end_id 0), with expectations
     derived from beam_search_op.cc's actual algorithm: per-source top-2
-    over all rows, buckets sorted by (parent row, id), lod[0] = abs
+    over all rows, buckets sorted by (parent row, id) — the explicit
+    fill-in-data re-sort at beam_search_op.cc:64-69 — lod[0] = abs
     high_level, lod[1] = per-parent-row child ranges."""
     import jax.numpy as jnp
     from paddle_tpu.ops.search_ops import _beam_search_dynamic

@@ -394,3 +394,21 @@ def test_sequence_pool_level2_then_fc_trains():
             main, feed={'x': st, 'y': ys}, fetch_list=[loss])[0]).mean())
             for _ in range(6)]
     assert losses[-1] < losses[0] and all(np.isfinite(losses))
+
+
+def test_packed_sequence_tensor_pytree_roundtrip():
+    """ADVICE r3: a packed-mode SequenceTensor crossing a jax tree
+    transform must keep its offset LoD (it rides in pytree aux data)."""
+    import jax
+    from paddle_tpu.lod import SequenceTensor
+    st = SequenceTensor.from_packed(
+        np.arange(8, dtype=np.float32).reshape(4, 2),
+        [[0, 1, 4], [0, 1, 2, 3, 4]])
+    out = jax.tree_util.tree_map(lambda x: x * 2, st)
+    assert out.packed_mode
+    assert out.offsets() == [[0, 1, 4], [0, 1, 2, 3, 4]]
+    np.testing.assert_allclose(np.asarray(out.data),
+                               np.arange(8).reshape(4, 2) * 2)
+    # read-only traversals (profiler / NaN checks) must not raise
+    leaves = jax.tree_util.tree_leaves(st)
+    assert any(getattr(l, 'shape', None) == (4, 2) for l in leaves)
