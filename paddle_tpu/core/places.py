@@ -13,9 +13,16 @@ __all__ = ['TPUPlace', 'CPUPlace', 'CUDAPlace', 'CUDAPinnedPlace',
 
 @functools.lru_cache(maxsize=None)
 def _backend_devices(platform):
+    """Process-LOCAL devices: a Place names a device this process can
+    address. Under jax.distributed, jax.devices() is the global list and
+    device 0 may belong to another process — placing startup state there
+    would make every state array non-addressable (multi-process bug,
+    r4)."""
     import jax
     try:
-        return tuple(jax.devices(platform))
+        if platform is None:
+            return tuple(jax.local_devices())
+        return tuple(jax.local_devices(backend=platform))
     except RuntimeError:
         return ()
 

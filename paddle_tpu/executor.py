@@ -339,6 +339,22 @@ class Executor(object):
         pruned = program.prune(targets)
         return pruned
 
+    def _prep_lowering(self, program, feed, fetch_list, scope,
+                       dynamic=False):
+        """Shared lowering preamble (run / cost_analysis /
+        ParallelExecutor): fetch-name normalization, feed preparation,
+        persistable-state name union with the PRNG key."""
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+        feed = self._prepare_feed(program, feed, dynamic=dynamic)
+        state_in, state_out = self._state_names(program, scope)
+        if scope.find_var(RNG_KEY) is None:
+            scope.set_var(RNG_KEY,
+                          jax.random.PRNGKey(program.random_seed or 0))
+        state_in = sorted(set(state_in) | {RNG_KEY})
+        state_out = sorted(set(state_out) | {RNG_KEY})
+        return fetch_names, feed, state_in, state_out
+
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name='feed', fetch_var_name='fetch', scope=None,
             return_numpy=True, use_program_cache=True):
@@ -351,20 +367,14 @@ class Executor(object):
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
-        fetch_names = [f.name if isinstance(f, Variable) else f
-                       for f in fetch_list]
         dynamic = program.__dict__.setdefault(
             '_dynamic_memo', {}).get(program.fingerprint())
         if dynamic is None:
             dynamic = _is_dynamic_program(program)
             program._dynamic_memo[program.fingerprint()] = dynamic
-        feed = self._prepare_feed(program, feed, dynamic=dynamic)
-        state_in_names, state_out_names = self._state_names(program, scope)
-        if scope.find_var(RNG_KEY) is None:
-            scope.set_var(RNG_KEY,
-                          jax.random.PRNGKey(program.random_seed or 0))
-        state_in_names = sorted(set(state_in_names) | {RNG_KEY})
-        state_out_names = sorted(set(state_out_names) | {RNG_KEY})
+        fetch_names, feed, state_in_names, state_out_names = \
+            self._prep_lowering(program, feed, fetch_list, scope,
+                                dynamic=dynamic)
 
         from .debugging import nan_checks_enabled
         from . import profiler as _prof
@@ -417,6 +427,34 @@ class Executor(object):
             fetches = [SequenceTensor(f, None) if isinstance(
                 f, (jax.Array, np.ndarray)) else f for f in fetches]
         return fetches
+
+    def cost_analysis(self, program, feed, fetch_list, scope=None):
+        """XLA's own ledger for the step this program compiles to:
+        flops, HBM bytes accessed (per-fusion sums), and compiled
+        buffer sizes. Powers PERF.md's roofline accounting (the
+        reference exposes per-op timings via its profiler; here the
+        whole block is ONE XLA program so the ledger is the natural
+        analog)."""
+        scope = scope or global_scope()
+        fetch_names, feed, state_in_names, state_out_names = \
+            self._prep_lowering(program, feed, fetch_list, scope)
+        lower_prog = self._maybe_prune(program, fetch_names)
+        fn = lower_block(lower_prog, lower_prog.global_block(),
+                         sorted(feed.keys()), fetch_names,
+                         state_in_names, state_out_names)
+        state = {n: scope.raw(n) for n in state_in_names}
+        comp = jax.jit(fn).lower(feed, state).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        ma = comp.memory_analysis()
+        return {
+            'flops': float(ca.get('flops', 0.0)),
+            'bytes_accessed': float(ca.get('bytes accessed', 0.0)),
+            'output_bytes': float(ca.get('bytes accessedout{}', 0.0)),
+            'temp_bytes': int(ma.temp_size_in_bytes),
+            'argument_bytes': int(ma.argument_size_in_bytes),
+        }
 
     def close(self):
         self._cache.clear()

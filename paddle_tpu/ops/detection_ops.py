@@ -543,9 +543,11 @@ def _roi_pool(ctx):
         batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
     r = jnp.round(rois * scale)
     x1, y1 = r[:, 0], r[:, 1]
-    x2, y2 = jnp.maximum(r[:, 2], x1 + 1), jnp.maximum(r[:, 3], y1 + 1)
-    bin_h = (y2 - y1) / ph
-    bin_w = (x2 - x1) / pw
+    # roi_pool_op.h: inclusive pixel extents — roi_h = max(y2-y1+1, 1)
+    roi_h = jnp.maximum(r[:, 3] - y1 + 1, 1.0)
+    roi_w = jnp.maximum(r[:, 2] - x1 + 1, 1.0)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
 
     hh = jnp.arange(H, dtype=jnp.float32)
     ww = jnp.arange(W, dtype=jnp.float32)
@@ -554,11 +556,14 @@ def _roi_pool(ctx):
         feat = x[bid]                            # [C, H, W]
         outs = []
         for i in range(ph):
-            hs, he = yy1 + i * bh, yy1 + (i + 1) * bh
-            hmask = (hh >= jnp.floor(hs)) & (hh < jnp.ceil(he))
+            # bin edges on roi-relative coords, then offset and clamp
+            hs = jnp.clip(yy1 + jnp.floor(i * bh), 0, H)
+            he = jnp.clip(yy1 + jnp.ceil((i + 1) * bh), 0, H)
+            hmask = (hh >= hs) & (hh < he)
             for j in range(pw):
-                ws, we = xx1 + j * bw, xx1 + (j + 1) * bw
-                wmask = (ww >= jnp.floor(ws)) & (ww < jnp.ceil(we))
+                ws = jnp.clip(xx1 + jnp.floor(j * bw), 0, W)
+                we = jnp.clip(xx1 + jnp.ceil((j + 1) * bw), 0, W)
+                wmask = (ww >= ws) & (ww < we)
                 m = hmask[:, None] & wmask[None, :]
                 v = jnp.max(jnp.where(m[None], feat, _NEG), axis=(1, 2))
                 v = jnp.where(jnp.any(m), v, 0.0)

@@ -398,8 +398,16 @@ def _smooth_l1(ctx):
 @register_kernel('norm')
 def _l2_normalize(ctx):
     x = unwrap(ctx.input('X'))
-    axis = ctx.attr('axis', -1)
     eps = ctx.attr('epsilon', 1e-10)
+    if ctx.has_input('Scale'):
+        # reference norm_op.cc (SSD cross-channel norm): per spatial
+        # position, out = Scale[c] * x / sqrt(sum_c x^2 + eps)
+        scale = unwrap(ctx.input('Scale')).reshape(1, -1, 1, 1)
+        denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=1,
+                                 keepdims=True) + eps)
+        ctx.set_output('Out', scale * x / denom)
+        return
+    axis = ctx.attr('axis', -1)
     norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
     out = x / jnp.maximum(norm, eps)
     ctx.set_output('Out', out)

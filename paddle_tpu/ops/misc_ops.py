@@ -283,17 +283,19 @@ def _proximal_gd(ctx):
 
 @register_kernel('proximal_adagrad')
 def _proximal_adagrad(ctx):
-    """ref proximal_adagrad_op.h: m' = m + g^2; lr_t = lr/sqrt(m');
-    same shrinkage as proximal_gd with lr_t."""
+    """ref proximal_adagrad_op.h: m' = m + g^2;
+    prox = p - lr*g/sqrt(m'); shrinkage uses the scalar lr."""
     p = unwrap(ctx.input('Param'))
     g = unwrap(ctx.input('Grad'))
     m = unwrap(ctx.input('Moment'))
     lr = unwrap(ctx.input('LearningRate')).reshape(())
     l1, l2 = ctx.attr('l1', 0.0), ctx.attr('l2', 0.0)
     m_out = m + g * g
-    lr_t = lr / jnp.sqrt(m_out)
+    # ref proximal_adagrad_op.h: lr_t only scales the grad step; the
+    # l1/l2 shrinkage uses the SCALAR lr (lr*l1, 1+lr*l2)
     ctx.set_output('MomentOut', m_out)
-    ctx.set_output('ParamOut', _prox(p - lr_t * g, lr_t, l1, l2))
+    ctx.set_output('ParamOut',
+                   _prox(p - lr * g / jnp.sqrt(m_out), lr, l1, l2))
 
 
 # ---- metric ops -----------------------------------------------------------------

@@ -130,9 +130,11 @@ def _adamax(ctx):
     eps = ctx.attr('epsilon', 1e-8)
     lr = _lr(ctx)
     m_out = b1 * m + (1 - b1) * g
-    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    # ref adamax_op.h:57-58: eps folds into the DECAYED term inside the
+    # max (|g|.cwiseMax(beta2*inf + eps)), not onto the denominator
+    inf_out = jnp.maximum(b2 * inf_norm + eps, jnp.abs(g))
     ctx.set_output('ParamOut',
-                   p - (lr / (1 - b1p)) * m_out / (inf_out + eps))
+                   p - (lr / (1 - b1p)) * m_out / inf_out)
     ctx.set_output('MomentOut', m_out)
     ctx.set_output('InfNormOut', inf_out)
 

@@ -166,8 +166,7 @@ def _dynamic_gru(ctx):
     is_rev = bool(ctx.attr('is_reverse', False))
     gact = _ACT[ctx.attr('gate_activation', 'sigmoid')]
     cact = _ACT[ctx.attr('activation', 'tanh')]
-    w_g = w[:, :2 * H]
-    w_c = w[:, 2 * H:]
+    w_g, w_c = _gru_weight_chunks(w, H)
 
     if is_rev:
         x = masked_reverse(x, st.lengths)
@@ -193,6 +192,16 @@ def _dynamic_gru(ctx):
     ctx.set_output('Hidden', SequenceTensor(hs, st.lengths))
 
 
+def _gru_weight_chunks(w, H):
+    """Reference gru weight layout (gru_op.h / gru_unit_op.h, mirrored
+    by the unittests' w.flatten() chunking): the [H, 3H] parameter is
+    a CONTIGUOUS [H, 2H] update/reset block followed by an [H, H]
+    candidate block — not column slices."""
+    flat = w.reshape(-1)
+    return (flat[:2 * H * H].reshape(H, 2 * H),
+            flat[2 * H * H:].reshape(H, H))
+
+
 @register_kernel('gru_unit')
 def _gru_unit(ctx):
     x = jnp.asarray(unwrap(ctx.input('Input')))        # [B, 3H]
@@ -203,11 +212,12 @@ def _gru_unit(ctx):
         else 0.0
     gact = _ACT[ctx.attr('gate_activation', 'sigmoid')]
     cact = _ACT[ctx.attr('activation', 'tanh')]
+    w_ur, w_cand = _gru_weight_chunks(w, H)
     xg = x + b
-    g = gact(xg[:, :2 * H] + h_prev @ w[:, :2 * H])
+    g = gact(xg[:, :2 * H] + h_prev @ w_ur)
     u, r = g[:, :H], g[:, H:]
     rhp = r * h_prev
-    c = cact(xg[:, 2 * H:] + rhp @ w[:, 2 * H:])
+    c = cact(xg[:, 2 * H:] + rhp @ w_cand)
     h = (1 - u) * h_prev + u * c   # ref gru_unit_op.h: u*(c-h_p)+h_p
     ctx.set_output('Gate', jnp.concatenate([u, r, c], axis=-1))
     ctx.set_output('ResetHiddenPrev', rhp)

@@ -48,7 +48,13 @@ def _assign_value(ctx):
     import numpy as np
     shape = ctx.attr('shape')
     dtype = runtime_dtype(ctx.attr('dtype', 'float32'))
+    # reference assign_value_op carries the payload in the attr list
+    # keyed by dtype (assign_value_op.cc: fp32_values / int32_values)
     values = ctx.attr('values')
+    if values is None:
+        key = 'int32_values' if np.dtype(dtype).kind in 'iu' \
+            else 'fp32_values'
+        values = ctx.attr(key)
     ctx.set_output('Out', jnp.asarray(np.array(values), dtype=dtype)
                    .reshape(shape))
 

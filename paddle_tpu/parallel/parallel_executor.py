@@ -52,7 +52,21 @@ class ParallelExecutor(object):
         spec = getattr(var, 'sharding', None) if var is not None else None
         if not spec:
             return NamedSharding(mesh, P())
-        return NamedSharding(mesh, P(*clean_spec(spec, mesh)))
+        spec = clean_spec(spec, mesh)
+        # a sharding decided against a different world size (e.g. ZeRO
+        # slicing at transpile time before the mesh existed) may not
+        # divide this mesh's extent — degrade that dim to replicated
+        # rather than failing the whole step
+        extents = dict(zip(mesh.axis_names, mesh.devices.shape))
+        shape = getattr(var, 'shape', None) or ()
+        for d, entry in enumerate(spec):
+            if entry is None or d >= len(shape):
+                continue
+            names = entry if isinstance(entry, (tuple, list)) else (entry,)
+            e = int(np.prod([extents.get(a, 1) for a in names]))
+            if e and int(shape[d]) % e != 0:
+                spec[d] = None
+        return NamedSharding(mesh, P(*spec))
 
     def _shardings(self, feed, state_names):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -76,15 +90,8 @@ class ParallelExecutor(object):
         feed = feed if feed is not None else feed_dict or {}
         program = self._program
         scope = self._scope
-        fetch_names = [f.name if isinstance(f, Variable) else f
-                       for f in fetch_list]
-        feed = self._exe._prepare_feed(program, feed)
-        state_in, state_out = self._exe._state_names(program, scope)
-        if scope.find_var(RNG_KEY) is None:
-            scope.set_var(RNG_KEY,
-                          jax.random.PRNGKey(program.random_seed or 0))
-        state_in = sorted(set(state_in) | {RNG_KEY})
-        state_out = sorted(set(state_out) | {RNG_KEY})
+        fetch_names, feed, state_in, state_out = \
+            self._exe._prep_lowering(program, feed, fetch_list, scope)
 
         from ..executor import _spec
         from ..debugging import nan_checks_enabled
@@ -93,7 +100,13 @@ class ParallelExecutor(object):
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
                tuple(fetch_names), tuple(state_in), tuple(state_out),
                guard)
+        multiproc = jax.process_count() > 1
         jitted = self._cache.get(key)
+        if jitted is None or multiproc:
+            # only the cache-miss path and the multi-process globalize
+            # path consume the shardings; skip the per-step block walk
+            # on the single-process hot path
+            feeds_s, state_s, repl = self._shardings(feed, state_in)
         if jitted is None:
             from ..core import lowering as _lowering
             fn = lower_block(program, program.global_block(),
@@ -106,8 +119,10 @@ class ParallelExecutor(object):
                 with _lowering.sharding_mesh(self._mesh):
                     return _fn(feeds, state)
 
-            feeds_s, state_s, repl = self._shardings(feed, state_in)
             out_state_s = {n: self._var_sharding(n) for n in state_out}
+            # multi-process: fetches must come back fully replicated so
+            # every process can materialize them as numpy
+            fetch_s = repl if multiproc else None
             if guard:
                 # debug mode: functionalize per-op NaN/Inf checks; no
                 # donation so state survives a thrown error
@@ -115,15 +130,39 @@ class ParallelExecutor(object):
                 jitted = jax.jit(
                     checkify.checkify(fn_with_mesh),
                     in_shardings=(feeds_s, state_s),
-                    out_shardings=(None, (None, out_state_s)))
+                    out_shardings=(None, (fetch_s, out_state_s)))
             else:
                 jitted = jax.jit(
                     fn_with_mesh, in_shardings=(feeds_s, state_s),
-                    out_shardings=(None, out_state_s),
+                    out_shardings=(fetch_s, out_state_s),
                     donate_argnums=(1,))
             self._cache[key] = jitted
 
         state = {n: scope.raw(n) for n in state_in}
+        if multiproc:
+            # Each process feeds its LOCAL batch shard (the reference's
+            # per-trainer reader semantics); host-local values become
+            # global arrays over the multi-process mesh. Replicated
+            # state (params, RNG key) passes the full local value.
+            def _globalize(v, s, full_value):
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    return v          # already a global array (prev step)
+                arr = np.asarray(v)
+                # full_value: every process holds the WHOLE tensor
+                # (startup-initialized state) — pass global_shape so a
+                # dp-sharded var (ZeRO slice) extracts this process's
+                # shards instead of inferring a nprocs-times-larger
+                # global. Feeds are per-process chunks: infer global.
+                return jax.make_array_from_process_local_data(
+                    s, arr, global_shape=arr.shape if full_value
+                    else None)
+            feed = jax.tree_util.tree_map(
+                lambda v, s: _globalize(v, s, False), feed, feeds_s)
+            # state shardings are per-var NamedShardings; broadcast over
+            # the (possibly pytree) state value's leaves
+            state = {n: jax.tree_util.tree_map(
+                lambda v, s=state_s[n]: _globalize(v, s, True), state[n])
+                for n in state}
         with self._mesh:
             if guard:
                 err, (fetches, new_state) = jitted(feed, state)
@@ -140,3 +179,46 @@ class ParallelExecutor(object):
         """Parity: ParallelExecutor.bcast_params (NCCL bcast). Params are
         replicated by sharding; nothing to do."""
         pass
+
+    def compile_stats(self, fetch_list, feed):
+        """Compile-time PER-DEVICE buffer accounting for the sharded
+        step (no execution): XLA's memory_analysis on the AOT-lowered
+        program. Used to prove ZeRO accumulator slicing at real scale
+        (VERDICT r3 #4) — sliced optimizer state shows up as smaller
+        per-device argument bytes.
+
+        Returns dict(argument_bytes, temp_bytes, output_bytes) for ONE
+        device of the mesh."""
+        program = self._program
+        scope = self._scope
+        fetch_names, feed, state_in, state_out = \
+            self._exe._prep_lowering(program, feed, fetch_list, scope)
+        # NB: lowers the FULL program (no pruning), mirroring
+        # ParallelExecutor.run — Executor.cost_analysis models the
+        # pruning Executor.run path instead.
+        from ..core import lowering as _lowering
+        fn = lower_block(program, program.global_block(),
+                         sorted(feed.keys()), fetch_names, state_in,
+                         state_out)
+
+        def fn_with_mesh(feeds, state, _fn=fn):
+            with _lowering.sharding_mesh(self._mesh):
+                return _fn(feeds, state)
+
+        feeds_s, state_s, repl = self._shardings(feed, state_in)
+        out_state_s = {n: self._var_sharding(n) for n in state_out}
+        jitted = jax.jit(fn_with_mesh, in_shardings=(feeds_s, state_s),
+                         out_shardings=(None, out_state_s))
+        state = {n: scope.raw(n) for n in state_in}
+        abstract = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(np.shape(v),
+                                           np.asarray(v).dtype),
+            (feed, state))
+        with self._mesh:
+            comp = jitted.lower(*abstract).compile()
+        ma = comp.memory_analysis()
+        return {
+            'argument_bytes': int(ma.argument_size_in_bytes),
+            'temp_bytes': int(ma.temp_size_in_bytes),
+            'output_bytes': int(ma.output_size_in_bytes),
+        }

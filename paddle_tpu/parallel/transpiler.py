@@ -53,6 +53,18 @@ class DistributeTranspiler(object):
         self.trainers = trainers
         self.pserver_endpoints = [e for e in pservers.split(",") if e]
         self.sync_mode = sync_mode
+        if not sync_mode:
+            # ref distribute_transpiler.py:196-204: async SGD applies
+            # each trainer's grads without barriers. XLA SPMD collectives
+            # are inherently synchronous; silently running async scripts
+            # as sync would change convergence behavior without signal.
+            import warnings
+            warnings.warn(
+                "DistributeTranspiler(sync_mode=False): async parameter-"
+                "server SGD has no TPU mapping — XLA collectives are "
+                "synchronous. This job will run in SYNC mode (gradients "
+                "psum'd every step). Set sync_mode=True to silence.",
+                UserWarning, stacklevel=2)
         self._program = program or default_main_program()
         # Multi-host bootstrap: one process per trainer. The coordinator is
         # the first pserver endpoint (reused as the JAX coordination
@@ -126,7 +138,17 @@ class DistributeTranspiler(object):
         """No parameter server exists on the TPU stack; optimizer state is
         ZeRO-sharded across the dp axis instead (see
         _slice_optimizer_state). Returns an empty heartbeat program so
-        pserver launcher scripts stay functional."""
+        pserver launcher scripts stay functional — and WARNS, because a
+        cluster script that expected remote optimization would otherwise
+        idle silently (r2 weak #6)."""
+        import warnings
+        warnings.warn(
+            "get_pserver_program(%r): the TPU stack has no parameter "
+            "server — optimizer state is ZeRO-sharded over the dp mesh "
+            "axis on the trainers and gradients ride XLA collectives. "
+            "Returning an empty heartbeat program; this process performs "
+            "NO optimization work." % (endpoint,),
+            UserWarning, stacklevel=2)
         return Program()
 
     def get_startup_program(self, endpoint, pserver_program=None):

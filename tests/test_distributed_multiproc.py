@@ -1,0 +1,96 @@
+"""First-ever multi-PROCESS run of the distributed path (VERDICT r3 #3).
+
+Two CPU subprocesses bootstrap a jax.distributed process group through
+DistributeTranspiler.transpile (the PADDLE_TPU_DISTRIBUTED=1 branch that
+was previously dead code), run the transpiled ParallelExecutor step over
+the 4-device global mesh, and must produce losses identical to a
+single-process full-batch run of the same program.
+
+Launch recipe (documented for users; mirrors the reference's
+one-process-per-trainer launch, distribute_transpiler.py:159):
+
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    PADDLE_TPU_DISTRIBUTED=1 PTPU_TRAINER_ID=<i> \
+    PTPU_COORD=127.0.0.1:<port> python tests/distributed_worker.py
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_oracle():
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 6).astype('float32')
+    ys = (xs.sum(1, keepdims=True) * 0.3).astype('float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return [float(np.ravel(np.asarray(exe.run(
+            main_p, feed={'x': xs, 'y': ys}, fetch_list=[loss])[0]))[0])
+            for _ in range(4)]
+
+
+def test_two_process_jax_distributed_matches_single_process():
+    port = _free_port()
+    workers = []
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ('XLA_FLAGS',)}
+    for tid in (0, 1):
+        env = dict(base_env)
+        env.update({
+            'JAX_PLATFORMS': 'cpu',
+            'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+            'PADDLE_TPU_DISTRIBUTED': '1',
+            'PTPU_TRAINER_ID': str(tid),
+            'PTPU_COORD': '127.0.0.1:%d' % port,
+        })
+        workers.append(subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__),
+                          'distributed_worker.py')],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    outs = []
+    for w in workers:
+        out, err = w.communicate(timeout=540)
+        assert w.returncode == 0, 'worker failed:\n%s\n%s' % (out, err)
+        outs.append(out)
+    per_worker = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith('LOSSES=')]
+        assert line, out
+        per_worker.append(json.loads(line[0][len('LOSSES='):]))
+    # both processes see the same (replicated) loss sequence
+    np.testing.assert_allclose(per_worker[0], per_worker[1], rtol=1e-6)
+    # and it matches the single-process full-batch oracle
+    oracle = _single_process_oracle()
+    np.testing.assert_allclose(per_worker[0], oracle, rtol=1e-4,
+                               atol=1e-6)
+    # training actually progressed
+    assert per_worker[0][-1] < per_worker[0][0]

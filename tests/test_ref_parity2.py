@@ -366,8 +366,11 @@ def test_dynamic_gru_relu_activation():
         out, = exe.run(main, feed={'x': create_lod_tensor(rows, [lens])},
                        fetch_list=[h])
     # numpy ref (gru_kernel.h): u,r = sig(xg+h@wg); c = relu(xc+(r*h)@wc)
+    # weight layout per test_gru_op.py's gru_step: flattened [H,2H]
+    # update/reset chunk then [H,H] candidate chunk
     hprev = np.zeros(H)
-    w_g, w_c = w[:, :2 * H], w[:, 2 * H:]
+    w_g = w.flatten()[:2 * H * H].reshape(H, 2 * H)
+    w_c = w.flatten()[2 * H * H:].reshape(H, H)
     for t in range(4):
         g = _sigmoid(rows[t, :2 * H] + hprev @ w_g)
         u, r = g[:H], g[H:]

@@ -1,0 +1,1313 @@
+"""Third parity wave (VERDICT r3 #1): port of the reference op-unittest
+suite's SEMANTICS for the ops that had no dedicated case yet.
+
+Each test names the reference file it mirrors
+(python/paddle/fluid/tests/unittests/test_<op>_op.py) and re-implements
+that file's setUp() expectation in numpy, then runs the paddle_tpu
+kernel against it. No reference code is copied — the numpy oracles are
+re-derived from the documented op semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.lod import SequenceTensor, create_lod_tensor
+
+
+def run_op(op_type, inputs, attrs, out_slots=('Out',), extra_outs=(),
+           lod_levels=None, dtypes=None):
+    """One-op program. inputs: slot -> ndarray | SequenceTensor | list
+    of (name, ndarray) pairs (reference multi-input convention)."""
+    lod_levels = lod_levels or {}
+    dtypes = dtypes or {}
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        in_vars, feed = {}, {}
+
+        def mk(name, val, slot):
+            arr = val.data if isinstance(val, SequenceTensor) else val
+            arr = np.asarray(arr)
+            v = fluid.layers.data(
+                name=name, shape=list(arr.shape[1:]),
+                dtype=dtypes.get(slot, str(arr.dtype)),
+                lod_level=lod_levels.get(slot, 0))
+            feed[name] = val
+            return v
+
+        for slot, val in inputs.items():
+            if isinstance(val, list):
+                in_vars[slot] = [mk(n, v, slot) for n, v in val]
+            else:
+                in_vars[slot] = [mk(slot.lower(), val, slot)]
+        outs = {}
+        block = main.global_block()
+        for i, slot in enumerate(tuple(out_slots) + tuple(extra_outs)):
+            outs[slot] = block.create_var(name='po_%d' % i,
+                                          dtype='float32')
+        block.append_op(type=op_type, inputs=in_vars,
+                        outputs={k: [v] for k, v in outs.items()},
+                        attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed=feed, fetch_list=[outs[s] for s in out_slots])
+    return [np.asarray(r.data if isinstance(r, SequenceTensor) else r)
+            for r in res]
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+# =====================================================================
+# Optimizer update ops — single-op update-rule tables.
+# =====================================================================
+
+def test_momentum_plain_and_nesterov():
+    """Mirrors test_momentum_op.py (TestMomentumOp1/2)."""
+    r = _rng(1)
+    p = r.random_sample((12, 7)).astype('float32')
+    g = r.random_sample((12, 7)).astype('float32')
+    v = r.random_sample((12, 7)).astype('float32')
+    lr = np.array([0.001], 'float32')
+    mu = 0.0001
+    for nesterov in (False, True):
+        po, vo = run_op('momentum',
+                        {'Param': p, 'Grad': g, 'Velocity': v,
+                         'LearningRate': lr},
+                        {'mu': mu, 'use_nesterov': nesterov},
+                        out_slots=('ParamOut', 'VelocityOut'))
+        v_ref = mu * v + g
+        if nesterov:
+            p_ref = p - g * lr - v_ref * mu * lr
+        else:
+            p_ref = p - lr * v_ref
+        np.testing.assert_allclose(vo, v_ref, rtol=1e-5)
+        np.testing.assert_allclose(po, p_ref, rtol=1e-5)
+
+
+def test_adadelta_update_rule():
+    """Mirrors test_adadelta_op.py (TestAdadeltaOp1)."""
+    r = _rng(2)
+    p = r.uniform(-1, 1, (10, 11)).astype('float32')
+    g = r.uniform(-1, 1, (10, 11)).astype('float32')
+    asg = r.random_sample((10, 11)).astype('float32')
+    asu = r.random_sample((10, 11)).astype('float32')
+    rho, eps = 0.95, 1e-6
+    po, go, uo = run_op(
+        'adadelta',
+        {'Param': p, 'Grad': g, 'AvgSquaredGrad': asg,
+         'AvgSquaredUpdate': asu},
+        {'rho': rho, 'epsilon': eps},
+        out_slots=('ParamOut', 'AvgSquaredGradOut', 'AvgSquaredUpdateOut'))
+    asg_ref = rho * asg + (1 - rho) * g * g
+    upd = -np.sqrt((asu + eps) / (asg_ref + eps)) * g
+    asu_ref = rho * asu + (1 - rho) * upd * upd
+    np.testing.assert_allclose(go, asg_ref, rtol=1e-5)
+    np.testing.assert_allclose(uo, asu_ref, rtol=1e-5)
+    np.testing.assert_allclose(po, p + upd, rtol=1e-5)
+
+
+def test_adamax_update_rule():
+    """Mirrors test_adamax_op.py (TestAdamaxOp1): lr/(1-beta1^t) bias
+    correction, inf-norm second moment."""
+    r = _rng(3)
+    p = r.uniform(-1, 1, (9, 8)).astype('float32')
+    g = r.uniform(-1, 1, (9, 8)).astype('float32')
+    m = r.uniform(-1, 1, (9, 8)).astype('float32')
+    inf = r.random_sample((9, 8)).astype('float32')
+    b1, b2, eps = 0.78, 0.899, 1e-5
+    b1p = np.array([b1 ** 10], 'float32')
+    po, mo, io = run_op(
+        'adamax',
+        {'Param': p, 'Grad': g, 'Moment': m, 'InfNorm': inf,
+         'LearningRate': np.array([0.002], 'float32'), 'Beta1Pow': b1p},
+        {'beta1': b1, 'beta2': b2, 'epsilon': eps},
+        out_slots=('ParamOut', 'MomentOut', 'InfNormOut'))
+    m_ref = b1 * m + (1 - b1) * g
+    inf_ref = np.maximum(b2 * inf + eps, np.abs(g))
+    lr_t = 0.002 / (1 - b1p[0])
+    np.testing.assert_allclose(mo, m_ref, rtol=1e-5)
+    np.testing.assert_allclose(io, inf_ref, rtol=1e-5)
+    np.testing.assert_allclose(p - lr_t * m_ref / inf_ref, po, rtol=1e-5)
+
+
+def test_decayed_adagrad_update_rule():
+    """Mirrors test_decayed_adagrad_op.py."""
+    r = _rng(4)
+    p = r.random_sample((13, 21)).astype('float32')
+    g = r.random_sample((13, 21)).astype('float32')
+    m = np.zeros((13, 21), 'float32')
+    lr, decay, eps = 0.01, 0.80, 1e-8
+    po, mo = run_op('decayed_adagrad',
+                    {'Param': p, 'Grad': g, 'Moment': m,
+                     'LearningRate': np.array([lr], 'float32')},
+                    {'decay': decay, 'epsilon': eps},
+                    out_slots=('ParamOut', 'MomentOut'))
+    m_ref = decay * m + (1 - decay) * g * g
+    np.testing.assert_allclose(mo, m_ref, rtol=1e-5)
+    np.testing.assert_allclose(po, p - lr * g / (np.sqrt(m_ref) + eps),
+                               rtol=1e-5)
+
+
+def test_ftrl_update_rule():
+    """Mirrors test_ftrl_op.py (lr_power=-0.5 branch with l1/l2)."""
+    r = _rng(5)
+    w = r.random_sample((10, 15)).astype('float32')
+    g = r.random_sample((10, 15)).astype('float32')
+    sq = np.full((10, 15), 0.1, 'float32')
+    lin = np.full((10, 15), 0.1, 'float32')
+    lr, l1, l2, lr_power = 0.01, 0.1, 0.2, -0.5
+    po, so, lo = run_op(
+        'ftrl',
+        {'Param': w, 'SquaredAccumulator': sq, 'LinearAccumulator': lin,
+         'Grad': g, 'LearningRate': np.array([lr], 'float32')},
+        {'l1': l1, 'l2': l2, 'lr_power': lr_power},
+        out_slots=('ParamOut', 'SquaredAccumOut', 'LinearAccumOut'))
+    new_acc = sq + g * g
+    lin_ref = lin + g - ((np.sqrt(new_acc) - np.sqrt(sq)) / lr) * w
+    x = l1 * np.sign(lin_ref) - lin_ref
+    y = np.sqrt(new_acc) / lr + 2 * l2
+    p_ref = np.where(np.abs(lin_ref) > l1, x / y, 0.0)
+    np.testing.assert_allclose(so, new_acc, rtol=1e-5)
+    np.testing.assert_allclose(lo, lin_ref, rtol=1e-4)
+    np.testing.assert_allclose(po, p_ref, rtol=1e-4, atol=1e-6)
+
+
+def test_proximal_adagrad_update_rule():
+    """Mirrors test_proximal_adagrad_op.py."""
+    r = _rng(6)
+    w = r.random_sample((10, 10)).astype('float32')
+    m = r.random_sample((10, 10)).astype('float32')
+    g = r.random_sample((10, 10)).astype('float32')
+    lr, l1, l2 = 0.1, 0.1, 0.2
+    po, mo = run_op('proximal_adagrad',
+                    {'Param': w, 'Grad': g, 'Moment': m,
+                     'LearningRate': np.array([lr], 'float32')},
+                    {'l1': l1, 'l2': l2},
+                    out_slots=('ParamOut', 'MomentOut'))
+    m_ref = m + g * g
+    prox = w - lr * g / np.sqrt(m_ref)
+    x = np.maximum(np.abs(prox) - lr * l1, 0)
+    p_ref = np.sign(prox) * (x / (1.0 + lr * l2))
+    np.testing.assert_allclose(mo, m_ref, rtol=1e-5)
+    np.testing.assert_allclose(po, p_ref, rtol=1e-4)
+
+
+def test_proximal_gd_update_rule():
+    """Mirrors test_proximal_gd_op.py."""
+    r = _rng(7)
+    w = r.random_sample((10, 10)).astype('float32')
+    g = r.random_sample((10, 10)).astype('float32')
+    lr, l1, l2 = 0.1, 0.1, 0.2
+    po, = run_op('proximal_gd',
+                 {'Param': w, 'Grad': g,
+                  'LearningRate': np.array([lr], 'float32')},
+                 {'l1': l1, 'l2': l2}, out_slots=('ParamOut',))
+    prox = w - lr * g
+    x = np.maximum(np.abs(prox) - lr * l1, 0)
+    p_ref = np.sign(prox) * (x / (1.0 + lr * l2))
+    np.testing.assert_allclose(po, p_ref, rtol=1e-5)
+
+
+# =====================================================================
+# Loss ops
+# =====================================================================
+
+def test_log_loss_formula():
+    """Mirrors test_log_loss_op.py: eps inside both logs."""
+    r = _rng(8)
+    pred = r.uniform(0.1, 1.0, (32, 1)).astype('float32')
+    lab = r.randint(0, 2, (32, 1)).astype('float32')
+    eps = 1e-4
+    got, = run_op('log_loss', {'Predicted': pred, 'Labels': lab},
+                  {'epsilon': eps}, out_slots=('Loss',))
+    ref = -lab * np.log(pred + eps) - (1 - lab) * np.log(1 - pred + eps)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_hinge_loss_formula():
+    """Mirrors test_hinge_loss_op.py: max(1 - (2y-1)*logit, 0)."""
+    r = _rng(9)
+    logits = r.uniform(-10, 10, (64, 1)).astype('float32')
+    labels = r.randint(0, 2, (64, 1)).astype('float32')
+    got, = run_op('hinge_loss', {'Logits': logits, 'Labels': labels}, {},
+                  out_slots=('Loss',))
+    ref = np.maximum(1.0 - (2 * labels - 1) * logits, 0)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_huber_loss_piecewise():
+    """Mirrors test_huber_loss_op.py: residual = Y - X, quadratic inside
+    delta, linear outside."""
+    r = _rng(10)
+    x = r.uniform(0, 1, (64, 1)).astype('float32')
+    y = r.uniform(0, 1, (64, 1)).astype('float32')
+    delta = 0.5
+    got, = run_op('huber_loss', {'X': x, 'Y': y}, {'delta': delta},
+                  out_slots=('Out',), extra_outs=('Residual',))
+    res = y - x
+    ref = np.where(np.abs(res) <= delta, 0.5 * res * res,
+                   delta * (np.abs(res) - 0.5 * delta))
+    np.testing.assert_allclose(got, ref.reshape(64, 1), rtol=1e-5)
+
+
+def test_modified_huber_loss_piecewise():
+    """Mirrors test_modified_huber_loss_op.py: z = x*(2y-1);
+    z >= 1 -> 0; -1 <= z < 1 -> (1-z)^2; z < -1 -> -4z."""
+    r = _rng(11)
+    x = r.uniform(-2, 2, (32, 1)).astype('float32')
+    y = r.choice([0, 1], 32).reshape(32, 1).astype('float32')
+    z = x * (2 * y - 1)
+    x[np.abs(z - 1) < 0.05] = 1.5  # keep away from the junction
+    z = x * (2 * y - 1)
+    got, = run_op('modified_huber_loss', {'X': x, 'Y': y}, {},
+                  out_slots=('Out',), extra_outs=('IntermediateVal',))
+    ref = np.where(z >= 1, 0.0,
+                   np.where(z >= -1, (1 - z) ** 2, -4 * z))
+    np.testing.assert_allclose(got, ref.reshape(32, 1), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_margin_rank_loss_formula():
+    """Mirrors test_margin_rank_loss_op.py: max(0, -label*(x1-x2)+m)."""
+    r = _rng(12)
+    label = (2 * r.randint(0, 2, (5, 1)) - 1).astype('float32')
+    x1 = r.random_sample((5, 1)).astype('float32')
+    x2 = r.random_sample((5, 1)).astype('float32')
+    m = 0.5
+    got, = run_op('margin_rank_loss',
+                  {'Label': label, 'X1': x1, 'X2': x2}, {'margin': m},
+                  out_slots=('Out',), extra_outs=('Activated',))
+    ref = np.maximum(-label * (x1 - x2) + m, 0)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_rank_loss_formula():
+    """Mirrors test_rank_loss_op.py: log(1+e^(l-r)) - label*(l-r)."""
+    r = _rng(13)
+    label = r.randint(0, 2, (5, 1)).astype('float32')
+    left = r.random_sample((5, 1)).astype('float32')
+    right = r.random_sample((5, 1)).astype('float32')
+    got, = run_op('rank_loss',
+                  {'Label': label, 'Left': left, 'Right': right}, {},
+                  out_slots=('Out',))
+    ref = np.log(1.0 + np.exp(left - right)) - label * (left - right)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_squared_l2_distance_rowwise_and_broadcast():
+    """Mirrors test_squared_l2_distance_op.py: row-sum of squared diff;
+    Y with first dim 1 broadcasts."""
+    r = _rng(14)
+    x = r.uniform(0.1, 0.6, (4, 3)).astype('float32')
+    for yshape in ((4, 3), (1, 3)):
+        y = r.uniform(0.1, 0.6, yshape).astype('float32')
+        got, = run_op('squared_l2_distance', {'X': x, 'Y': y}, {},
+                      out_slots=('Out',), extra_outs=('sub_result',))
+        ref = ((x - y) ** 2).sum(1, keepdims=True)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_l1_norm_scalar():
+    """Mirrors test_l1_norm_op.py: sum(|X|) over all elements."""
+    x = _rng(15).uniform(-1, 1, (13, 19)).astype('float32')
+    got, = run_op('l1_norm', {'X': x}, {})
+    np.testing.assert_allclose(np.ravel(got)[0], np.abs(x).sum(),
+                               rtol=1e-5)
+
+
+def test_squared_l2_norm_scalar():
+    """Mirrors test_squared_l2_norm_op.py: ||X||_F^2."""
+    x = _rng(16).uniform(-1, 1, (13, 19)).astype('float32')
+    got, = run_op('squared_l2_norm', {'X': x}, {})
+    np.testing.assert_allclose(np.ravel(got)[0], (x ** 2).sum(),
+                               rtol=1e-5)
+
+
+# =====================================================================
+# Elementwise / scalar ops
+# =====================================================================
+
+def test_scale_op_value():
+    """Mirrors test_scale_op.py: Out = scale * X."""
+    x = _rng(17).random_sample((10, 10)).astype('float32')
+    got, = run_op('scale', {'X': x}, {'scale': -2.3})
+    np.testing.assert_allclose(got, x * np.float32(-2.3), rtol=1e-6)
+
+
+def test_sign_op_value():
+    """Mirrors test_sign_op.py."""
+    x = _rng(18).uniform(-10, 10, (10, 10)).astype('float32')
+    got, = run_op('sign', {'X': x}, {})
+    np.testing.assert_allclose(got, np.sign(x))
+
+
+def test_clip_minmax():
+    """Mirrors test_clip_op.py."""
+    x = _rng(19).random_sample((16, 8)).astype('float32')
+    got, = run_op('clip', {'X': x}, {'min': 0.2, 'max': 0.8})
+    np.testing.assert_allclose(got, np.clip(x, 0.2, 0.8), rtol=1e-6)
+
+
+def test_minus_op_value():
+    """Mirrors test_minus_op.py: Out = X - Y."""
+    r = _rng(20)
+    x = r.random_sample((32, 14)).astype('float32')
+    y = r.random_sample((32, 14)).astype('float32')
+    got, = run_op('minus', {'X': x, 'Y': y}, {})
+    np.testing.assert_allclose(got, x - y, rtol=1e-6)
+
+
+def test_sum_multi_input():
+    """Mirrors test_sum_op.py: variadic X -> elementwise sum."""
+    r = _rng(21)
+    xs = [r.random_sample((3, 4)).astype('float32') for _ in range(3)]
+    got, = run_op('sum', {'X': [('x%d' % i, x) for i, x in
+                                enumerate(xs)]}, {})
+    np.testing.assert_allclose(got, xs[0] + xs[1] + xs[2], rtol=1e-6)
+
+
+@pytest.mark.parametrize('ew_op,np_fn', [
+    ('elementwise_add', np.add), ('elementwise_sub', np.subtract),
+    ('elementwise_mul', np.multiply), ('elementwise_div', np.divide),
+])
+def test_elementwise_same_shape(ew_op, np_fn):
+    """Mirrors test_elementwise_{add,sub,mul,div}_op.py basic cases."""
+    r = _rng(22)
+    x = r.uniform(0.5, 2.0, (13, 17)).astype('float32')
+    y = r.uniform(0.5, 2.0, (13, 17)).astype('float32')
+    got, = run_op(ew_op, {'X': x, 'Y': y}, {})
+    np.testing.assert_allclose(got, np_fn(x, y), rtol=1e-5)
+
+
+def test_elementwise_broadcast_trailing_axis():
+    """Mirrors test_elementwise_add_op.py TestElementwiseAddOp_broadcast:
+    Y of shape [D2] with axis=1 against X [D1, D2, D3]."""
+    r = _rng(23)
+    x = r.random_sample((2, 3, 4)).astype('float32')
+    y = r.random_sample((3,)).astype('float32')
+    got, = run_op('elementwise_add', {'X': x, 'Y': y}, {'axis': 1})
+    np.testing.assert_allclose(got, x + y.reshape(1, 3, 1), rtol=1e-6)
+
+
+# =====================================================================
+# Shape / data movement ops
+# =====================================================================
+
+def test_reshape_inference():
+    """Mirrors test_reshape_op.py incl. the -1 dim."""
+    x = _rng(24).random_sample((10, 20)).astype('float32')
+    got, = run_op('reshape', {'X': x}, {'shape': [4, 50]})
+    np.testing.assert_allclose(got, x.reshape(4, 50))
+    got, = run_op('reshape', {'X': x}, {'shape': [-1, 25]})
+    np.testing.assert_allclose(got, x.reshape(8, 25))
+
+
+def test_transpose_axis_perms():
+    """Mirrors test_transpose_op.py axis grids."""
+    r = _rng(25)
+    for shape, axis in [((3, 4), (1, 0)), ((2, 3, 4), (1, 2, 0)),
+                        ((2, 3, 4, 5), (0, 2, 3, 1))]:
+        x = r.random_sample(shape).astype('float32')
+        got, = run_op('transpose', {'X': x}, {'axis': list(axis)})
+        np.testing.assert_allclose(got, x.transpose(axis))
+
+
+def test_concat_mid_axis():
+    """Mirrors test_concat_op.py: axis=1 concat of 3 inputs."""
+    r = _rng(26)
+    xs = [r.random_sample((2, k, 5)).astype('float32')
+          for k in (3, 1, 2)]
+    got, = run_op('concat', {'X': [('c%d' % i, x) for i, x in
+                                   enumerate(xs)]}, {'axis': 1})
+    np.testing.assert_allclose(got, np.concatenate(xs, 1))
+
+
+def test_pad_constant_values():
+    """Mirrors test_pad_op.py: flat [(before, after)...] paddings +
+    pad_value."""
+    x = _rng(27).random_sample((6, 7)).astype('float32')
+    got, = run_op('pad', {'X': x},
+                  {'paddings': [0, 1, 2, 3], 'pad_value': 0.9})
+    ref = np.pad(x, [(0, 1), (2, 3)], mode='constant',
+                 constant_values=0.9)
+    np.testing.assert_allclose(got, ref.astype('float32'), rtol=1e-6)
+
+
+def test_multiplex_row_select():
+    """Mirrors test_multiplex_op.py: per-row candidate-tensor pick."""
+    r = _rng(28)
+    rows = 4
+    idx = np.arange(rows)
+    r.shuffle(idx)
+    idx = idx.reshape(rows, 1).astype('int32')
+    xs = [r.random_sample((rows, 10)).astype('float32')
+          for _ in range(4)]
+    got, = run_op('multiplex',
+                  {'Ids': idx,
+                   'X': [('m%d' % i, x) for i, x in enumerate(xs)]}, {})
+    ref = np.stack([xs[idx[i, 0]][i] for i in range(rows)])
+    np.testing.assert_allclose(got, ref)
+
+
+def test_fill_constant_and_batch_size_like():
+    """Mirrors test_fill_constant_op.py /
+    test_fill_constant_batch_size_like_op.py."""
+    got, = run_op('fill_constant', {},
+                  {'shape': [5, 3], 'value': 2.5, 'dtype': 'float32'})
+    np.testing.assert_allclose(got, np.full((5, 3), 2.5, 'float32'))
+    x = np.zeros((7, 4), 'float32')
+    got, = run_op('fill_constant_batch_size_like', {'Input': x},
+                  {'shape': [-1, 9], 'value': 1.5, 'dtype': 'float32'})
+    np.testing.assert_allclose(got, np.full((7, 9), 1.5, 'float32'))
+
+
+def test_fill_zeros_like_value():
+    """Mirrors test_fill_zeros_like_op.py."""
+    x = _rng(29).random_sample((9, 3)).astype('float32')
+    got, = run_op('fill_zeros_like', {'X': x}, {})
+    np.testing.assert_allclose(got, np.zeros_like(x))
+
+
+def test_assign_passthrough():
+    """Mirrors test_assign_op.py: identity copy."""
+    x = _rng(30).random_sample((5, 6)).astype('float32')
+    got, = run_op('assign', {'X': x}, {})
+    np.testing.assert_allclose(got, x)
+
+
+def test_assign_value_attr_payload():
+    """Mirrors test_assign_value_op.py: values ride in attrs."""
+    x = _rng(31).random_sample((2, 5)).astype('float32')
+    got, = run_op('assign_value', {},
+                  {'shape': list(x.shape), 'dtype': 'float32',
+                   'fp32_values': [float(v) for v in x.flat]})
+    np.testing.assert_allclose(got, x, rtol=1e-6)
+
+
+def test_is_empty_flag():
+    """Mirrors test_is_empty_op.py."""
+    got, = run_op('is_empty', {'X': np.array([1., 2., 3.], 'float32')},
+                  {})
+    assert not bool(np.ravel(got)[0])
+    got, = run_op('is_empty', {'X': np.zeros((0,), 'float32')}, {})
+    assert bool(np.ravel(got)[0])
+
+
+# =====================================================================
+# Activation-adjacent ops with their own reference test files
+# =====================================================================
+
+def test_prelu_shared_alpha():
+    """Mirrors test_prelu_op.py: scalar Alpha, x>0 -> x else alpha*x."""
+    r = _rng(32)
+    x = r.normal(size=(10, 10)).astype('float32')
+    x = np.sign(x) * np.maximum(np.abs(x), 0.005)
+    alpha = np.array([0.1], 'float32')
+    got, = run_op('prelu', {'X': x, 'Alpha': alpha}, {})
+    ref = np.maximum(x, 0.) + np.minimum(x, 0.) * 0.1
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_maxout_groups():
+    """Mirrors test_maxout_op.py: [N, C, H, W] -> max over ``groups``
+    consecutive channels."""
+    r = _rng(33)
+    x = r.random_sample((4, 6, 2, 2)).astype('float32')
+    got, = run_op('maxout', {'X': x}, {'groups': 2})
+    ref = x.reshape(4, 3, 2, 2, 2).max(axis=2)
+    np.testing.assert_allclose(got, ref)
+
+
+def test_norm_cross_channel():
+    """Mirrors test_norm_op.py: per-position cross-channel l2 norm,
+    channel-wise Scale."""
+    r = _rng(34)
+    x = r.random_sample((2, 3, 2, 2)).astype('float32')
+    scale = np.array([10, 10, 10], 'float32')
+    eps = 1e-6
+    got, = run_op('norm', {'X': x, 'Scale': scale}, {'epsilon': eps})
+    denom = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + eps)
+    ref = scale.reshape(1, 3, 1, 1) * x / denom
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+# =====================================================================
+# Compare / logical ops
+# =====================================================================
+
+def test_compare_ops_table():
+    """Mirrors test_compare_op.py: all six comparisons, int and float."""
+    r = _rng(35)
+    x = r.randint(0, 5, (11, 17)).astype('int32')
+    y = r.randint(0, 5, (11, 17)).astype('int32')
+    for op, fn in [('less_than', np.less),
+                   ('less_equal', np.less_equal),
+                   ('greater_than', np.greater),
+                   ('greater_equal', np.greater_equal),
+                   ('equal', np.equal), ('not_equal', np.not_equal)]:
+        got, = run_op(op, {'X': x, 'Y': y}, {})
+        np.testing.assert_array_equal(np.asarray(got, bool), fn(x, y))
+
+
+def test_logical_ops_table():
+    """Mirrors test_logical_op.py: and/or/xor/not."""
+    r = _rng(36)
+    x = (r.random_sample((7, 9)) > 0.5)
+    y = (r.random_sample((7, 9)) > 0.5)
+    cases = [('logical_and', np.logical_and(x, y), True),
+             ('logical_or', np.logical_or(x, y), True),
+             ('logical_xor', np.logical_xor(x, y), True),
+             ('logical_not', np.logical_not(x), False)]
+    for op, ref, binary in cases:
+        ins = {'X': x.astype('int32')}
+        if binary:
+            ins['Y'] = y.astype('int32')
+        got, = run_op(op, ins, {}, dtypes={'X': 'int32', 'Y': 'int32'})
+        np.testing.assert_array_equal(np.asarray(got, bool), ref)
+
+
+# =====================================================================
+# matmul attribute grid
+# =====================================================================
+
+@pytest.mark.parametrize('case', [
+    dict(x=(4, 5), y=(5, 6), tx=False, ty=False),
+    dict(x=(5, 4), y=(5, 6), tx=True, ty=False),
+    dict(x=(4, 5), y=(6, 5), tx=False, ty=True),
+    dict(x=(2, 4, 5), y=(2, 5, 3), tx=False, ty=False),
+    dict(x=(5,), y=(5,), tx=False, ty=False),
+])
+def test_matmul_transpose_grid(case):
+    """Mirrors test_matmul_op.py's generated shape/transpose grid."""
+    r = _rng(37)
+    x = r.random_sample(case['x']).astype('float32')
+    y = r.random_sample(case['y']).astype('float32')
+    got, = run_op('matmul', {'X': x, 'Y': y},
+                  {'transpose_X': case['tx'], 'transpose_Y': case['ty']})
+    xr = np.swapaxes(x, -1, -2) if case['tx'] else x
+    yr = np.swapaxes(y, -1, -2) if case['ty'] else y
+    ref = np.matmul(xr, yr)
+    np.testing.assert_allclose(np.asarray(got).reshape(ref.shape), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_mean_scalar():
+    """Mirrors test_mean_op.py."""
+    x = _rng(38).random_sample((10, 10)).astype('float32')
+    got, = run_op('mean', {'X': x}, {})
+    np.testing.assert_allclose(np.ravel(got)[0], x.mean(), rtol=1e-5)
+
+
+# =====================================================================
+# Random ops — statistical checks (mirrors the reference's moment
+# assertions in test_gaussian_random_op.py / test_uniform_random_op.py)
+# =====================================================================
+
+def test_gaussian_random_moments():
+    """Mirrors test_gaussian_random_op.py: mean/std within .1 of
+    attrs."""
+    got, = run_op('gaussian_random', {},
+                  {'shape': [1000, 784], 'mean': 0.5, 'std': 1.0,
+                   'dtype': 'float32'})
+    g = np.asarray(got)
+    assert abs(g.mean() - 0.5) < 0.1
+    assert abs(g.std() - 1.0) < 0.1
+
+
+def test_uniform_random_moments():
+    """Mirrors test_uniform_random_op.py: mean of U(-5, 10) ~ 2.5."""
+    got, = run_op('uniform_random', {},
+                  {'shape': [1000, 784], 'min': -5.0, 'max': 10.0,
+                   'dtype': 'float32'})
+    g = np.asarray(got)
+    assert abs(g.mean() - 2.5) < 0.5
+    assert g.min() >= -5.0 and g.max() <= 10.0
+
+
+def test_gaussian_random_batch_size_like_shape():
+    """Mirrors test_gaussian_random_batch_size_like_op.py: leading dim
+    copied from Input."""
+    x = np.zeros((13, 4), 'float32')
+    got, = run_op('gaussian_random_batch_size_like', {'Input': x},
+                  {'shape': [-1, 5], 'mean': 0.0, 'std': 1.0,
+                   'dtype': 'float32'})
+    assert np.asarray(got).shape == (13, 5)
+
+
+def test_uniform_random_batch_size_like_shape():
+    """Mirrors test_uniform_random_batch_size_like_op.py."""
+    x = np.zeros((11, 4), 'float32')
+    got, = run_op('uniform_random_batch_size_like', {'Input': x},
+                  {'shape': [-1, 7], 'min': -1.0, 'max': 1.0,
+                   'dtype': 'float32'})
+    g = np.asarray(got)
+    assert g.shape == (11, 7) and g.min() >= -1.0 and g.max() <= 1.0
+
+
+def test_dropout_test_mode_and_train_rate():
+    """Mirrors test_dropout_op.py: TestDropoutOp4/5 pin is_test to
+    Out = X*(1-p) (downscale-in-infer); training keeps kept values at
+    x (mask 0/1, no upscale) with drop rate ~ dropout_prob."""
+    x = np.ones((64, 64), 'float32')
+    got, = run_op('dropout', {'X': x},
+                  {'dropout_prob': 0.35, 'is_test': True})
+    np.testing.assert_allclose(got, x * (1.0 - 0.35), rtol=1e-6)
+    got, = run_op('dropout', {'X': x},
+                  {'dropout_prob': 0.35, 'is_test': False})
+    g = np.asarray(got)
+    assert set(np.unique(g)).issubset({0.0, 1.0})
+    frac = (g == 0).mean()
+    assert abs(frac - 0.35) < 0.05, frac
+
+
+# =====================================================================
+# Wave 2: sequence ops, RNN units, scatter/roi_pool/auc
+# =====================================================================
+
+def run_op_raw(op_type, inputs, attrs, out_slots=('Out',),
+               extra_outs=(), lod_levels=None, dtypes=None):
+    """Like run_op but returns fetched objects (SequenceTensor kept)."""
+    lod_levels = lod_levels or {}
+    dtypes = dtypes or {}
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        in_vars, feed = {}, {}
+        for slot, val in inputs.items():
+            vals = val if isinstance(val, list) else [(slot.lower(), val)]
+            mk = []
+            for name, v in vals:
+                arr = v.data if isinstance(v, SequenceTensor) else v
+                arr = np.asarray(arr)
+                mk.append(fluid.layers.data(
+                    name=name, shape=list(arr.shape[1:]),
+                    dtype=dtypes.get(slot, str(arr.dtype)),
+                    lod_level=lod_levels.get(slot, 0)))
+                feed[name] = v
+            in_vars[slot] = mk
+        outs = {}
+        block = main.global_block()
+        for i, slot in enumerate(tuple(out_slots) + tuple(extra_outs)):
+            outs[slot] = block.create_var(name='po_%d' % i,
+                                          dtype='float32')
+        block.append_op(type=op_type, inputs=in_vars,
+                        outputs={k: [v] for k, v in outs.items()},
+                        attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main, feed=feed,
+                   fetch_list=[outs[s] for s in out_slots])
+
+
+def _packed(st, n=None):
+    rows = st.to_dense_rows() if isinstance(st, SequenceTensor) \
+        else np.asarray(st)
+    return rows if n is None else rows[:n]
+
+
+def test_sequence_erase_tokens_and_lod():
+    """Mirrors test_sequence_erase_op.py: remove tokens, shrink LoD."""
+    r = _rng(40)
+    ids = r.randint(0, 10, (30, 1)).astype('int32')
+    lens = [9, 4, 11, 6]
+    tokens = [2, 3, 5]
+    st = create_lod_tensor(ids, [lens])
+    out, = run_op_raw('sequence_erase', {'X': st}, {'tokens': tokens},
+                      lod_levels={'X': 1})
+    # numpy oracle: per sequence, drop erased tokens
+    ref_rows, ref_lens, s = [], [], 0
+    for L in lens:
+        seq = ids[s:s + L, 0]
+        kept = seq[~np.isin(seq, tokens)]
+        ref_rows.extend(kept.tolist())
+        ref_lens.append(len(kept))
+        s += L
+    got_rows = _packed(out).ravel().astype(int).tolist()
+    assert got_rows == ref_rows
+    assert [int(v) for v in np.asarray(out.lengths)] == ref_lens
+
+
+def test_sequence_erase_empty_tokens():
+    """Mirrors TestSequenceEraseOpEmpty: no tokens -> identity."""
+    r = _rng(41)
+    ids = r.randint(0, 10, (12, 1)).astype('int32')
+    st = create_lod_tensor(ids, [[5, 7]])
+    out, = run_op_raw('sequence_erase', {'X': st}, {'tokens': []},
+                      lod_levels={'X': 1})
+    np.testing.assert_array_equal(_packed(out).ravel(), ids.ravel())
+
+
+def test_sequence_slice_offsets_lengths():
+    """Mirrors test_sequence_slice_op.py: per-seq [offset, length)."""
+    r = _rng(42)
+    x = r.random_sample((100, 6)).astype('float32')
+    lens = [20, 20, 20, 20, 20]
+    offs = np.array([[1], [2], [3], [4], [5]], 'int64')
+    lengths = np.array([[10], [8], [6], [4], [2]], 'int64')
+    st = create_lod_tensor(x, [lens])
+    out, = run_op_raw('sequence_slice',
+                      {'X': st, 'Offset': offs, 'Length': lengths}, {},
+                      lod_levels={'X': 1})
+    ref, s = [], 0
+    for i, L in enumerate(lens):
+        beg = s + int(offs[i, 0])
+        ref.append(x[beg:beg + int(lengths[i, 0])])
+        s += L
+    ref = np.concatenate(ref, 0)
+    np.testing.assert_allclose(_packed(out), ref, rtol=1e-6)
+    assert [int(v) for v in np.asarray(out.lengths)] == \
+        [int(v) for v in lengths.ravel()]
+
+
+def test_sequence_softmax_per_sequence():
+    """Mirrors test_sequence_softmax_op.py: softmax within each seq."""
+    r = _rng(43)
+    x = r.uniform(0.1, 1, (11, 1)).astype('float32')
+    lens = [4, 1, 3, 3]
+    st = create_lod_tensor(x, [lens])
+    out, = run_op_raw('sequence_softmax', {'X': st}, {},
+                      lod_levels={'X': 1})
+    ref, s = np.zeros_like(x), 0
+    for L in lens:
+        seg = x[s:s + L, 0]
+        e = np.exp(seg - seg.max())
+        ref[s:s + L, 0] = e / e.sum()
+        s += L
+    np.testing.assert_allclose(_packed(out), ref, rtol=1e-5)
+
+
+def test_lod_reset_target_attr_and_input():
+    """Mirrors test_lod_reset_op.py: target_lod attr and Y-input
+    variants re-segment the same rows."""
+    r = _rng(44)
+    x = r.random_sample((10, 20)).astype('float32')
+    st = create_lod_tensor(x, [[3, 2, 5]])
+    out, = run_op_raw('lod_reset', {'X': st}, {'target_lod': [0, 7, 10]},
+                      lod_levels={'X': 1})
+    np.testing.assert_allclose(_packed(out), x, rtol=1e-6)
+    assert [int(v) for v in np.asarray(out.lengths)] == [7, 3]
+
+
+def test_lstm_unit_gate_order_ifoj():
+    """Mirrors test_lstm_unit_op.py: X split as (i, f, o, j);
+    c' = c*sig(f + fb) + sig(i)*tanh(j); h = tanh(c')*sig(o)."""
+    r = _rng(45)
+    x = r.normal(size=(5, 16)).astype('float32')
+    c = r.normal(size=(5, 4)).astype('float32')
+    co, ho = run_op('lstm_unit', {'X': x, 'C_prev': c},
+                    {'forget_bias': 0.5}, out_slots=('C', 'H'))
+    i, f, o, j = np.split(x, 4, axis=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    c_ref = c * sig(f + 0.5) + sig(i) * np.tanh(j)
+    h_ref = np.tanh(c_ref) * sig(o)
+    np.testing.assert_allclose(co, c_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ho, h_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_gru_unit_formula_with_bias():
+    """Mirrors test_gru_unit_op.py (TestGRUUnitOpWithBias): weight is
+    [H, 2H | H]; u/r from first block, candidate from second;
+    h = u*c + (1-u)*h_prev."""
+    r = _rng(46)
+    B, H = 4, 5
+    x = r.uniform(-0.1, 0.1, (B, 3 * H)).astype('float32')
+    hp = r.uniform(-0.1, 0.1, (B, H)).astype('float32')
+    w = r.uniform(-0.4, 0.4, (H, 3 * H)).astype('float32')
+    b = r.uniform(-0.1, 0.1, (1, 3 * H)).astype('float32')
+    hid, = run_op('gru_unit',
+                  {'Input': x, 'HiddenPrev': hp, 'Weight': w, 'Bias': b},
+                  {'activation': 'tanh', 'gate_activation': 'sigmoid'},
+                  out_slots=('Hidden',),
+                  extra_outs=('Gate', 'ResetHiddenPrev'))
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    g = x + b
+    w_ur = w.flatten()[:H * H * 2].reshape(H, 2 * H)
+    ur = sig(hp @ w_ur + g[:, :2 * H])
+    u, rr = ur[:, :H], ur[:, H:]
+    w_c = w.flatten()[H * H * 2:].reshape(H, H)
+    cand = np.tanh((rr * hp) @ w_c + g[:, 2 * H:])
+    h_ref = u * cand + (1 - u) * hp
+    np.testing.assert_allclose(hid, h_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_scatter_overwrite_rows():
+    """Mirrors test_scatter_op.py: rows at Ids replaced by Updates."""
+    ref_np = np.ones((3, 3), 'float32')
+    idx = np.array([1, 2], 'int32')
+    upd = _rng(47).random_sample((2, 3)).astype('float32')
+    got, = run_op('scatter', {'X': ref_np, 'Ids': idx, 'Updates': upd},
+                  {})
+    out = ref_np.copy()
+    out[idx] = upd
+    np.testing.assert_allclose(got, out)
+
+
+def test_cumsum_reverse_exclusive_attrs():
+    """Mirrors test_cumsum_op.py TestSumOp1-3 attr grid."""
+    x = _rng(48).random_sample((5, 6, 10)).astype('float32')
+    got, = run_op('cumsum', {'X': x}, {'axis': 2})
+    np.testing.assert_allclose(got, x.cumsum(2), rtol=1e-5)
+    got, = run_op('cumsum', {'X': x}, {'axis': -1, 'reverse': True})
+    np.testing.assert_allclose(
+        got, np.flip(np.flip(x, 2).cumsum(2), 2), rtol=1e-5)
+    got, = run_op('cumsum', {'X': x},
+                  {'axis': 2, 'exclusive': True})
+    ref = x.cumsum(2) - x
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_roi_pool_reference_arithmetic():
+    """Mirrors test_roi_pool_op.py: rounded roi corners, +1 extents,
+    floor/ceil bin edges, empty bins -> 0."""
+    r = _rng(49)
+    N, C, Hh, Ww = 2, 3, 6, 4
+    x = r.random_sample((N, C, Hh, Ww)).astype('float32')
+    scale = 0.25
+    ph = pw = 2
+    rois = []
+    for _ in range(4):
+        bid = r.randint(0, N)
+        x1 = r.randint(0, Ww // scale - pw)
+        y1 = r.randint(0, Hh // scale - ph)
+        x2 = r.randint(x1 + pw, Ww // scale)
+        y2 = r.randint(y1 + ph, Hh // scale)
+        rois.append([bid, x1, y1, x2, y2])
+    rois = np.array(rois, 'float32')
+    got, = run_op('roi_pool', {'X': x, 'ROIs': rois},
+                  {'pooled_height': ph, 'pooled_width': pw,
+                   'spatial_scale': scale})
+    R = len(rois)
+    ref = np.zeros((R, C, ph, pw), 'float32')
+    for ri in range(R):
+        bid = int(rois[ri, 0])
+        sw = int(round(rois[ri, 1] * scale))
+        sh = int(round(rois[ri, 2] * scale))
+        ew = int(round(rois[ri, 3] * scale))
+        eh = int(round(rois[ri, 4] * scale))
+        rh = max(eh - sh + 1, 1)
+        rw = max(ew - sw + 1, 1)
+        for c in range(C):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(sh + int(np.floor(i * rh / ph)), 0), Hh)
+                    he = min(max(sh + int(np.ceil((i + 1) * rh / ph)),
+                                 0), Hh)
+                    ws = min(max(sw + int(np.floor(j * rw / pw)), 0), Ww)
+                    we = min(max(sw + int(np.ceil((j + 1) * rw / pw)),
+                                 0), Ww)
+                    if he > hs and we > ws:
+                        ref[ri, c, i, j] = x[bid, c, hs:he, ws:we].max()
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)
+
+
+def test_auc_roc_against_numpy():
+    """Mirrors test_auc_op.py: threshold-sweep trapezoidal AUC on
+    class-1 scores (within estimator tolerance of the exact AUC)."""
+    r = _rng(50)
+    pred = r.random_sample((256, 2)).astype('float32')
+    labels = r.randint(0, 2, (256, 1)).astype('int32')
+    got, = run_op('auc', {'Predict': pred, 'Label': labels},
+                  {'curve': 'ROC', 'num_thresholds': 200},
+                  out_slots=('AUC',), dtypes={'Label': 'int32'})
+    # exact AUC by rank statistic
+    s = pred[:, 1]
+    lab = labels.ravel()
+    pos, neg = s[lab == 1], s[lab == 0]
+    exact = (pos[:, None] > neg[None, :]).mean() + \
+        0.5 * (pos[:, None] == neg[None, :]).mean()
+    assert abs(float(np.ravel(got)[0]) - exact) < 0.01
+
+
+# =====================================================================
+# Wave 3: numeric-gradient checks (ref op_test.py get_numeric_gradient
+# / check_grad) for ops beyond the r2 hot-op set. The probed tensor is
+# a parameter wired into the op's ``grad_slot``; loss = mean(out).
+# =====================================================================
+
+from paddle_tpu.executor import global_scope
+
+
+def _op_grad_check(op_type, w_shape, other_inputs, attrs,
+                   grad_slot='X', out_slot='Out', n_probe=5, eps=1e-3,
+                   rtol=6e-2, atol=6e-4, seed=0, w0=None,
+                   extra_out_slots=(), lod_levels=None):
+    """check_grad analog: numeric central difference vs the analytic
+    grad that lowering produces for op ``op_type`` w.r.t. ``grad_slot``."""
+    lod_levels = lod_levels or {}
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter(
+            shape=list(w_shape), dtype='float32', name='probe_w',
+            default_initializer=fluid.initializer.Constant(0.0))
+        in_vars, feed = {grad_slot: [w]}, {}
+        for slot, val in other_inputs.items():
+            arr = val.data if isinstance(val, SequenceTensor) else val
+            arr = np.asarray(arr)
+            v = fluid.layers.data(
+                name=slot.lower(), shape=list(arr.shape[1:]),
+                dtype=str(arr.dtype),
+                lod_level=lod_levels.get(slot, 0))
+            in_vars[slot] = [v]
+            feed[slot.lower()] = val
+        block = main.global_block()
+        outs = {}
+        for i, slot in enumerate((out_slot,) + tuple(extra_out_slots)):
+            outs[slot] = block.create_var(name='pg_%d' % i,
+                                          dtype='float32')
+        block.append_op(type=op_type, inputs=in_vars,
+                        outputs={k: [v] for k, v in outs.items()},
+                        attrs=attrs)
+        loss = fluid.layers.mean(outs[out_slot])
+        fluid.backward.append_backward(loss)
+    rng = np.random.RandomState(seed)
+    if w0 is None:
+        w0 = (rng.rand(*w_shape).astype('float32') - 0.5) * 0.8
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        global_scope().find_var('probe_w').set(w0)
+        analytic, = exe.run(main, feed=feed,
+                            fetch_list=['probe_w@GRAD'])
+        analytic = np.asarray(analytic)
+
+        def loss_at(wv):
+            global_scope().find_var('probe_w').set(wv)
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            return float(np.asarray(out).ravel()[0])
+
+        flat = w0.reshape(-1)
+        idxs = rng.choice(flat.size, size=min(n_probe, flat.size),
+                          replace=False)
+        for i in idxs:
+            wp = flat.copy()
+            wp[i] += eps
+            up = loss_at(wp.reshape(w_shape))
+            wp[i] -= 2 * eps
+            dn = loss_at(wp.reshape(w_shape))
+            num = (up - dn) / (2 * eps)
+            ana = analytic.reshape(-1)[i]
+            assert abs(num - ana) <= atol + rtol * abs(num), \
+                "%s/%s coord %d: numeric %.6f vs analytic %.6f" % (
+                    op_type, grad_slot, i, num, ana)
+
+
+def test_grad_scale():
+    """Mirrors test_scale_op.py check_grad."""
+    _op_grad_check('scale', (6, 7), {}, {'scale': -2.3})
+
+
+def test_grad_clip_interior():
+    """Mirrors test_clip_op.py check_grad (points off the clip knees)."""
+    _op_grad_check('clip', (6, 7), {}, {'min': -10.0, 'max': 10.0})
+
+
+def test_grad_pad():
+    """Mirrors test_pad_op.py check_grad."""
+    _op_grad_check('pad', (4, 5), {},
+                   {'paddings': [1, 2, 0, 3], 'pad_value': 0.3})
+
+
+def test_grad_transpose():
+    """Mirrors test_transpose_op.py check_grad."""
+    _op_grad_check('transpose', (3, 4, 5), {}, {'axis': [2, 0, 1]})
+
+
+def test_grad_reshape():
+    """Mirrors test_reshape_op.py check_grad."""
+    _op_grad_check('reshape', (6, 8), {}, {'shape': [4, 12]})
+
+
+def test_grad_cumsum():
+    """Mirrors test_cumsum_op.py check_grad."""
+    _op_grad_check('cumsum', (5, 9), {}, {'axis': 1})
+
+
+def test_grad_prelu():
+    """Mirrors test_prelu_op.py check_grad (X side, away from 0)."""
+    r = np.random.RandomState(60)
+    w0 = np.sign(r.randn(8, 8)) * (np.abs(r.randn(8, 8)) + 0.1)
+    _op_grad_check('prelu', (8, 8),
+                   {'Alpha': np.array([0.25], 'float32')}, {},
+                   w0=w0.astype('float32'))
+
+
+def test_grad_maxout():
+    """Mirrors test_maxout_op.py check_grad."""
+    _op_grad_check('maxout', (2, 6, 3, 3), {}, {'groups': 2}, seed=3)
+
+
+def test_grad_huber_loss():
+    """Mirrors test_huber_loss_op.py check_grad (X side)."""
+    r = np.random.RandomState(61)
+    y = r.uniform(0, 1, (16, 1)).astype('float32')
+    _op_grad_check('huber_loss', (16, 1), {'Y': y}, {'delta': 0.3},
+                   out_slot='Out', extra_out_slots=('Residual',))
+
+
+def test_grad_log_loss():
+    """Mirrors test_log_loss_op.py check_grad (Predicted side)."""
+    r = np.random.RandomState(62)
+    lab = r.randint(0, 2, (20, 1)).astype('float32')
+    w0 = r.uniform(0.2, 0.8, (20, 1)).astype('float32')
+    _op_grad_check('log_loss', (20, 1), {'Labels': lab},
+                   {'epsilon': 1e-4}, grad_slot='Predicted',
+                   out_slot='Loss', w0=w0)
+
+
+def test_grad_rank_loss():
+    """Mirrors test_rank_loss_op.py check_grad (Left side)."""
+    r = np.random.RandomState(63)
+    lab = r.randint(0, 2, (12, 1)).astype('float32')
+    right = r.random_sample((12, 1)).astype('float32')
+    _op_grad_check('rank_loss', (12, 1),
+                   {'Label': lab, 'Right': right}, {},
+                   grad_slot='Left', out_slot='Out')
+
+
+def test_grad_margin_rank_loss():
+    """Mirrors test_margin_rank_loss_op.py check_grad (X1 side)."""
+    r = np.random.RandomState(64)
+    lab = (2 * r.randint(0, 2, (10, 1)) - 1).astype('float32')
+    x2 = r.random_sample((10, 1)).astype('float32')
+    _op_grad_check('margin_rank_loss', (10, 1),
+                   {'Label': lab, 'X2': x2}, {'margin': 0.1},
+                   grad_slot='X1', out_slot='Out',
+                   extra_out_slots=('Activated',))
+
+
+def test_grad_squared_l2_distance():
+    """Mirrors test_squared_l2_distance_op.py check_grad."""
+    r = np.random.RandomState(65)
+    y = r.uniform(0.1, 0.6, (8, 5)).astype('float32')
+    _op_grad_check('squared_l2_distance', (8, 5), {'Y': y}, {},
+                   extra_out_slots=('sub_result',))
+
+
+def test_grad_matmul_transpose_y():
+    """Mirrors test_matmul_op.py check_grad with transpose_Y."""
+    r = np.random.RandomState(66)
+    y = r.random_sample((6, 4)).astype('float32')
+    _op_grad_check('matmul', (5, 4), {'Y': y},
+                   {'transpose_X': False, 'transpose_Y': True})
+
+
+def test_grad_elementwise_mul_broadcast():
+    """Mirrors test_elementwise_mul_op.py grad with axis broadcast."""
+    r = np.random.RandomState(67)
+    y = r.random_sample((3,)).astype('float32')
+    _op_grad_check('elementwise_mul', (2, 3, 4), {'Y': y}, {'axis': 1})
+
+
+def test_grad_elementwise_div():
+    """Mirrors test_elementwise_div_op.py grad (denominator side)."""
+    r = np.random.RandomState(68)
+    x = r.uniform(0.5, 1.5, (6, 7)).astype('float32')
+    w0 = r.uniform(0.5, 1.5, (6, 7)).astype('float32')
+    _op_grad_check('elementwise_div', (6, 7), {'X': x}, {},
+                   grad_slot='Y', w0=w0)
+
+
+def test_grad_cos_sim():
+    """Mirrors test_cos_sim_op.py check_grad."""
+    r = np.random.RandomState(69)
+    y = r.uniform(0.3, 0.9, (6, 5)).astype('float32')
+    w0 = r.uniform(0.3, 0.9, (6, 5)).astype('float32')
+    _op_grad_check('cos_sim', (6, 5), {'Y': y}, {}, w0=w0,
+                   extra_out_slots=('XNorm', 'YNorm'))
+
+
+def test_grad_expand():
+    """Mirrors test_expand_op.py check_grad."""
+    _op_grad_check('expand', (3, 4), {}, {'expand_times': [2, 3]})
+
+
+def test_grad_crop():
+    """Mirrors test_crop_op.py check_grad."""
+    _op_grad_check('crop', (5, 6), {},
+                   {'offsets': [1, 2], 'shape': [3, 3]})
+
+
+def test_grad_sigmoid_cross_entropy_with_logits():
+    """Mirrors test_sigmoid_cross_entropy_with_logits_op.py grad."""
+    r = np.random.RandomState(70)
+    lab = r.randint(0, 2, (10, 4)).astype('float32')
+    _op_grad_check('sigmoid_cross_entropy_with_logits', (10, 4),
+                   {'Label': lab}, {})
+
+
+def test_grad_smooth_l1():
+    """Mirrors test_smooth_l1_loss_op.py grad (X side)."""
+    r = np.random.RandomState(71)
+    y = r.random_sample((8, 4)).astype('float32')
+    _op_grad_check('smooth_l1_loss', (8, 4), {'Y': y}, {'sigma': 1.0},
+                   out_slot='Out', extra_out_slots=('Diff',))
+
+
+def test_grad_l2_normalize():
+    """Mirrors the reference's l2_normalize decomposition gradient
+    (norm op axis form)."""
+    _op_grad_check('norm', (6, 5), {}, {'axis': 1, 'epsilon': 1e-10},
+                   seed=9)
+def test_grad_reduce_ops():
+    """Mirrors test_reduce_op.py check_grad for sum/mean over dim."""
+    _op_grad_check('reduce_sum', (5, 6), {}, {'dim': [1],
+                                              'keep_dim': False})
+    _op_grad_check('reduce_mean', (5, 6), {}, {'dim': [0],
+                                               'keep_dim': True})
+
+
+# =====================================================================
+# Wave 4: multi-output ops, LoD reshape, edit distance, more grads
+# =====================================================================
+
+def _run_multi_out(op_type, inputs, attrs, out_names, lod_levels=None):
+    """One-op program with a LIST of outputs on slot 'Out'."""
+    lod_levels = lod_levels or {}
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        in_vars, feed = {}, {}
+        for slot, val in inputs.items():
+            arr = val.data if isinstance(val, SequenceTensor) else val
+            arr = np.asarray(arr)
+            v = fluid.layers.data(
+                name=slot.lower(), shape=list(arr.shape[1:]),
+                dtype=str(arr.dtype), lod_level=lod_levels.get(slot, 0))
+            in_vars[slot] = [v]
+            feed[slot.lower()] = val
+        block = main.global_block()
+        outs = [block.create_var(name=n, dtype='float32')
+                for n in out_names]
+        block.append_op(type=op_type, inputs=in_vars,
+                        outputs={'Out': outs}, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main, feed=feed, fetch_list=outs)
+
+
+def test_split_sections():
+    """Mirrors test_split_op.py: sections [2, 1, 2] on axis 1."""
+    x = _rng(80).random_sample((4, 5, 6)).astype('float32')
+    outs = _run_multi_out('split', {'X': x},
+                          {'axis': 1, 'sections': [2, 1, 2]},
+                          ['so0', 'so1', 'so2'])
+    refs = np.split(x, [2, 3], axis=1)
+    for got, ref in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(got), ref)
+
+
+def test_split_equal_num():
+    """Mirrors test_split_op.py equal-part variant (num attr)."""
+    x = _rng(81).random_sample((6, 8)).astype('float32')
+    outs = _run_multi_out('split', {'X': x}, {'axis': 0, 'num': 3},
+                          ['se0', 'se1', 'se2'])
+    refs = np.split(x, 3, axis=0)
+    for got, ref in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(got), ref)
+
+
+def test_sequence_reshape_redistributes_rows():
+    """Mirrors test_sequence_reshape.py: new_dim regroups each
+    sequence's flattened payload; lod scales by width/new_dim."""
+    r = _rng(82)
+    x = r.uniform(0.1, 1, (11, 24)).astype('float32')
+    lens = [4, 1, 3, 3]
+    st = create_lod_tensor(x, [lens])
+    out, = run_op_raw('sequence_reshape', {'X': st}, {'new_dim': 12},
+                      lod_levels={'X': 1})
+    ref_lens = [L * 24 // 12 for L in lens]
+    rows = _packed(out)
+    assert rows.shape == (22, 12)
+    np.testing.assert_allclose(rows.ravel(), x.ravel(), rtol=1e-6)
+    assert [int(v) for v in np.asarray(out.lengths)] == ref_lens
+
+
+def test_edit_distance_reference_fixture():
+    """Mirrors test_edit_distance_op.py: the exact hyp/ref strings and
+    Levenshtein distances, raw and normalized."""
+    hyp = np.array([0, 12, 3, 5, 8, 2], 'int32').reshape(6, 1)
+    ref = np.array([0, 12, 4, 7, 8], 'int32').reshape(5, 1)
+    h = create_lod_tensor(hyp, [[1, 5]])
+    rf = create_lod_tensor(ref, [[3, 1]])
+    got, = run_op('edit_distance', {'Hyps': h, 'Refs': rf},
+                  {'normalized': False},
+                  lod_levels={'Hyps': 1, 'Refs': 1},
+                  extra_outs=('SequenceNum',))
+    # seq0: hyp [0] vs ref [0,12,4] -> 2 deletions... distance 2
+    # seq1: hyp [12,3,5,8,2] vs ref [7] -> 5 (4 del + 1 sub)
+    np.testing.assert_allclose(np.asarray(got).ravel(), [2.0, 5.0])
+    got, = run_op('edit_distance', {'Hyps': h, 'Refs': rf},
+                  {'normalized': True},
+                  lod_levels={'Hyps': 1, 'Refs': 1},
+                  extra_outs=('SequenceNum',))
+    np.testing.assert_allclose(np.asarray(got).ravel(),
+                               [2.0 / 3.0, 5.0], rtol=1e-6)
+
+
+def test_reverse_axis_list():
+    """Mirrors the reverse op semantics (layers/ops reverse)."""
+    x = _rng(83).random_sample((3, 4, 5)).astype('float32')
+    got, = run_op('reverse', {'X': x}, {'axis': [0, 2]})
+    np.testing.assert_allclose(got, x[::-1, :, ::-1])
+
+
+def test_squeeze_unsqueeze_axes():
+    """Mirrors test_squeeze/unsqueeze semantics via axes attr."""
+    x = _rng(84).random_sample((3, 1, 4, 1)).astype('float32')
+    got, = run_op('squeeze', {'X': x}, {'axes': [1, 3]})
+    np.testing.assert_allclose(got, x.reshape(3, 4))
+    y = _rng(85).random_sample((3, 4)).astype('float32')
+    got, = run_op('unsqueeze', {'X': y}, {'axes': [1]})
+    np.testing.assert_allclose(got, y.reshape(3, 1, 4))
+
+
+def test_grad_gather():
+    """Mirrors test_gather_op.py check_grad."""
+    idx = np.array([1, 3, 0, 2], 'int32')
+    _op_grad_check('gather', (5, 4), {'Index': idx}, {})
+
+
+def test_grad_conv2d_transpose():
+    """Mirrors test_conv2d_transpose_op.py check_grad (Input side)."""
+    r = np.random.RandomState(86)
+    w = r.random_sample((3, 2, 3, 3)).astype('float32') * 0.3
+    main_shape = (2, 3, 4, 4)
+    _op_grad_check('conv2d_transpose', main_shape, {'Filter': w},
+                   {'strides': [2, 2], 'paddings': [1, 1],
+                    'dilations': [1, 1]},
+                   grad_slot='Input', out_slot='Output', rtol=8e-2)
+
+
+def test_grad_sequence_softmax():
+    """Mirrors test_sequence_softmax_op.py check_grad: the vjp through
+    per-sequence softmax, probed via a scalar multiplier parameter."""
+    st_lens = [3, 2, 3]
+    r = np.random.RandomState(87)
+    x = r.uniform(0.1, 1, (8, 1)).astype('float32')
+    st = create_lod_tensor(x, [st_lens])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                               lod_level=1)
+        w = fluid.layers.create_parameter(
+            shape=[1], dtype='float32', name='probe_w',
+            default_initializer=fluid.initializer.Constant(1.7))
+        scaled = fluid.layers.elementwise_mul(xv, w)
+        out = fluid.layers.sequence_softmax(input=scaled)
+        sq = fluid.layers.elementwise_mul(out, out)
+        loss = fluid.layers.mean(sq)
+        fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ana, = exe.run(main, feed={'x': st}, fetch_list=['probe_w@GRAD'])
+        ana = float(np.asarray(ana).ravel()[0])
+
+        def loss_at(wv):
+            global_scope().find_var('probe_w').set(
+                np.array([wv], 'float32'))
+            o, = exe.run(main, feed={'x': st}, fetch_list=[loss])
+            return float(np.asarray(o).ravel()[0])
+
+        eps = 1e-3
+        num = (loss_at(1.7 + eps) - loss_at(1.7 - eps)) / (2 * eps)
+    assert abs(num - ana) <= 6e-4 + 6e-2 * abs(num), (num, ana)

@@ -263,9 +263,13 @@ def test_dynamic_gru_matches_numpy():
         h = np.zeros(H, 'float32')
         for t in range(L):
             xg = data[off[i] + t]
-            g = sigmoid(xg[:2 * H] + h @ w[:, :2 * H])
+            # weight layout per ref test_gru_op.py gru_step: flattened
+            # [H, 2H] update/reset chunk then [H, H] candidate chunk
+            w_ur = w.flatten()[:2 * H * H].reshape(H, 2 * H)
+            w_c = w.flatten()[2 * H * H:].reshape(H, H)
+            g = sigmoid(xg[:2 * H] + h @ w_ur)
             u, r = g[:H], g[H:]
-            c = np.tanh(xg[2 * H:] + (r * h) @ w[:, 2 * H:])
+            c = np.tanh(xg[2 * H:] + (r * h) @ w_c)
             h = (1 - u) * h + u * c  # ref: out = prev - u*prev + u*c
             np.testing.assert_allclose(res.data[i, t], h, rtol=1e-4,
                                        atol=1e-5)
