@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from . import framework
 from .framework import Program, Variable, default_main_program
 from .core import places as _places
+from .core import lowering
 from .core.lowering import lower_block, runtime_dtype, RNG_KEY
 from .lod import SequenceTensor
 
@@ -383,7 +384,8 @@ class Executor(object):
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
                tuple(fetch_names), tuple(state_in_names),
-               tuple(state_out_names), guard, profiling)
+               tuple(state_out_names), guard, profiling,
+               lowering.MERGE_SHARED_MULS[0])
         entry = self._cache.get(key)
         if entry is None:
             lower_prog = self._maybe_prune(program, fetch_names)

