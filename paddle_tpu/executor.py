@@ -198,16 +198,26 @@ def _block_has(block, types):
 
 
 def _is_dynamic_program(program):
-    """True when a While sub-block contains beam search: beam topology is
-    data-dependent (reference runs it op-by-op on host), so the program
-    executes EAGERLY — host control flow + concrete values, exactly the
-    reference Executor's model — instead of one jitted XLA computation.
-    Training/static-decode programs keep the jitted whole-block path."""
+    """True when a While sub-block contains beam search AND the program
+    feeds 2-level LoD data (the reference decode's init_ids/init_scores):
+    beam topology is then data-dependent — row counts shrink per step —
+    so the program executes EAGERLY (host control flow + concrete
+    values, exactly the reference Executor's model). A static-beam
+    decode ([B*K] dense rows, no multi-level-LoD feeds) keeps the
+    jitted whole-block path: its While lowers to lax.while_loop."""
+    has_beam_while = False
     for b in program.blocks:
         for op in b.ops:
             sub = op.attrs.get('sub_block')
             if op.type == 'while' and sub is not None and _block_has(
                     sub, ('beam_search',)):
+                has_beam_while = True
+    if not has_beam_while:
+        return False
+    for b in program.blocks:
+        for var in b.vars.values():
+            if getattr(var, 'is_data', False) and \
+                    getattr(var, 'lod_level', 0) >= 2:
                 return True
     return False
 
@@ -340,11 +350,54 @@ class Executor(object):
         pruned = program.prune(targets)
         return pruned
 
+    def _pull_program_readers(self, program, feed):
+        """Program readers (open_recordio_file / random_data_generator
+        + decorator chain): when the program binds a host-side reader
+        and its slot vars are not explicitly fed, pull the next batch
+        and inject it — the TPU-native analogue of the reference's
+        ``read`` op pulling from the ReaderHolder
+        (paddle/fluid/operators/read_op.cc). Raises core.EOFException
+        when the decorated stream is exhausted; EOF is STICKY (further
+        runs keep raising) until ``reader.reset()``."""
+        from .layers.io import ReaderVar
+        readers = [v for v in program.global_block().vars.values()
+                   if isinstance(v, ReaderVar)
+                   and getattr(v, 'source', None) is not None]
+        if not readers:
+            return feed
+        feed = dict(feed)
+        for rv in readers:
+            names = [fv.name for fv in rv.feed_vars]
+            if all(n in feed for n in names):
+                continue
+            from .core import EOFException
+            it = rv.__dict__.get('_live_iter')
+            if it == 'EOF':
+                raise EOFException(
+                    'program reader %s is exhausted; call '
+                    'reader.reset() to restart it' % rv.name)
+            if it is None:
+                from .reader_io import iterate_reader
+                it = rv.__dict__['_live_iter'] = iterate_reader(rv)
+            try:
+                batch = next(it)
+            except StopIteration:
+                rv.__dict__['_live_iter'] = 'EOF'   # sticky, like the
+                # reference ReaderHolder: EOF persists until reset
+                raise EOFException(
+                    'program reader %s is exhausted; call '
+                    'reader.reset() to restart it' % rv.name) from None
+            for n, val in zip(names, batch):
+                feed.setdefault(n, val)
+        return feed
+
     def _prep_lowering(self, program, feed, fetch_list, scope,
                        dynamic=False):
         """Shared lowering preamble (run / cost_analysis /
-        ParallelExecutor): fetch-name normalization, feed preparation,
-        persistable-state name union with the PRNG key."""
+        ParallelExecutor): program-reader batch injection, fetch-name
+        normalization, feed preparation, persistable-state name union
+        with the PRNG key."""
+        feed = self._pull_program_readers(program, feed)
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
         feed = self._prepare_feed(program, feed, dynamic=dynamic)

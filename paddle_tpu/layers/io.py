@@ -31,7 +31,10 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
 class ReaderVar(Variable):
     """A host-side reader bound into the program (TPU-native: the reader
     stays on host; Executor pulls batches and feeds the XLA program)."""
-    pass
+
+    def reset(self):
+        """Parity: reader.reset() — restart the decorated stream."""
+        self.__dict__.pop('_live_iter', None)
 
 
 def _reader_var(helper, feed_vars, source=None):
@@ -70,6 +73,41 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
     return _reader_var(helper, feed_vars,
                        RecordIOSource(filenames, shapes, dtypes, lod_levels,
                                       pass_num))
+
+
+def random_data_generator(low, high, shapes, lod_levels,
+                          for_parallel=True):
+    """Dummy uniform-random reader (parity: reference layers/io.py:362
+    random_data_generator / create_random_data_generator op): test a
+    network without opening real files. float32 only, like the
+    reference."""
+    from ..reader_io import RandomDataSource
+    helper = LayerHelper('random_data_generator')
+    feed_vars = []
+    for i, (shape, lod) in enumerate(zip(shapes, lod_levels)):
+        shape = shape if isinstance(shape, (list, tuple)) else (shape,)
+        feed_vars.append(helper.create_global_variable(
+            name='%s_slot_%d' % (helper.name, i), shape=tuple(shape),
+            dtype='float32', lod_level=lod, is_data=True))
+    return _reader_var(helper, feed_vars,
+                       RandomDataSource(low, high,
+                                        [fv.shape for fv in feed_vars],
+                                        lod_levels))
+
+
+def multi_pass(reader, pass_num):
+    """Re-iterate the underlying source ``pass_num`` times (parity:
+    reference layers/io.py:561 create_multi_pass_reader)."""
+    reader.decorators.append(('multi_pass', pass_num))
+    return reader
+
+
+def parallel(reader):
+    """Threaded prefetch decorator (parity: reference layers/io.py:566
+    create_threaded_reader): a host thread pulls ahead into a bounded
+    queue; sample order is preserved."""
+    reader.decorators.append(('parallel', None))
+    return reader
 
 
 def shuffle(reader, buffer_size):

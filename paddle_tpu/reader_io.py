@@ -82,3 +82,114 @@ class RecordIOSource(object):
                     if native_loader.available() else read_records(fn)
                 for payload in it:
                     yield pickle.loads(payload)
+
+
+class RandomDataSource(object):
+    """Parity: layers/io.py::random_data_generator (reference
+    create_random_data_generator op) — a dummy reader producing
+    float32 uniform samples of the declared shapes, for testing
+    networks without real files."""
+
+    def __init__(self, low, high, shapes, lod_levels, seed=0,
+                 n_samples=None):
+        self.low = float(low)
+        self.high = float(high)
+        self.shapes = [tuple(int(d) for d in s) for s in shapes]
+        self.lod_levels = lod_levels
+        self.seed = seed
+        self.n_samples = n_samples    # None = endless, like the ref op
+
+    def __iter__(self):
+        rng = np.random.RandomState(self.seed)
+        i = 0
+        while self.n_samples is None or i < self.n_samples:
+            yield tuple(rng.uniform(self.low, self.high, s)
+                        .astype('float32') for s in self.shapes)
+            i += 1
+
+
+def iterate_reader(reader_var):
+    """Build the host-side batch iterator for a program reader: the
+    bound source run through its decorator chain (parity: the
+    reference's decorated-reader op stack, layers/io.py:545-570)."""
+    def src():
+        return iter(reader_var.source)
+
+    it_fn = src
+    for kind, arg in reader_var.decorators:
+        prev = it_fn
+        if kind == 'multi_pass':
+            def it_fn(prev=prev, n=arg):
+                for _ in range(int(n)):
+                    for item in prev():
+                        yield item
+        elif kind == 'shuffle':
+            def it_fn(prev=prev, buf=arg):
+                import random
+                pool = []
+                for item in prev():
+                    pool.append(item)
+                    if len(pool) >= buf:
+                        random.shuffle(pool)
+                        while pool:
+                            yield pool.pop()
+                random.shuffle(pool)
+                while pool:
+                    yield pool.pop()
+        elif kind == 'batch':
+            def it_fn(prev=prev, bs=arg):
+                cur = []
+                for item in prev():
+                    cur.append(item)
+                    if len(cur) == bs:
+                        yield tuple(np.stack([s[i] for s in cur])
+                                    for i in range(len(cur[0])))
+                        cur = []
+                if cur:
+                    # ref create_batch_reader_op.cc: the trailing
+                    # PARTIAL batch is yielded, not dropped
+                    yield tuple(np.stack([s[i] for s in cur])
+                                for i in range(len(cur[0])))
+        elif kind in ('parallel', 'double_buffer'):
+            # threaded prefetch (ref create_threaded_reader /
+            # create_double_buffer_reader): a daemon thread pulls
+            # ahead into a bounded queue; order is preserved
+            def it_fn(prev=prev, depth=4 if kind == 'parallel' else 2):
+                import queue
+                import threading
+                q = queue.Queue(maxsize=depth)
+                END = object()
+                stop = threading.Event()
+
+                def offer(item):
+                    # never block forever: an abandoned consumer
+                    # (reader.reset(), early break) sets `stop`
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            return True
+                        except queue.Full:
+                            continue
+                    return False
+
+                def worker():
+                    try:
+                        for item in prev():
+                            if not offer(item):
+                                return
+                    finally:
+                        offer(END)
+
+                t = threading.Thread(target=worker, daemon=True)
+                t.start()
+                try:
+                    while True:
+                        item = q.get()
+                        if item is END:
+                            return
+                        yield item
+                finally:
+                    stop.set()
+        else:  # pragma: no cover - unknown decorators pass through
+            it_fn = prev
+    return it_fn()

@@ -241,3 +241,109 @@ def test_checkpoint_rejects_unknown_backend(tmp_path):
     exe = fluid.Executor(fluid.CPUPlace())
     with pytest.raises(ValueError):
         pio.save_checkpoint(exe, str(tmp_path), backend='Orbax')
+
+
+def test_random_data_generator_reader():
+    """Mirrors reference layers/io.py:362 random_data_generator: a
+    program reader producing uniform float32 batches, pulled
+    automatically by the Executor (read op analogue)."""
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.io.random_data_generator(
+            low=0.0, high=1.0, shapes=[[8, 3], [8, 1]],
+            lod_levels=[0, 0])
+        reader = fluid.layers.io.batch(reader, 4)
+        image, label = fluid.layers.io.read_file(reader)
+        out = fluid.layers.mean(image)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        v, = exe.run(main, fetch_list=[out])
+        assert 0.0 <= float(np.asarray(v).ravel()[0]) <= 1.0
+        # different batch on the next pull
+        v2, = exe.run(main, fetch_list=[out])
+        assert float(np.asarray(v).ravel()[0]) != \
+            float(np.asarray(v2).ravel()[0])
+
+
+def test_multi_pass_reader():
+    """Mirrors reference layers/io.py:561 multi_pass: the source is
+    re-iterated pass_num times before EOF."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.reader_io import RecordIOWriter, iterate_reader
+    import tempfile
+    import os as _os
+    d = tempfile.mkdtemp()
+    path = _os.path.join(d, 'mp.recordio')
+    with RecordIOWriter(path) as w:
+        for i in range(3):
+            w.write_arrays([np.full((2,), i, 'float32')])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.io.open_recordio_file(
+            path, shapes=[[2]], lod_levels=[0], dtypes=['float32'])
+        reader = fluid.layers.io.multi_pass(reader, pass_num=2)
+    vals = [int(b[0][0]) for b in iterate_reader(reader)]
+    assert vals == [0, 1, 2, 0, 1, 2]
+
+
+def test_parallel_threaded_reader():
+    """Mirrors reference layers/io.py:566 parallel
+    (create_threaded_reader): prefetch thread preserves order and
+    delivers every record; Executor EOF signals core.EOFException."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.reader_io import RecordIOWriter, iterate_reader
+    import tempfile
+    import os as _os
+    d = tempfile.mkdtemp()
+    path = _os.path.join(d, 'par.recordio')
+    with RecordIOWriter(path) as w:
+        for i in range(5):
+            w.write_arrays([np.full((1,), i, 'float32')])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.io.open_recordio_file(
+            path, shapes=[[1]], lod_levels=[0], dtypes=['float32'])
+        reader = fluid.layers.io.parallel(reader)
+        x = fluid.layers.io.read_file(reader)
+        out = fluid.layers.scale(x, scale=2.0)
+    vals = [int(b[0][0]) for b in iterate_reader(reader)]
+    assert vals == [0, 1, 2, 3, 4]
+    # through the Executor: 5 pulls then EOFException, reset restarts
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got = [float(np.asarray(exe.run(main, fetch_list=[out])[0])
+                     .ravel()[0]) for _ in range(5)]
+        assert got == [0.0, 2.0, 4.0, 6.0, 8.0]
+        import pytest as _pytest
+        with _pytest.raises(fluid.core.EOFException):
+            exe.run(main, fetch_list=[out])
+        # EOF is sticky until reset (reference ReaderHolder semantics)
+        with _pytest.raises(fluid.core.EOFException):
+            exe.run(main, fetch_list=[out])
+        reader.reset()
+        v, = exe.run(main, fetch_list=[out])
+        assert float(np.asarray(v).ravel()[0]) == 0.0
+
+
+def test_batch_decorator_yields_trailing_partial():
+    """Mirrors reference create_batch_reader_op.cc: the final PARTIAL
+    batch is yielded, not dropped."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.reader_io import RecordIOWriter, iterate_reader
+    import tempfile
+    import os as _os
+    d = tempfile.mkdtemp()
+    path = _os.path.join(d, 'pb.recordio')
+    with RecordIOWriter(path) as w:
+        for i in range(5):
+            w.write_arrays([np.full((3,), i, 'float32')])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.io.open_recordio_file(
+            path, shapes=[[3]], lod_levels=[0], dtypes=['float32'])
+        reader = fluid.layers.io.batch(reader, 2)
+    sizes = [b[0].shape[0] for b in iterate_reader(reader)]
+    assert sizes == [2, 2, 1]

@@ -278,12 +278,20 @@ def test_flash_attention_engages_mosaic_at_bench_shapes():
     XLA fallback) at the shapes bench.py measures."""
     import numpy as np
     from paddle_tpu.ops import pallas_kernels as P
-    for T in (512, 2048, 4096):
+    # engagement starts at _FLASH_MIN_T=768 (r4: strictly above the
+    # measured break-even; T=512 deliberately falls back to XLA)
+    for T in (1024, 2048, 4096):
         q = jnp.asarray(np.random.RandomState(0)
                         .randn(2, T, 4, 64).astype('float32'))
         hlo = jax.jit(lambda q: P.flash_attention(q, q, q)) \
             .lower(q).compile().as_text()
         assert 'tpu_custom_call' in hlo, 'no Mosaic call at T=%d' % T
+    q = jnp.asarray(np.random.RandomState(0)
+                    .randn(2, 512, 4, 64).astype('float32'))
+    hlo = jax.jit(lambda q: P.flash_attention(q, q, q)) \
+        .lower(q).compile().as_text()
+    assert 'tpu_custom_call' not in hlo, \
+        'T=512 must fall back to XLA (below break-even)'
 
 
 def test_flash_attention_layer_scaling():

@@ -275,3 +275,50 @@ def test_highlevel_image_classification_vgg_script(fresh_programs,
     finally:
         sys.meta_path.remove(finder)
         sys.modules.pop('cifar10_small_test_set', None)
+
+
+def test_highlevel_understand_sentiment_dynrnn_script(fresh_programs):
+    """Trainer API, hand-built LSTM inside DynamicRNN with Variable
+    operator overloads; is_sparse embedding; EndStepEvent stop."""
+    mod = _load(
+        'understand_sentiment/test_understand_sentiment_dynamic_rnn.py',
+        REF_HL)
+    mod.main(use_cuda=False)
+
+
+def test_highlevel_understand_sentiment_stacked_lstm_script(
+        fresh_programs):
+    """Trainer API, 3-deep stacked-LSTM sentiment net."""
+    mod = _load(
+        'understand_sentiment/test_understand_sentiment_stacked_lstm.py',
+        REF_HL)
+    mod.main(use_cuda=False)
+
+
+@pytest.mark.skip(reason=(
+    "high-level-api/label_semantic_roles/no_test_label_semantic_roles"
+    ".py is broken UPSTREAM and cannot execute under any framework: "
+    "train_network() calls lstm_net(word, predicate, ...) with names "
+    "that are never defined anywhere in the module (NameError), "
+    "inference_network() references an undefined 'feature_out', and "
+    "the event handler tests fluid.EndIteration which does not exist "
+    "in the reference trainer API (trainer.py defines "
+    "BeginEpochEvent/EndEpochEvent/BeginStepEvent/EndStepEvent) — "
+    "hence its no_test_ prefix. The same db-LSTM CRF pipeline runs "
+    "verbatim via the book no_test_label_semantic_roles predecessor "
+    "(test_label_semantic_roles_script above)"))
+def test_highlevel_no_test_label_semantic_roles_upstream_broken():
+    pass
+
+
+@pytest.mark.skip(reason=(
+    "reference book/test_image_classification.py 'resnet' net and "
+    "high-level-api/image_classification/"
+    "test_image_classification_resnet.py both compute (depth - 2) / 6 "
+    "with py2 integer division and feed it to a range(); under py3 "
+    "lib2to3 cannot fix the semantic change (float), so the VERBATIM "
+    "scripts are unrunnable on python3 — the same architecture runs "
+    "via benchmark/fluid/models.py::resnet and the vgg variants of "
+    "both scripts run above"))
+def test_image_classification_resnet_scripts_py2_division():
+    pass
