@@ -20,7 +20,8 @@ from . import framework
 from .framework import Program, Variable, default_main_program
 from .core import places as _places
 from .core import lowering
-from .core.lowering import lower_block, runtime_dtype, RNG_KEY
+from .core.lowering import (lower_block, runtime_dtype, RNG_KEY,
+                            _op_reads)
 from .lod import SequenceTensor
 
 __all__ = ['Executor', 'global_scope', 'scope_guard', 'switch_scope',
@@ -205,20 +206,37 @@ def _is_dynamic_program(program):
     values, exactly the reference Executor's model). A static-beam
     decode ([B*K] dense rows, no multi-level-LoD feeds) keeps the
     jitted whole-block path: its While lowers to lax.while_loop."""
-    has_beam_while = False
+    beam_whiles = []
     for b in program.blocks:
         for op in b.ops:
             sub = op.attrs.get('sub_block')
             if op.type == 'while' and sub is not None and _block_has(
                     sub, ('beam_search',)):
-                has_beam_while = True
-    if not has_beam_while:
+                beam_whiles.append(op)
+    if not beam_whiles:
         return False
+    # restrict the lod-2 test to vars that actually REACH a beam While
+    # (transitive producers of its inputs): an unrelated nested-sequence
+    # feed elsewhere must not force a 146x-slower eager decode
+    producers = {}
     for b in program.blocks:
-        for var in b.vars.values():
-            if getattr(var, 'is_data', False) and \
+        for op in b.ops:
+            for n in op.output_arg_names:
+                producers.setdefault(n, op)
+    for w_op in beam_whiles:
+        seen, frontier = set(), list(_op_reads(w_op))
+        while frontier:
+            n = frontier.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            var = program.global_block()._find_var_recursive(n)
+            if var is not None and getattr(var, 'is_data', False) and \
                     getattr(var, 'lod_level', 0) >= 2:
                 return True
+            p = producers.get(n)
+            if p is not None and p is not w_op:
+                frontier.extend(p.input_arg_names)
     return False
 
 
@@ -350,54 +368,84 @@ class Executor(object):
         pruned = program.prune(targets)
         return pruned
 
-    def _pull_program_readers(self, program, feed):
+    def _pull_program_readers(self, program, feed, scope=None,
+                              consume=True):
         """Program readers (open_recordio_file / random_data_generator
         + decorator chain): when the program binds a host-side reader
         and its slot vars are not explicitly fed, pull the next batch
         and inject it — the TPU-native analogue of the reference's
         ``read`` op pulling from the ReaderHolder
-        (paddle/fluid/operators/read_op.cc). Raises core.EOFException
-        when the decorated stream is exhausted; EOF is STICKY (further
-        runs keep raising) until ``reader.reset()``."""
+        (paddle/fluid/operators/read_op.cc).
+
+        Stream state (iterator, pending peeked batch, sticky EOF) lives
+        PER SCOPE, like the reference's ReaderHolder — a fresh scope is
+        a fresh stream; ``reader.reset()`` bumps the var's generation
+        so every scope restarts. ``consume=False`` peeks: the batch is
+        stashed and handed to the next consuming run (analysis paths
+        must not drop data). Raises core.EOFException at stream end;
+        EOF is sticky until reset."""
         from .layers.io import ReaderVar
         readers = [v for v in program.global_block().vars.values()
                    if isinstance(v, ReaderVar)
                    and getattr(v, 'source', None) is not None]
         if not readers:
             return feed
+        scope = scope or global_scope()
+        states = scope.__dict__.setdefault('_reader_states', {})
         feed = dict(feed)
         for rv in readers:
             names = [fv.name for fv in rv.feed_vars]
-            if all(n in feed for n in names):
+            fed = [n for n in names if n in feed]
+            if len(fed) == len(names):
                 continue
+            if fed:
+                raise ValueError(
+                    'program reader %s: slots %s were fed but %s were '
+                    'not — feed all of a reader\'s slots or none (a '
+                    'partial feed would pair your data with an '
+                    'unrelated pulled batch)' % (
+                        rv.name, fed,
+                        [n for n in names if n not in feed]))
             from .core import EOFException
-            it = rv.__dict__.get('_live_iter')
-            if it == 'EOF':
+            gen = rv.__dict__.get('_generation', 0)
+            st = states.get(rv.name)
+            if st is None or st['gen'] != gen:
+                from .reader_io import iterate_reader
+                st = states[rv.name] = {
+                    'gen': gen, 'iter': iterate_reader(rv),
+                    'pending': None, 'eof': False}
+            if st['eof']:
                 raise EOFException(
                     'program reader %s is exhausted; call '
                     'reader.reset() to restart it' % rv.name)
-            if it is None:
-                from .reader_io import iterate_reader
-                it = rv.__dict__['_live_iter'] = iterate_reader(rv)
-            try:
-                batch = next(it)
-            except StopIteration:
-                rv.__dict__['_live_iter'] = 'EOF'   # sticky, like the
-                # reference ReaderHolder: EOF persists until reset
-                raise EOFException(
-                    'program reader %s is exhausted; call '
-                    'reader.reset() to restart it' % rv.name) from None
+            if st['pending'] is not None:
+                batch = st['pending']
+                if consume:
+                    st['pending'] = None
+            else:
+                try:
+                    batch = next(st['iter'])
+                except StopIteration:
+                    st['eof'] = True      # sticky, like ReaderHolder
+                    raise EOFException(
+                        'program reader %s is exhausted; call '
+                        'reader.reset() to restart it'
+                        % rv.name) from None
+                if not consume:
+                    st['pending'] = batch
             for n, val in zip(names, batch):
-                feed.setdefault(n, val)
+                feed[n] = val
         return feed
 
     def _prep_lowering(self, program, feed, fetch_list, scope,
-                       dynamic=False):
+                       dynamic=False, consume_readers=True):
         """Shared lowering preamble (run / cost_analysis /
         ParallelExecutor): program-reader batch injection, fetch-name
         normalization, feed preparation, persistable-state name union
-        with the PRNG key."""
-        feed = self._pull_program_readers(program, feed)
+        with the PRNG key. Analysis paths pass consume_readers=False
+        so they PEEK (no training batch is dropped)."""
+        feed = self._pull_program_readers(program, feed, scope,
+                                          consume=consume_readers)
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
         feed = self._prepare_feed(program, feed, dynamic=dynamic)
@@ -492,7 +540,8 @@ class Executor(object):
         analog)."""
         scope = scope or global_scope()
         fetch_names, feed, state_in_names, state_out_names = \
-            self._prep_lowering(program, feed, fetch_list, scope)
+            self._prep_lowering(program, feed, fetch_list, scope,
+                                consume_readers=False)
         lower_prog = self._maybe_prune(program, fetch_names)
         fn = lower_block(lower_prog, lower_prog.global_block(),
                          sorted(feed.keys()), fetch_names,

@@ -33,8 +33,10 @@ class ReaderVar(Variable):
     stays on host; Executor pulls batches and feeds the XLA program)."""
 
     def reset(self):
-        """Parity: reader.reset() — restart the decorated stream."""
-        self.__dict__.pop('_live_iter', None)
+        """Parity: reader.reset() — restart the decorated stream (in
+        every scope: stream state is scope-keyed, generation-checked)."""
+        self.__dict__['_generation'] = \
+            self.__dict__.get('_generation', 0) + 1
 
 
 def _reader_var(helper, feed_vars, source=None):

@@ -79,13 +79,44 @@ def _pool2d(ctx):
     ksize = _pair(ctx.attr('ksize', [2, 2]))
     strides = _pair(ctx.attr('strides', [1, 1]))
     pads = _pair(ctx.attr('paddings', [0, 0]))
+    if ctx.attr('adaptive', False):
+        # ref pooling.h AdaptivePool: out grid = ksize; bin edges
+        # floor(i*H/out) .. ceil((i+1)*H/out)
+        H, W = int(x.shape[2]), int(x.shape[3])
+        oh, ow = ksize
+        rows = []
+        for i in range(oh):
+            cols = []
+            hs, he = (i * H) // oh, -((-(i + 1) * H) // oh)
+            for j in range(ow):
+                ws, we = (j * W) // ow, -((-(j + 1) * W) // ow)
+                win = x[:, :, hs:he, ws:we]
+                cols.append(win.max((2, 3)) if ptype == 'max'
+                            else win.mean((2, 3)))
+            rows.append(jnp.stack(cols, -1))
+        ctx.set_output('Out', jnp.stack(rows, -2))
+        return
     if ctx.attr('global_pooling', False):
         ksize = (x.shape[2], x.shape[3])
         strides = ksize
         pads = (0, 0)
+    # ceil_mode (ref pool_op.cc PoolOutputSize): the output grid uses
+    # ceil division; realized as extra bottom/right padding whose
+    # clipped windows only see in-image values (exclusive counts)
+    extra = (0, 0)
+    if ctx.attr('ceil_mode', False):
+        def _ceil_extra(sz, k, p, s):
+            o = -((-(sz + 2 * p - k)) // s) + 1
+            return max((o - 1) * s + k - (sz + 2 * p), 0)
+        extra = (_ceil_extra(int(x.shape[2]), ksize[0], pads[0],
+                             strides[0]),
+                 _ceil_extra(int(x.shape[3]), ksize[1], pads[1],
+                             strides[1]))
     window = (1, 1) + ksize
     strides4 = (1, 1) + strides
-    padding = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+    padding = [(0, 0), (0, 0),
+               (pads[0], pads[0] + extra[0]),
+               (pads[1], pads[1] + extra[1])]
     if ptype == 'max':
         init = -jnp.inf
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4,
@@ -93,7 +124,8 @@ def _pool2d(ctx):
     else:
         s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4,
                                   padding)
-        if ctx.attr('exclusive', True) and (pads[0] or pads[1]):
+        if ctx.attr('exclusive', True) and (pads[0] or pads[1] or
+                                            extra[0] or extra[1]):
             ones = jnp.ones_like(x)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                         strides4, padding)

@@ -193,7 +193,8 @@ class ParallelExecutor(object):
         program = self._program
         scope = self._scope
         fetch_names, feed, state_in, state_out = \
-            self._exe._prep_lowering(program, feed, fetch_list, scope)
+            self._exe._prep_lowering(program, feed, fetch_list, scope,
+                                     consume_readers=False)
         # NB: lowers the FULL program (no pruning), mirroring
         # ParallelExecutor.run — Executor.cost_analysis models the
         # pruning Executor.run path instead.

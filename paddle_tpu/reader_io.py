@@ -124,19 +124,15 @@ def iterate_reader(reader_var):
                     for item in prev():
                         yield item
         elif kind == 'shuffle':
+            # reuse the canonical decorator (paddle_tpu/reader):
+            # identical stream-of-items contract
+            from .reader import shuffle as _shuffle
             def it_fn(prev=prev, buf=arg):
-                import random
-                pool = []
-                for item in prev():
-                    pool.append(item)
-                    if len(pool) >= buf:
-                        random.shuffle(pool)
-                        while pool:
-                            yield pool.pop()
-                random.shuffle(pool)
-                while pool:
-                    yield pool.pop()
+                return _shuffle(prev, buf)()
         elif kind == 'batch':
+            # NOT reader.batch: program readers STACK samples into
+            # batch arrays (the read op's tensor contract); the python
+            # reader decorator yields lists of samples instead
             def it_fn(prev=prev, bs=arg):
                 cur = []
                 for item in prev():
@@ -177,8 +173,10 @@ def iterate_reader(reader_var):
                         for item in prev():
                             if not offer(item):
                                 return
-                    finally:
-                        offer(END)
+                    except BaseException as e:  # surface, don't EOF
+                        offer(('__reader_error__', e))
+                        return
+                    offer(END)
 
                 t = threading.Thread(target=worker, daemon=True)
                 t.start()
@@ -187,6 +185,9 @@ def iterate_reader(reader_var):
                         item = q.get()
                         if item is END:
                             return
+                        if isinstance(item, tuple) and len(item) == 2 \
+                                and item[0] == '__reader_error__':
+                            raise item[1]
                         yield item
                 finally:
                     stop.set()

@@ -347,3 +347,59 @@ def test_batch_decorator_yields_trailing_partial():
         reader = fluid.layers.io.batch(reader, 2)
     sizes = [b[0].shape[0] for b in iterate_reader(reader)]
     assert sizes == [2, 2, 1]
+
+
+def test_reader_state_is_scope_keyed():
+    """Reference ReaderHolder semantics: stream position lives in the
+    SCOPE — a fresh scope restarts from record 0; reset() restarts in
+    every scope."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.reader_io import RecordIOWriter
+    import tempfile
+    import os as _os
+    d = tempfile.mkdtemp()
+    path = _os.path.join(d, 'sk.recordio')
+    with RecordIOWriter(path) as w:
+        for i in range(3):
+            w.write_arrays([np.full((1,), i, 'float32')])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.io.open_recordio_file(
+            path, shapes=[[1]], lod_levels=[0], dtypes=['float32'])
+        x = fluid.layers.io.read_file(reader)
+        out = fluid.layers.scale(x, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def pull():
+        return float(np.asarray(exe.run(main, fetch_list=[out])[0])
+                     .ravel()[0])
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        assert pull() == 0.0 and pull() == 1.0
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # fresh scope -> fresh stream
+        assert pull() == 0.0
+
+
+def test_parallel_reader_propagates_source_errors():
+    """A failing source must surface through the prefetch thread, not
+    read as a clean EOF."""
+    from paddle_tpu.reader_io import iterate_reader
+
+    class BadSource(object):
+        def __iter__(self):
+            yield (np.zeros((1,), 'float32'),)
+            raise IOError('recordio crc mismatch (synthetic)')
+
+    class RV(object):
+        pass
+
+    rv = RV()
+    rv.source = BadSource()
+    rv.decorators = [('parallel', None)]
+    it = iterate_reader(rv)
+    next(it)
+    import pytest as _pytest
+    with _pytest.raises(IOError):
+        next(it)

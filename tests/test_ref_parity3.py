@@ -1311,3 +1311,183 @@ def test_grad_sequence_softmax():
         eps = 1e-3
         num = (loss_at(1.7 + eps) - loss_at(1.7 - eps)) / (2 * eps)
     assert abs(num - ana) <= 6e-4 + 6e-2 * abs(num), (num, ana)
+
+
+# =====================================================================
+# Wave 5: activation numeric-grad table + remaining attribute grids
+# =====================================================================
+
+@pytest.mark.parametrize('act,dom', [
+    ('tanh', (-2, 2)), ('sigmoid', (-3, 3)), ('exp', (-1, 1)),
+    ('log', (0.2, 2)), ('sqrt', (0.2, 2)), ('square', (-2, 2)),
+    ('softplus', (-2, 2)), ('softsign', (-2, 2)),
+    ('reciprocal', (0.5, 2)), ('abs', (0.3, 2)),
+    ('leaky_relu', (0.2, 2)), ('elu', (0.2, 2)),
+    ('relu6', (0.2, 2)), ('tanh_shrink', (-2, 2)),
+    ('softshrink', (0.8, 2)), ('stanh', (-2, 2)),
+    ('hard_sigmoid', (-0.1, 0.1)), ('logsigmoid', (-2, 2)),
+])
+def test_grad_activation(act, dom):
+    """Mirrors test_activation_op.py check_grad for each activation
+    (domains avoid the non-differentiable corners the reference also
+    steers around)."""
+    import zlib
+    r = np.random.RandomState(zlib.crc32(act.encode()) % 2 ** 31)
+    w0 = r.uniform(dom[0], dom[1], (6, 7)).astype('float32')
+    _op_grad_check(act, (6, 7), {}, {}, w0=w0, rtol=8e-2, atol=8e-4)
+
+
+def test_softmax_rows():
+    """Mirrors test_softmax_op.py: row-wise stable softmax."""
+    x = _rng(90).uniform(0.1, 1, (10, 10)).astype('float32')
+    got, = run_op('softmax', {'X': x}, {})
+    e = np.exp(x - x.max(1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(1, keepdims=True),
+                               rtol=1e-5)
+
+
+def test_grad_softmax():
+    """Mirrors test_softmax_op.py check_grad."""
+    _op_grad_check('softmax', (5, 8), {}, {}, seed=12)
+
+
+def test_lrn_across_channel_formula():
+    """Mirrors test_lrn_op.py: mid = k + alpha * sum_window(x^2);
+    out = x * mid^-beta (window centered, clipped)."""
+    r = _rng(91)
+    N, C, H, W = 2, 6, 3, 3
+    x = r.uniform(0.5, 1.5, (N, C, H, W)).astype('float32')
+    n, k, alpha, beta = 5, 2.0, 0.0001, 0.75
+    got, = run_op('lrn', {'X': x},
+                  {'n': n, 'k': k, 'alpha': alpha, 'beta': beta},
+                  extra_outs=('MidOut',))
+    mid = np.full_like(x, k)
+    start = -(n - 1) // 2
+    for c in range(start, start + n):
+        for i in range(C):
+            ch = i + c
+            if 0 <= ch < C:
+                mid[:, i] += alpha * x[:, ch] ** 2
+    ref = x * mid ** (-beta)
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_reduce_all_and_negative_dim():
+    """Mirrors test_reduce_op.py corner attrs: reduce_all and dim=-1."""
+    x = _rng(92).random_sample((3, 4, 5)).astype('float32')
+    got, = run_op('reduce_sum', {'X': x}, {'reduce_all': True})
+    np.testing.assert_allclose(np.ravel(got)[0], x.sum(), rtol=1e-5)
+    got, = run_op('reduce_max', {'X': x}, {'dim': [-1],
+                                           'keep_dim': True})
+    np.testing.assert_allclose(got, x.max(-1, keepdims=True))
+    got, = run_op('reduce_prod', {'X': x}, {'dim': [1]})
+    np.testing.assert_allclose(got, x.prod(1), rtol=1e-5)
+
+
+def test_pool2d_ceil_mode():
+    """Mirrors test_pool2d_op.py TestCaseCeil*: ceil_mode grows the
+    output grid when (H - k + 2p) % s != 0."""
+    r = _rng(93)
+    x = r.random_sample((2, 3, 8, 8)).astype('float32')
+    for ceil_mode, ho in ((False, 3), (True, 4)):
+        got, = run_op('pool2d', {'X': x},
+                      {'pooling_type': 'max', 'ksize': [3, 3],
+                       'strides': [2, 2], 'paddings': [0, 0],
+                       'ceil_mode': ceil_mode})
+        assert np.asarray(got).shape == (2, 3, ho, ho), \
+            (ceil_mode, np.asarray(got).shape)
+        # windows clip at the boundary; check one corner value
+        g = np.asarray(got)
+        np.testing.assert_allclose(g[0, 0, 0, 0], x[0, 0, :3, :3].max(),
+                                   rtol=1e-6)
+
+
+def test_pool2d_adaptive():
+    """Mirrors the adaptive pooling semantics (output grid fixed,
+    window boundaries floor/ceil-partitioned)."""
+    r = _rng(94)
+    x = r.random_sample((1, 2, 5, 5)).astype('float32')
+    got, = run_op('pool2d', {'X': x},
+                  {'pooling_type': 'avg', 'ksize': [2, 2],
+                   'adaptive': True})
+    g = np.asarray(got)
+    assert g.shape == (1, 2, 2, 2)
+    # bin (0,0) covers rows/cols [0, ceil(5/2)) = [0,3)
+    np.testing.assert_allclose(g[0, 0, 0, 0], x[0, 0, :3, :3].mean(),
+                               rtol=1e-5)
+
+
+def test_sequence_expand_packed_row_repeat():
+    """Mirrors test_sequence_expand.py's packed-rows semantics
+    (operators/sequence_expand_op.h): row i of x repeats by the i-th
+    ref-level size of y's lod. Exercised on the packed/eager
+    representation (the dynamic decode path), where shape-changing
+    expands are legal."""
+    from paddle_tpu.ops.sequence_ops import _sequence_expand
+    from paddle_tpu.lod import SequenceTensor
+
+    class _Ctx(object):
+        def __init__(self, env, attrs):
+            self.env, self.attrs = env, attrs
+
+        def input(self, slot):
+            return self.env[slot]
+
+        def attr(self, name, default=None):
+            return self.attrs.get(name, default)
+
+        def set_output(self, slot, val):
+            self.env[slot] = val
+
+    x = np.array([[1.], [2.], [3.]], 'float32')
+    y = SequenceTensor.from_packed(np.zeros((6, 1), 'float32'),
+                                   [[0, 2, 5, 6]])
+    env = {'X': np.asarray(x), 'Y': y}
+    _sequence_expand(_Ctx(env, {'ref_level': 0}))
+    out = env['Out']
+    # x row 0 repeated 2x, row 1 3x, row 2 1x (y's level-0 sizes)
+    np.testing.assert_allclose(np.asarray(out.data).ravel(),
+                               [1, 1, 2, 2, 2, 3])
+
+
+def test_im2sequence_stride_padding():
+    """Mirrors test_im2sequence_op.py TestBlockExpandOpCase2: kernels
+    [2,1], strides [2,1], paddings [2,1,2,1]."""
+    r = _rng(95)
+    x = r.uniform(0.1, 1, (1, 2, 4, 5)).astype('float32')
+    got, = run_op_raw('im2sequence', {'X': x},
+                      {'kernels': [2, 1], 'strides': [2, 1],
+                       'paddings': [2, 1, 2, 1]})
+    rows = _packed(got)
+    # padded H = 4+4 = 8 -> out_h = (8-2)/2+1 = 4; W = 5+2 = 7 -> 7
+    assert rows.shape == (4 * 7, 2 * 2 * 1)
+    xp = np.zeros((1, 2, 8, 7), 'float32')
+    xp[:, :, 2:6, 1:6] = x
+    # first patch = rows 0:2, col 0 of padded image, both channels
+    np.testing.assert_allclose(rows[0],
+                               xp[0, :, 0:2, 0:1].reshape(-1),
+                               rtol=1e-6)
+
+
+def test_grad_bilinear_interp():
+    """Mirrors test_bilinear_interp_op.py check_grad."""
+    _op_grad_check('bilinear_interp', (2, 2, 4, 4), {},
+                   {'out_h': 7, 'out_w': 7}, seed=13)
+
+
+def test_grad_l1_and_squared_l2_norm():
+    """Mirrors test_l1_norm_op.py / test_squared_l2_norm_op.py grads."""
+    r = np.random.RandomState(96)
+    w0 = np.sign(r.randn(6, 5)) * (np.abs(r.randn(6, 5)) + 0.2)
+    _op_grad_check('l1_norm', (6, 5), {}, {},
+                   w0=w0.astype('float32'))
+    _op_grad_check('squared_l2_norm', (6, 5), {}, {}, seed=14)
+
+
+def test_grad_lrn():
+    """Mirrors test_lrn_op.py check_grad."""
+    r = np.random.RandomState(97)
+    w0 = r.uniform(0.5, 1.5, (2, 4, 3, 3)).astype('float32')
+    _op_grad_check('lrn', (2, 4, 3, 3), {},
+                   {'n': 3, 'k': 1.0, 'alpha': 0.01, 'beta': 0.5},
+                   w0=w0, extra_out_slots=('MidOut',), rtol=8e-2)
