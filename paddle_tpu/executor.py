@@ -222,7 +222,7 @@ def _is_dynamic_program(program):
     for b in program.blocks:
         for op in b.ops:
             for n in op.output_arg_names:
-                producers.setdefault(n, op)
+                producers.setdefault(n, []).append(op)
     for w_op in beam_whiles:
         seen, frontier = set(), list(_op_reads(w_op))
         while frontier:
@@ -234,9 +234,11 @@ def _is_dynamic_program(program):
             if var is not None and getattr(var, 'is_data', False) and \
                     getattr(var, 'lod_level', 0) >= 2:
                 return True
-            p = producers.get(n)
-            if p is not None and p is not w_op:
-                frontier.extend(p.input_arg_names)
+            for p in producers.get(n, ()):
+                if p is not w_op:
+                    # sub-block aware: a producing control-flow op may
+                    # read the lod-2 feed only inside its sub-block
+                    frontier.extend(_op_reads(p))
     return False
 
 
@@ -391,6 +393,9 @@ class Executor(object):
         if not readers:
             return feed
         scope = scope or global_scope()
+        # keyed by the reader OBJECT (auto-generated names can collide
+        # across programs sharing a scope); the entry pins rv so ids
+        # stay unique for the scope's lifetime
         states = scope.__dict__.setdefault('_reader_states', {})
         feed = dict(feed)
         for rv in readers:
@@ -408,11 +413,12 @@ class Executor(object):
                         [n for n in names if n not in feed]))
             from .core import EOFException
             gen = rv.__dict__.get('_generation', 0)
-            st = states.get(rv.name)
+            key = id(rv)
+            st = states.get(key)
             if st is None or st['gen'] != gen:
                 from .reader_io import iterate_reader
-                st = states[rv.name] = {
-                    'gen': gen, 'iter': iterate_reader(rv),
+                st = states[key] = {
+                    'rv': rv, 'gen': gen, 'iter': iterate_reader(rv),
                     'pending': None, 'eof': False}
             if st['eof']:
                 raise EOFException(

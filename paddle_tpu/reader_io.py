@@ -155,6 +155,11 @@ def iterate_reader(reader_var):
                 import threading
                 q = queue.Queue(maxsize=depth)
                 END = object()
+
+                class _Err(object):
+                    def __init__(self, exc):
+                        self.exc = exc
+
                 stop = threading.Event()
 
                 def offer(item):
@@ -174,7 +179,7 @@ def iterate_reader(reader_var):
                             if not offer(item):
                                 return
                     except BaseException as e:  # surface, don't EOF
-                        offer(('__reader_error__', e))
+                        offer(_Err(e))
                         return
                     offer(END)
 
@@ -185,9 +190,8 @@ def iterate_reader(reader_var):
                         item = q.get()
                         if item is END:
                             return
-                        if isinstance(item, tuple) and len(item) == 2 \
-                                and item[0] == '__reader_error__':
-                            raise item[1]
+                        if isinstance(item, _Err):
+                            raise item.exc
                         yield item
                 finally:
                     stop.set()
