@@ -180,26 +180,47 @@ def _bipartite_match(ctx):
 def _target_assign(ctx):
     """out[n, p] = X[n, match[n, p]] (mismatch_value where match < 0).
     Parity: paddle/fluid/operators/target_assign_op.h."""
+    from ..lod import SequenceTensor
     x = unwrap(ctx.input('X'))
     match = unwrap(ctx.input('MatchIndices'))
     mismatch = ctx.attr('mismatch_value', 0)
     if x.ndim == 2:                      # [G, K] shared across batch
         x = jnp.broadcast_to(x[None], (match.shape[0],) + x.shape)
-    idx = jnp.maximum(match, 0)[..., None]
-    out = jnp.take_along_axis(x, jnp.broadcast_to(
-        idx, match.shape + (x.shape[-1],)), axis=1)
+    if x.ndim == 4:
+        # reference target_assign_op.h: X is the LoD-batched gt tensor
+        # ([sum_gt, P, K] grouped per image; padded here to
+        # [N, Gmax, P, K]) and match[i, j] indexes image i's OWN gt
+        # rows — out[i, j] = x[i, match[i, j], j]
+        idx = jnp.maximum(match, 0)[:, None, :, None]
+        out = jnp.take_along_axis(
+            x, jnp.broadcast_to(
+                idx, (x.shape[0], 1) + match.shape[1:] +
+                (x.shape[-1],)), axis=1)[:, 0]
+    else:
+        idx = jnp.maximum(match, 0)[..., None]
+        out = jnp.take_along_axis(x, jnp.broadcast_to(
+            idx, match.shape + (x.shape[-1],)), axis=1)
     matched = (match >= 0)[..., None]
     out = jnp.where(matched, out, jnp.asarray(mismatch, out.dtype))
     weight = matched.astype(jnp.float32)
-    neg = ctx.input('NegIndices')
-    if neg is not None:
-        nidx = unwrap(neg)
+    neg_in = ctx.input('NegIndices')
+    if neg_in is not None:
+        nidx = unwrap(neg_in)
+        if nidx.ndim == 3:
+            nidx = nidx[..., 0]
         valid = nidx >= 0
+        if isinstance(neg_in, SequenceTensor) and \
+                neg_in.lengths is not None:
+            # LoD-fed negatives: padded slots are ZEROS, which would
+            # pass the >=0 test — mask to each image's true length
+            lens = jnp.asarray(neg_in.lengths, jnp.int32)
+            valid &= jnp.arange(nidx.shape[1])[None, :] < lens[:, None]
         scat = jnp.where(valid, nidx, 0)
         negsel = jax.vmap(
             lambda s, v: jnp.zeros((match.shape[1],), bool)
             .at[s].max(v))(scat, valid)
-        weight = jnp.maximum(weight, negsel[..., None].astype(jnp.float32))
+        weight = jnp.maximum(weight,
+                             negsel[..., None].astype(jnp.float32))
     ctx.set_output('Out', out)
     ctx.set_output('OutWeight', weight)
 

@@ -397,8 +397,15 @@ def _nce(ctx):
     w = unwrap(ctx.input('Weight'))
     num_neg = ctx.attr('num_neg_samples', 10)
     num_classes = ctx.attr('num_total_classes', w.shape[0])
-    key = ctx.next_rng()
-    neg = jax.random.randint(key, (num_neg,), 0, num_classes)
+    custom = ctx.attr('custom_neg_classes')
+    if custom:
+        # ref nce_op.cc custom_neg_classes attr: fixed negatives so
+        # unit tests can pin the sampled set
+        neg = jnp.asarray(list(custom), jnp.int32)
+        num_neg = int(neg.shape[0])
+    else:
+        key = ctx.next_rng()
+        neg = jax.random.randint(key, (num_neg,), 0, num_classes)
     b = unwrap(ctx.input('Bias')) if ctx.has_input('Bias') else None
 
     def logit(ids):

@@ -1491,3 +1491,284 @@ def test_grad_lrn():
     _op_grad_check('lrn', (2, 4, 3, 3), {},
                    {'n': 3, 'k': 1.0, 'alpha': 0.01, 'beta': 0.5},
                    w0=w0, extra_out_slots=('MidOut',), rtol=8e-2)
+
+
+# =====================================================================
+# Wave 6: detection fixtures, nce, conv-adjacent grads, ctc_align
+# =====================================================================
+
+def test_target_assign_batched_lod_gather():
+    """Mirrors test_target_assign_op.py: out[i, j] = X[i-th image's
+    gt row match[i, j], prior j]; mismatches filled; weights 1 at
+    matched priors and at listed negatives."""
+    r = _rng(100)
+    N, G, P, K = 3, 4, 6, 4
+    gt_lens = [2, 4, 3]
+    x_rows = r.random_sample((sum(gt_lens), P, K)).astype('float32')
+    st = create_lod_tensor(x_rows, [gt_lens])
+    match = np.full((N, P), -1, 'int32')
+    match[0, 1] = 1
+    match[1, 0] = 3
+    match[1, 4] = 0
+    match[2, 2] = 2
+    neg = np.full((N, 2), -1, 'int32')
+    neg[0, 0] = 5
+    neg[2, 0] = 0
+    neg[2, 1] = 3
+    got, wt = run_op('target_assign',
+                     {'X': st, 'MatchIndices': match,
+                      'NegIndices': neg},
+                     {'mismatch_value': 0.0},
+                     out_slots=('Out', 'OutWeight'),
+                     lod_levels={'X': 1})
+    off = np.concatenate([[0], np.cumsum(gt_lens)])
+    ref = np.zeros((N, P, K), 'float32')
+    refw = np.zeros((N, P, 1), 'float32')
+    for i in range(N):
+        for j in range(P):
+            if match[i, j] >= 0:
+                ref[i, j] = x_rows[off[i] + match[i, j], j]
+                refw[i, j] = 1.0
+        for nn in neg[i]:
+            if nn >= 0:
+                refw[i, nn] = 1.0
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(wt), refw)
+
+
+def test_mine_hard_examples_reference_fixture():
+    """Mirrors test_mine_hard_examples_op.py's exact arrays
+    (max_negative mining, neg_pos_ratio 1, neg_overlap 0.5)."""
+    cls_loss = np.array([[0.1, 0.1, 0.3], [0.3, 0.1, 0.1]], 'float32')
+    loc_loss = np.array([[0.1, 0.2, 0.3], [0.3, 0.4, 0.1]], 'float32')
+    match_dis = np.array([[0.2, 0.4, 0.8], [0.1, 0.9, 0.3]], 'float32')
+    match_idx = np.array([[0, -1, -1], [-1, 0, -1]], 'int32')
+    neg, upd = run_op('mine_hard_examples',
+                      {'ClsLoss': cls_loss, 'LocLoss': loc_loss,
+                       'MatchIndices': match_idx,
+                       'MatchDist': match_dis},
+                      {'neg_pos_ratio': 1.0, 'neg_dist_threshold': 0.5,
+                       'mining_type': 'max_negative'},
+                      out_slots=('NegIndices',
+                                 'UpdatedMatchIndices'))
+    # reference expectation: neg lod [0,1,2] with indices [1], [0]
+    neg = np.asarray(neg)
+    assert list(neg[0][neg[0] >= 0]) == [1]
+    assert list(neg[1][neg[1] >= 0]) == [0]
+    np.testing.assert_array_equal(np.asarray(upd), match_idx)
+
+
+def test_nce_loss_formula():
+    """Mirrors test_nce_op.py: with custom_neg_classes pinned (the
+    reference's own unit-test hook, nce_op.cc), the logistic NCE loss
+    is exactly -log sig(s_pos - log(k*p)) - sum log sig(-(s_neg -
+    log(k*p))) with uniform p = 1/C."""
+    r = _rng(101)
+    B, D, C = 4, 8, 10
+    x = r.random_sample((B, D)).astype('float32')
+    w = r.random_sample((C, D)).astype('float32') * 0.3
+    b = r.random_sample((C,)).astype('float32') * 0.1
+    lab = r.randint(0, C, (B, 1)).astype('int64')
+    negs = [1, 4, 7]
+    got, = run_op('nce',
+                  {'Input': x, 'Weight': w, 'Bias': b, 'Label': lab},
+                  {'num_total_classes': C, 'num_neg_samples': 3,
+                   'custom_neg_classes': negs},
+                  out_slots=('Cost',))
+    g = np.asarray(got)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    k_p = 3 * (1.0 / C)
+    ref = np.zeros((B, 1), 'float32')
+    for i in range(B):
+        s_pos = x[i] @ w[lab[i, 0]] + b[lab[i, 0]]
+        ref[i, 0] = -np.log(sig(s_pos - np.log(k_p)))
+        for n in negs:
+            s_neg = x[i] @ w[n] + b[n]
+            ref[i, 0] += -np.log(sig(-(s_neg - np.log(k_p))))
+    np.testing.assert_allclose(g, ref, rtol=1e-4)
+
+
+def test_ctc_align_merge_repeated_and_blank():
+    """Mirrors test_ctc_align_op semantics: collapse repeats then drop
+    blanks."""
+    ids = np.array([[0, 1, 1, 2, 2, 0, 4, 0, 4]], 'int32').T
+    st = create_lod_tensor(ids, [[9]])
+    out, = run_op_raw('ctc_align', {'Input': st},
+                      {'blank': 0, 'merge_repeated': True},
+                      out_slots=('Output',),
+                      lod_levels={'Input': 1})
+    rows = _packed(out).ravel().astype(int).tolist()
+    assert rows == [1, 2, 4, 4], rows
+
+
+def test_polygon_box_transform_offsets():
+    """Mirrors polygon_box_transform_op.cc: non-zero cells become
+    (index offset +/- value) in image coordinates."""
+    x = np.zeros((1, 8, 2, 2), 'float32')
+    x[0, 0, 0, 1] = 1.0     # first channel, cell (0, 1)
+    got, = run_op('polygon_box_transform', {'Input': x}, {},
+                  out_slots=('Output',))
+    g = np.asarray(got)
+    assert g.shape == (1, 8, 2, 2)
+    # even channels encode col-offset: 4*col - value
+    np.testing.assert_allclose(g[0, 0, 0, 1], 4 * 1 - 1.0)
+    assert g[0, 0, 0, 0] == 0.0
+
+
+def test_grad_sequence_conv():
+    """Mirrors test_seq_conv.py check_grad via a scalar multiplier."""
+    r = np.random.RandomState(102)
+    rows = r.random_sample((8, 4)).astype('float32')
+    st = create_lod_tensor(rows, [[5, 3]])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name='x', shape=[4], dtype='float32',
+                               lod_level=1)
+        w = fluid.layers.create_parameter(
+            shape=[12, 6], dtype='float32', name='probe_w',
+            default_initializer=fluid.initializer.Constant(0.1))
+        block = main.global_block()
+        out = block.create_var(name='sc_out', dtype='float32')
+        block.append_op(type='sequence_conv',
+                        inputs={'X': [xv], 'Filter': [w]},
+                        outputs={'Out': [out]},
+                        attrs={'contextLength': 3, 'contextStart': -1,
+                               'contextStride': 1})
+        loss = fluid.layers.mean(out)
+        fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ana, = exe.run(main, feed={'x': st},
+                       fetch_list=['probe_w@GRAD'])
+        ana = np.asarray(ana)
+        w0 = np.full((12, 6), 0.1, 'float32')
+
+        def loss_at(wv):
+            global_scope().find_var('probe_w').set(wv)
+            o, = exe.run(main, feed={'x': st}, fetch_list=[loss])
+            return float(np.asarray(o).ravel()[0])
+
+        eps = 1e-3
+        rng2 = np.random.RandomState(0)
+        for i in rng2.choice(w0.size, size=4, replace=False):
+            wp = w0.reshape(-1).copy()
+            wp[i] += eps
+            up = loss_at(wp.reshape(12, 6))
+            wp[i] -= 2 * eps
+            dn = loss_at(wp.reshape(12, 6))
+            num = (up - dn) / (2 * eps)
+            assert abs(num - ana.reshape(-1)[i]) <= 6e-4 + 6e-2 * abs(num)
+
+
+def test_grad_conv_shift():
+    """Mirrors test_conv_shift_op.py check_grad (X side)."""
+    r = np.random.RandomState(103)
+    y = r.random_sample((5, 3)).astype('float32')
+    _op_grad_check('conv_shift', (5, 8), {'Y': y}, {})
+
+
+def test_grad_row_conv():
+    """Mirrors test_row_conv_op.py check_grad (Filter side) via a
+    parameter filter."""
+    r = np.random.RandomState(104)
+    rows = r.random_sample((9, 4)).astype('float32')
+    st = create_lod_tensor(rows, [[4, 5]])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name='x', shape=[4], dtype='float32',
+                               lod_level=1)
+        w = fluid.layers.create_parameter(
+            shape=[3, 4], dtype='float32', name='probe_w',
+            default_initializer=fluid.initializer.Constant(0.2))
+        block = main.global_block()
+        out = block.create_var(name='rc_out', dtype='float32')
+        block.append_op(type='row_conv',
+                        inputs={'X': [xv], 'Filter': [w]},
+                        outputs={'Out': [out]}, attrs={})
+        loss = fluid.layers.mean(out)
+        fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ana, = exe.run(main, feed={'x': st},
+                       fetch_list=['probe_w@GRAD'])
+        ana = np.asarray(ana)
+        w0 = np.full((3, 4), 0.2, 'float32')
+
+        def loss_at(wv):
+            global_scope().find_var('probe_w').set(wv)
+            o, = exe.run(main, feed={'x': st}, fetch_list=[loss])
+            return float(np.asarray(o).ravel()[0])
+
+        eps = 1e-3
+        for i in (0, 5, 11):
+            wp = w0.reshape(-1).copy()
+            wp[i] += eps
+            up = loss_at(wp.reshape(3, 4))
+            wp[i] -= 2 * eps
+            dn = loss_at(wp.reshape(3, 4))
+            num = (up - dn) / (2 * eps)
+            assert abs(num - ana.reshape(-1)[i]) <= 6e-4 + 6e-2 * abs(num)
+
+
+def test_grad_multiplex():
+    """Mirrors test_multiplex_op.py check_grad: d(out)/d(candidate k)
+    is the row-selection mask."""
+    r = np.random.RandomState(105)
+    rows = 4
+    idx = np.array([[1], [0], [1], [0]], 'int32')
+    x2 = r.random_sample((rows, 6)).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter(
+            shape=[rows, 6], dtype='float32', name='probe_w',
+            default_initializer=fluid.initializer.Constant(0.0))
+        ids_v = fluid.layers.data(name='ids', shape=[1], dtype='int32')
+        x2_v = fluid.layers.data(name='x2', shape=[6], dtype='float32')
+        block = main.global_block()
+        out = block.create_var(name='mx_out', dtype='float32')
+        block.append_op(type='multiplex',
+                        inputs={'Ids': [ids_v], 'X': [w, x2_v]},
+                        outputs={'Out': [out]}, attrs={})
+        loss = fluid.layers.mean(out)
+        fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        g, = exe.run(main, feed={'ids': idx, 'x2': x2},
+                     fetch_list=['probe_w@GRAD'])
+    g = np.asarray(g)
+    # candidate 0 (the param) is selected for rows 1 and 3 only
+    ref = np.zeros((rows, 6), 'float32')
+    ref[1] = ref[3] = 1.0 / (rows * 6)
+    np.testing.assert_allclose(g, ref, rtol=1e-5)
+
+
+def test_target_assign_lod_fed_negatives():
+    """LoD-fed NegIndices (reference convention, zero-padded in the
+    padded layout) must weight only each image's REAL negatives —
+    padding slots are not prior-0 selections."""
+    r = _rng(106)
+    N, P, K = 2, 5, 4
+    gt_lens = [1, 1]
+    x_rows = r.random_sample((2, P, K)).astype('float32')
+    st = create_lod_tensor(x_rows, [gt_lens])
+    match = np.full((N, P), -1, 'int32')
+    match[0, 2] = 0
+    match[1, 1] = 0
+    # image 0 has ONE negative (prior 3); image 1 has none
+    neg_st = create_lod_tensor(np.array([[3]], 'int32'), [[1, 0]])
+    got, wt = run_op('target_assign',
+                     {'X': st, 'MatchIndices': match,
+                      'NegIndices': neg_st},
+                     {'mismatch_value': 0.0},
+                     out_slots=('Out', 'OutWeight'),
+                     lod_levels={'X': 1, 'NegIndices': 1})
+    wt = np.asarray(wt)[..., 0]
+    ref = np.zeros((N, P), 'float32')
+    ref[0, 2] = ref[1, 1] = 1.0   # matches
+    ref[0, 3] = 1.0               # image 0's single negative
+    np.testing.assert_allclose(wt, ref)
