@@ -1772,3 +1772,142 @@ def test_target_assign_lod_fed_negatives():
     ref[0, 2] = ref[1, 1] = 1.0   # matches
     ref[0, 3] = 1.0               # image 0's single negative
     np.testing.assert_allclose(wt, ref)
+
+
+# =====================================================================
+# Wave 7: control-flow / LoD-structure ops + static RNN
+# =====================================================================
+
+def test_split_and_merge_lod_tensor_roundtrip():
+    """Mirrors test_split_and_merge_lod_tensor_op.py's CONTRACT: the
+    mask decides which branch's computation lands in each output row.
+    (TPU design, SURVEY §2.3: both branches see the full batch and
+    merge_lod_tensor does the row selection — the XLA-friendly
+    formulation of the reference's data-dependent split; the branch
+    results are identical where it matters.)"""
+    x = np.arange(10, dtype='float32').reshape(10, 1)
+    mask = (x[:, 0] >= 5).reshape(10, 1).astype('bool')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name='x', shape=[1], dtype='float32')
+        yv = fluid.layers.data(name='y', shape=[1], dtype='bool')
+        out_true, out_false = fluid.layers.split_lod_tensor(
+            input=xv, mask=yv, level=0)
+        t_proc = fluid.layers.scale(out_true, scale=10.0)
+        f_proc = fluid.layers.scale(out_false, scale=-1.0)
+        merged = fluid.layers.merge_lod_tensor(
+            in_true=t_proc, in_false=f_proc, mask=yv, x=xv, level=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        m, = exe.run(main, feed={'x': x, 'y': mask},
+                     fetch_list=[merged])
+    m = np.asarray(m.data if hasattr(m, 'data') else m)
+    ref = np.where(mask, x * 10.0, -x)
+    np.testing.assert_allclose(m.reshape(ref.shape), ref)
+
+
+def test_lod_rank_table_and_reorder():
+    """Mirrors test_lod_rank_table.py (sort sequences by length desc,
+    stable) + reorder_lod_tensor_by_rank round trip."""
+    rows = np.arange(6, dtype='float32').reshape(6, 1)
+    st = create_lod_tensor(rows, [[1, 3, 2]])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                               lod_level=1)
+        table = fluid.layers.lod_rank_table(xv, level=0)
+        reordered = fluid.layers.reorder_lod_tensor_by_rank(
+            x=xv, rank_table=table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        out, = exe.run(main, feed={'x': st}, fetch_list=[reordered])
+    # seq lengths [1, 3, 2] -> rank order [seq1(3), seq2(2), seq0(1)]
+    got = out.to_dense_rows() if hasattr(out, 'to_dense_rows') else \
+        np.asarray(out)
+    np.testing.assert_allclose(np.ravel(got)[:6],
+                               [1, 2, 3, 4, 5, 0])
+
+
+def test_array_read_write_and_length():
+    """Mirrors test_array_read_write_op.py + test_lod_array_length_op:
+    write/read round trip and array length."""
+    x = np.array([[2.0], [3.0]], 'float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name='x', shape=[1], dtype='float32')
+        i = fluid.layers.fill_constant(shape=[1], dtype='int32',
+                                       value=0)
+        arr = fluid.layers.array_write(xv, i)
+        i2 = fluid.layers.increment(x=i, value=1, in_place=False)
+        fluid.layers.array_write(xv * 2.0, i2, array=arr)
+        ln = fluid.layers.array_length(arr)
+        back = fluid.layers.array_read(arr, i2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        lnv, bv = exe.run(main, feed={'x': x}, fetch_list=[ln, back])
+    assert int(np.ravel(np.asarray(lnv))[0]) == 2
+    np.testing.assert_allclose(np.asarray(bv), x * 2.0)
+
+
+def test_static_rnn_matches_numpy():
+    """Mirrors test_recurrent_op.py's simple case: StaticRNN h_t =
+    sigmoid(x_t W + h_{t-1} U)."""
+    r = _rng(110)
+    T, B, D = 4, 2, 3
+    x = r.uniform(-0.5, 0.5, (T, B, D)).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        # feed is [T, B, D]: StaticRNN steps over the leading dim
+        xv = fluid.layers.data(name='x', shape=[B, D], dtype='float32')
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(xv)
+            h_prev = rnn.memory(shape=[-1, D], batch_ref=xv,
+                                init_value=0.0)
+            w = fluid.layers.fc(xt, size=D, bias_attr=False,
+                                param_attr=fluid.ParamAttr(name='w_x'))
+            u = fluid.layers.fc(h_prev, size=D, bias_attr=False,
+                                param_attr=fluid.ParamAttr(name='w_h'))
+            h = fluid.layers.sigmoid(w + u)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        wx = _rng(111).uniform(-0.5, 0.5, (D, D)).astype('float32')
+        wh = _rng(112).uniform(-0.5, 0.5, (D, D)).astype('float32')
+        global_scope().find_var('w_x').set(wx)
+        global_scope().find_var('w_h').set(wh)
+        got, = exe.run(main, feed={'x': x}, fetch_list=[out])
+    got = np.asarray(got.data if hasattr(got, 'data') else got)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros((B, D))
+    ref = []
+    for t in range(T):
+        h = sig(x[t] @ wx + h @ wh)
+        ref.append(h.copy())
+    ref = np.stack(ref)                      # [T, B, D]
+    np.testing.assert_allclose(got.reshape(ref.shape), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sparse_rows_merge_semantics():
+    """The SelectedRows analogue (SURVEY: split_ids /
+    split_selected_rows map to SparseRows merge): duplicate ids sum and
+    out-of-range rows drop, mirroring selected_rows merge_add."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.optim_ops import _merge_rows
+    rows = jnp.asarray(np.array([[1., 1.], [2., 2.], [4., 4.]],
+                                'float32'))
+    ids = jnp.asarray(np.array([3, 1, 3], 'int32'))
+    agg, sids = _merge_rows(rows, ids, vocab=5)
+    # JAX's default scatter mode DROPS out-of-bounds indices — the
+    # exact semantics the sparse optimizer paths rely on for the
+    # id=vocab sentinel rows
+    got = np.asarray(jnp.zeros((5, 2)).at[sids].add(agg))
+    dense = np.zeros((5, 2), 'float32')
+    dense[3] = [5., 5.]
+    dense[1] = [2., 2.]
+    np.testing.assert_allclose(got, dense)
