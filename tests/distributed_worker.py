@@ -78,6 +78,47 @@ def main():
         losses.append(float(np.ravel(np.asarray(l))[0]))
     print('LOSSES=%s' % json.dumps(losses))
 
+    # ---- tp ACROSS processes: mesh ('tp', 'dp') puts the tp pairs on
+    # different processes, so the activation psum rides the gloo
+    # cross-process transport (the multi-host ICI/DCN analogue)
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel.mesh import set_mesh
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2), ('tp', 'dp'))
+    set_mesh(mesh)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    main2.random_seed = startup2.random_seed = 5
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(
+            x, size=16, act='relu',
+            param_attr=fluid.ParamAttr(name='tp_w1',
+                                       sharding=(None, 'tp')))
+        pred = fluid.layers.fc(
+            h, size=1,
+            param_attr=fluid.ParamAttr(name='tp_w2',
+                                       sharding=('tp', None)))
+        loss2 = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss2)
+    # with ('tp', 'dp') every process's devices span BOTH dp shards, so
+    # each process feeds the FULL batch (replicated over tp); the dp
+    # split happens inside make_array_from_process_local_data
+    full_feed = {'x': xs, 'y': ys}
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        pexe2 = fluid.ParallelExecutor(use_cuda=False,
+                                       loss_name=loss2.name,
+                                       main_program=main2, mesh=mesh)
+        tp_losses = []
+        for _ in range(3):
+            l, = pexe2.run(fetch_list=[loss2], feed=full_feed)
+            tp_losses.append(float(np.ravel(np.asarray(l))[0]))
+    set_mesh(None)
+    print('TP_LOSSES=%s' % json.dumps(tp_losses))
+
 
 if __name__ == '__main__':
     main()

@@ -34,7 +34,9 @@ def _free_port():
     return port
 
 
-def _single_process_oracle():
+def _oracle(make_opt, n_steps):
+    """Single-process full-batch oracle matching the worker's model
+    (same seed/graph; the worker file builds the same net)."""
     main_p, startup = fluid.Program(), fluid.Program()
     main_p.random_seed = startup.random_seed = 5
     with fluid.program_guard(main_p, startup):
@@ -44,7 +46,7 @@ def _single_process_oracle():
         pred = fluid.layers.fc(h, size=1)
         loss = fluid.layers.mean(
             fluid.layers.square_error_cost(pred, y))
-        fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+        make_opt().minimize(loss)
     rng = np.random.RandomState(0)
     xs = rng.randn(8, 6).astype('float32')
     ys = (xs.sum(1, keepdims=True) * 0.3).astype('float32')
@@ -53,7 +55,11 @@ def _single_process_oracle():
         exe.run(startup)
         return [float(np.ravel(np.asarray(exe.run(
             main_p, feed={'x': xs, 'y': ys}, fetch_list=[loss])[0]))[0])
-            for _ in range(4)]
+            for _ in range(n_steps)]
+
+
+def _single_process_oracle():
+    return _oracle(lambda: fluid.optimizer.Adam(learning_rate=0.1), 4)
 
 
 def test_two_process_jax_distributed_matches_single_process():
@@ -94,3 +100,24 @@ def test_two_process_jax_distributed_matches_single_process():
                                atol=1e-6)
     # training actually progressed
     assert per_worker[0][-1] < per_worker[0][0]
+
+    # tp-ACROSS-processes leg: activation psum over the cross-process
+    # tp axis must reproduce single-process math exactly
+    tp_per_worker = []
+    for out in outs:
+        line = [l for l in out.splitlines()
+                if l.startswith('TP_LOSSES=')]
+        assert line, out
+        tp_per_worker.append(json.loads(line[0][len('TP_LOSSES='):]))
+    np.testing.assert_allclose(tp_per_worker[0], tp_per_worker[1],
+                               rtol=1e-6)
+    tp_oracle = _tp_oracle()
+    np.testing.assert_allclose(tp_per_worker[0], tp_oracle, rtol=1e-4,
+                               atol=1e-6)
+    assert tp_per_worker[0][-1] < tp_per_worker[0][0]
+
+
+def _tp_oracle():
+    """Oracle for the tp-across-processes leg: same graph, SGD. (tp
+    param names/sharding don't change the math — params init by seed.)"""
+    return _oracle(lambda: fluid.optimizer.SGD(learning_rate=0.1), 3)
