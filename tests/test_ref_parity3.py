@@ -1911,3 +1911,90 @@ def test_sparse_rows_merge_semantics():
     dense[3] = [5., 5.]
     dense[1] = [2., 2.]
     np.testing.assert_allclose(got, dense)
+
+
+# =====================================================================
+# Wave 8: remaining corners
+# =====================================================================
+
+def test_softmax_with_cross_entropy_soft_label():
+    """Mirrors test_softmax_with_cross_entropy_op.py soft-label case:
+    loss = -sum(label * log softmax(x))."""
+    r = _rng(120)
+    x = r.uniform(0.1, 1, (6, 5)).astype('float32')
+    lab = r.random_sample((6, 5)).astype('float32')
+    lab /= lab.sum(1, keepdims=True)
+    got, = run_op('softmax_with_cross_entropy',
+                  {'Logits': x, 'Label': lab}, {'soft_label': True},
+                  out_slots=('Loss',), extra_outs=('Softmax',))
+    e = np.exp(x - x.max(1, keepdims=True))
+    logp = np.log(e / e.sum(1, keepdims=True))
+    ref = -(lab * logp).sum(1, keepdims=True)
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_grad_elementwise_max_min():
+    """Mirrors test_elementwise_max/min_op.py grads (ties avoided)."""
+    r = np.random.RandomState(121)
+    y = r.uniform(0.4, 0.6, (6, 7)).astype('float32')
+    w0 = np.where(r.rand(6, 7) > 0.5, 0.8, 0.2).astype('float32')
+    _op_grad_check('elementwise_max', (6, 7), {'Y': y}, {}, w0=w0)
+    _op_grad_check('elementwise_min', (6, 7), {'Y': y}, {}, w0=w0)
+
+
+def test_one_hot_depth():
+    """Mirrors test_one_hot_op.py: depth attr, int64 ids."""
+    ids = np.array([[1], [0], [3]], 'int64')
+    got, = run_op('one_hot', {'X': ids}, {'depth': 4})
+    ref = np.zeros((3, 4), 'float32')
+    ref[0, 1] = ref[1, 0] = ref[2, 3] = 1
+    np.testing.assert_allclose(np.asarray(got).reshape(3, 4), ref)
+
+
+def test_conv2d_transpose_with_dilation():
+    """Mirrors test_conv2d_transpose_op.py TestWithDilation."""
+    r = _rng(122)
+    x = r.random_sample((2, 3, 5, 5)).astype('float32')
+    w = r.random_sample((3, 4, 3, 3)).astype('float32')
+    s, p, d = (1, 1), (1, 1), (2, 2)
+    got, = run_op('conv2d_transpose', {'Input': x, 'Filter': w},
+                  {'strides': list(s), 'paddings': list(p),
+                   'dilations': list(d)}, out_slots=('Output',))
+    got = np.asarray(got)
+    N, Ci, H, W = x.shape
+    _, Co, kh, kw = w.shape
+    Ho = (H - 1) * s[0] - 2 * p[0] + d[0] * (kh - 1) + 1
+    Wo = (W - 1) * s[1] - 2 * p[1] + d[1] * (kw - 1) + 1
+    full = np.zeros((N, Co, Ho + 2 * p[0], Wo + 2 * p[1]), np.float64)
+    for n in range(N):
+        for i in range(H):
+            for j in range(W):
+                patch = np.tensordot(x[n, :, i, j], w, axes=(0, 0))
+                full[n, :, i * s[0]:i * s[0] + d[0] * (kh - 1) + 1:d[0],
+                     j * s[1]:j * s[1] + d[1] * (kw - 1) + 1:d[1]] += \
+                    patch
+    ref = full[:, :, p[0]:p[0] + Ho, p[1]:p[1] + Wo].astype('float32')
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_gaussian_random_seed_determinism():
+    """Mirrors test_gaussian_random_op seed attr: same seed -> same
+    draw, different seeds differ."""
+    a1, = run_op('gaussian_random', {},
+                 {'shape': [4, 5], 'mean': 0.0, 'std': 1.0,
+                  'seed': 7, 'dtype': 'float32'})
+    a2, = run_op('gaussian_random', {},
+                 {'shape': [4, 5], 'mean': 0.0, 'std': 1.0,
+                  'seed': 7, 'dtype': 'float32'})
+    b, = run_op('gaussian_random', {},
+                {'shape': [4, 5], 'mean': 0.0, 'std': 1.0,
+                 'seed': 8, 'dtype': 'float32'})
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(a1), np.asarray(b))
+
+
+def test_increment_op():
+    """Mirrors test_increment usage: in-place-style step counter."""
+    got, = run_op('increment', {'X': np.array([3.0], 'float32')},
+                  {'step': 2.0})
+    np.testing.assert_allclose(np.asarray(got), [5.0])
