@@ -1935,7 +1935,7 @@ def test_softmax_with_cross_entropy_soft_label():
 
 def test_grad_elementwise_max_min():
     """Mirrors test_elementwise_max/min_op.py grads (ties avoided)."""
-    r = np.random.RandomState(121)
+    r = _rng(121)
     y = r.uniform(0.4, 0.6, (6, 7)).astype('float32')
     w0 = np.where(r.rand(6, 7) > 0.5, 0.8, 0.2).astype('float32')
     _op_grad_check('elementwise_max', (6, 7), {'Y': y}, {}, w0=w0)
@@ -1946,9 +1946,11 @@ def test_one_hot_depth():
     """Mirrors test_one_hot_op.py: depth attr, int64 ids."""
     ids = np.array([[1], [0], [3]], 'int64')
     got, = run_op('one_hot', {'X': ids}, {'depth': 4})
+    got = np.asarray(got)
+    assert got.shape == (3, 4), got.shape
     ref = np.zeros((3, 4), 'float32')
     ref[0, 1] = ref[1, 0] = ref[2, 3] = 1
-    np.testing.assert_allclose(np.asarray(got).reshape(3, 4), ref)
+    np.testing.assert_allclose(got, ref)
 
 
 def test_conv2d_transpose_with_dilation():
