@@ -438,6 +438,7 @@ def test_accuracy_top1():
     np.testing.assert_allclose(np.asarray(got), [2.0 / 3.0], rtol=1e-6)
 
 
+# (mirrors test_pool_max_op.py)
 def test_max_pool2d_with_index_mask_always_in_image():
     """ADVICE r1: argmax must never address padding — every Mask entry
     is a real pixel and Out == x[mask] even when data ties with the

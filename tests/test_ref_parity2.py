@@ -442,6 +442,7 @@ def test_sequence_expand_ref_level_0():
     np.testing.assert_allclose(got.reshape(-1, 2)[:5], exp, rtol=1e-6)
 
 
+# (mirrors test_seq_concat_op.py)
 def test_sequence_concat_ragged():
     a = create_lod_tensor(np.arange(6, dtype='float32').reshape(3, 2),
                           [[2, 1]])

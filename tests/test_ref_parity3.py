@@ -2000,3 +2000,115 @@ def test_increment_op():
     got, = run_op('increment', {'X': np.array([3.0], 'float32')},
                   {'step': 2.0})
     np.testing.assert_allclose(np.asarray(got), [5.0])
+
+
+# =====================================================================
+# Wave 9: last unmirrored reference op-test files, named explicitly
+# =====================================================================
+
+def test_beam_search_decode_packallsteps():
+    """Mirrors test_beam_search_decode_op.py: per-step (ids, scores)
+    arrays backtrack via parents into per-beam token sequences."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype='int32',
+                                       value=0)
+        # 1 batch x 2 beams: step0 root token 1; step1 picks tokens
+        # (5 from beam0, 7 from beam0) -> parents (0, 0)
+        ids0 = fluid.layers.assign(np.array([[1], [1]], 'int64'))
+        sc0 = fluid.layers.assign(np.array([[0.], [0.]], 'float32'))
+        ids_arr = fluid.layers.array_write(ids0, i)
+        sc_arr = fluid.layers.array_write(sc0, i)
+        par_arr = fluid.layers.array_write(
+            fluid.layers.assign(np.array([[0], [1]], 'int32')), i)
+        i1 = fluid.layers.increment(x=i, value=1, in_place=False)
+        fluid.layers.array_write(
+            fluid.layers.assign(np.array([[5], [7]], 'int64')), i1,
+            array=ids_arr)
+        fluid.layers.array_write(
+            fluid.layers.assign(np.array([[-0.1], [-0.2]], 'float32')),
+            i1, array=sc_arr)
+        fluid.layers.array_write(
+            fluid.layers.assign(np.array([[0], [0]], 'int32')), i1,
+            array=par_arr)
+        sent_ids, sent_sc = fluid.layers.beam_search_decode(
+            ids_arr, sc_arr, parents=par_arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        out_ids, out_sc = exe.run(main,
+                                  fetch_list=[sent_ids, sent_sc])
+    toks = np.asarray(out_ids.data)
+    # both final beams backtrack through beam 0's root: [1, 5], [1, 7]
+    assert toks.shape[0] == 2
+    assert list(toks[0].ravel()[:2]) == [1, 5]
+    assert list(toks[1].ravel()[:2]) == [1, 7]
+
+
+def test_get_places_device_list():
+    """Mirrors test_get_places_op.py: returns the visible device list
+    (documented host-side shim, layers/device.py)."""
+    places = fluid.layers.get_places(device_count=2)
+    assert len(places) == 2
+
+
+def test_shrink_rnn_memory_identity_contract():
+    """Mirrors test_rnn_memory_helper_op.py / shrink_rnn_memory: the
+    masked-scan design keeps the full batch, so shrink is the identity
+    (sorted-by-length shrinking is subsumed by the per-step mask)."""
+    x = _rng(130).random_sample((4, 3)).astype('float32')
+    got, = run_op('shrink_rnn_memory', {'X': x}, {})
+    np.testing.assert_allclose(np.asarray(got), x)
+
+
+def test_lookup_sparse_table_maps_to_sparse_rows():
+    """Mirrors test_lookup_sparse_table_op.py BY DESIGN MAPPING: the
+    reference's auto-growing sparse table is served by the dense table
+    + SparseRows row-gradient path (is_sparse=True). This test drives
+    the full TRAIN step so the sparse carrier machinery actually runs:
+    only looked-up rows may change under SGD."""
+    from paddle_tpu.layers.nn import set_sparse_fallback_threshold
+    prev = set_sparse_fallback_threshold(0)
+    try:
+        r = _rng(131)
+        table = r.random_sample((50, 8)).astype('float32')
+        ids = np.array([[3], [49], [0]], 'int64')
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            iv = fluid.layers.data(name='ids', shape=[1],
+                                   dtype='int64')
+            emb = fluid.layers.embedding(
+                input=iv, size=[50, 8], is_sparse=True,
+                param_attr=fluid.ParamAttr(name='sparse_tbl'))
+            loss = fluid.layers.mean(emb)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        ops = [op for op in main.global_block().ops
+               if op.type == 'lookup_table']
+        assert 'sparse_carrier' in ops[0].attrs   # SparseRows engaged
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            global_scope().find_var('sparse_tbl').set(table)
+            out, = exe.run(main, feed={'ids': ids}, fetch_list=[emb])
+            np.testing.assert_allclose(np.asarray(out),
+                                       table[[3, 49, 0]], rtol=1e-6)
+            new_tbl = np.asarray(
+                global_scope().raw('sparse_tbl'))
+        touched = sorted({3, 49, 0})
+        untouched = [i for i in range(50) if i not in touched]
+        # only looked-up rows moved (touched-row SGD update)
+        np.testing.assert_allclose(new_tbl[untouched],
+                                   table[untouched], rtol=1e-6)
+        assert not np.allclose(new_tbl[touched], table[touched])
+    finally:
+        set_sparse_fallback_threshold(prev)
+
+
+def test_elementwise_gradient_matrix():
+    """Mirrors test_elementwise_gradient_op.py: grad of add/mul wrt
+    BOTH operands at matrix shapes."""
+    r = _rng(132)
+    y = r.uniform(0.5, 1.5, (4, 6)).astype('float32')
+    for op in ('elementwise_add', 'elementwise_mul'):
+        _op_grad_check(op, (4, 6), {'Y': y}, {}, grad_slot='X')
+        x = r.uniform(0.5, 1.5, (4, 6)).astype('float32')
+        _op_grad_check(op, (4, 6), {'X': x}, {}, grad_slot='Y')
