@@ -9,7 +9,7 @@ import copy
 from .framework import default_main_program, default_startup_program, \
     Variable, convert_np_dtype
 from . import unique_name
-from .param_attr import ParamAttr
+from .param_attr import ParamAttr, WeightNormParamAttr
 from .initializer import Constant, Xavier
 
 __all__ = ['LayerHelper']
@@ -102,6 +102,11 @@ class LayerHelper(object):
         if attr.name is None:
             attr.name = unique_name.generate(".".join([self.name, 'w']))
 
+        if isinstance(attr, WeightNormParamAttr):
+            param = self._create_weight_normalize(attr, shape, dtype)
+            WeightNormParamAttr.params_with_weight_norm.append(param)
+            return param
+
         startup_block = self.startup_program.global_block()
         sv = startup_block.create_var(
             name=attr.name, shape=[int(s) for s in shape],
@@ -112,6 +117,110 @@ class LayerHelper(object):
         return main_block.create_parameter(
             shape=[int(s) for s in shape], dtype=convert_np_dtype(dtype),
             **attr.to_kwargs())
+
+    def _weight_norm_tmp(self, block, tag, shape, dtype):
+        return block.create_var(
+            name=unique_name.generate(
+                ".".join([self.name, 'weight_norm_' + tag])),
+            dtype=dtype, shape=shape)
+
+    def _append_norm_except_dim(self, block, x, x_shape, dim, out, dtype):
+        """Append ops computing the L2 norm of ``x`` over every axis except
+        ``dim`` (all axes when dim is None), keep_dim so the result has g's
+        shape [1,..,x_shape[dim],..,1]. The reference chains
+        abs->pow->reduce_sum->pow per-axis with reshape/transpose gymnastics
+        (layer_helper.py:113-226); a multi-axis keepdims reduce is one XLA
+        fusion, so square->reduce_sum->sqrt is used instead.
+        """
+        ndim = len(x_shape)
+        g_shape = [1] * ndim
+        if dim is not None:
+            g_shape[dim] = int(x_shape[dim])
+
+        def _tmp(tag, shape):
+            return self._weight_norm_tmp(block, tag, shape, dtype)
+
+        sq = _tmp('sq', list(x_shape))
+        block.append_op(type='square', inputs={'X': [x]},
+                        outputs={'Out': [sq]})
+        ssum = _tmp('sum', g_shape)
+        reduce_dims = None if dim is None else \
+            [i for i in range(ndim) if i != dim]
+        block.append_op(
+            type='reduce_sum', inputs={'X': [sq]}, outputs={'Out': [ssum]},
+            attrs={'dim': reduce_dims, 'keep_dim': True,
+                   'reduce_all': dim is None})
+        if out is None:
+            out = _tmp('norm', g_shape)
+        block.append_op(type='sqrt', inputs={'X': [ssum]},
+                        outputs={'Out': [out]})
+        return out
+
+    def _create_weight_normalize(self, attr, shape, dtype):
+        """Weight normalization (Salimans & Kingma, arXiv:1602.07868):
+        w = g * v / ||v||, the norm taken over every axis except ``dim``.
+
+        Parity: python/paddle/fluid/layer_helper.py:108-309
+        (_create_weight_normalize), tested by
+        tests/unittests/test_weight_normalization.py. Direction ``v`` keeps
+        the user's initializer; magnitude ``g`` is initialized to ||v|| in
+        the startup program (ops appended after v's init op) so w's initial
+        distribution matches initializing w directly. Both g and v are
+        trainable Parameters; the recomposition runs in the main program so
+        gradients flow to g and v through the fused value_and_grad path.
+        """
+        dtype = convert_np_dtype(dtype)
+        shape = [int(s) for s in shape]
+        ndim = len(shape)
+        dim = attr.dim
+        if dim is not None:
+            if not (-ndim <= dim < ndim):
+                raise ValueError(
+                    "WeightNormParamAttr.dim=%s out of range for a %d-D "
+                    "parameter" % (dim, ndim))
+            if dim < 0:
+                dim += ndim
+        g_shape = [1] * ndim
+        if dim is not None:
+            g_shape[dim] = shape[dim]
+
+        g_attr = copy.deepcopy(attr)
+        g_attr.name = attr.name + '_g'
+        v_attr = copy.deepcopy(attr)
+        v_attr.name = attr.name + '_v'
+
+        # Startup: init v with the user's initializer, then g = ||v||.
+        startup_block = self.startup_program.global_block()
+        sv = startup_block.create_var(
+            name=v_attr.name, shape=shape, dtype=dtype, persistable=True)
+        attr.initializer(sv, startup_block)
+        sg = startup_block.create_var(
+            name=g_attr.name, shape=g_shape, dtype=dtype, persistable=True)
+        self._append_norm_except_dim(startup_block, sv, shape, dim, sg,
+                                     dtype)
+
+        # Main program: parameters g, v and the recomposition w.
+        main_block = self.main_program.global_block()
+        g_param = main_block.create_parameter(
+            shape=g_shape, dtype=dtype, **g_attr.to_kwargs())
+        v_param = main_block.create_parameter(
+            shape=shape, dtype=dtype, **v_attr.to_kwargs())
+
+        block = self.main_program.current_block()
+        norm = self._append_norm_except_dim(block, v_param, shape, dim,
+                                            None, dtype)
+        # scale has v's rank with keepdims singleton axes, so a plain
+        # same-rank broadcast multiply recomposes w (no reshape needed,
+        # unlike the reference's subset-broadcast workaround)
+        scale = self._weight_norm_tmp(block, 'scale', g_shape, dtype)
+        block.append_op(
+            type='elementwise_div', inputs={'X': [g_param], 'Y': [norm]},
+            outputs={'Out': [scale]}, attrs={'axis': -1})
+        w_param = self._weight_norm_tmp(block, 'w', shape, dtype)
+        block.append_op(
+            type='elementwise_mul', inputs={'X': [v_param], 'Y': [scale]},
+            outputs={'Out': [w_param]}, attrs={'axis': -1})
+        return w_param
 
     def get_parameter(self, name):
         param = self.main_program.global_block().var(name)

@@ -76,6 +76,17 @@ class ParamAttr(object):
 
 
 class WeightNormParamAttr(ParamAttr):
+    """Weight-normalization reparameterization w = g * v / ||v||.
+
+    Parity: python/paddle/fluid/param_attr.py (WeightNormParamAttr) and
+    layer_helper.py:108-309 (_create_weight_normalize). Passing this attr
+    to fc/conv splits the weight into direction ``v`` (original shape) and
+    magnitude ``g`` (norm-shaped along ``dim``); both train, and the layer
+    consumes the recomposed w. ``params_with_weight_norm`` collects the
+    recomposed w Variables, mirroring the reference's bookkeeping.
+    """
+    params_with_weight_norm = []
+
     def __init__(self, dim=None, **kwargs):
         super(WeightNormParamAttr, self).__init__(**kwargs)
         self.dim = dim

@@ -1,0 +1,141 @@
+"""Weight normalization parity.
+
+Mirrors python/paddle/fluid/tests/unittests/test_weight_normalization.py:
+fc with WeightNormParamAttr trains a g (magnitude) / v (direction) pair
+with w = g * v / ||v||_{except dim}; the test fetches g, v and their
+gradients and checks them against an independent numpy oracle, for
+dim=None (the reference's case), dim=0 and dim=1, plus a 4-D conv case.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.param_attr import WeightNormParamAttr
+
+
+def _norm_except(v, dim):
+    if dim is None:
+        return np.linalg.norm(v, axis=None, keepdims=True) * np.ones(
+            [1] * v.ndim)
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return np.sqrt(np.sum(v * v, axis=axes, keepdims=True))
+
+
+def _oracle(x, v, dim):
+    """Forward out = x @ w and grads of loss=sum(out) wrt g, v."""
+    n = _norm_except(v, dim)
+    g = n.copy()  # g is initialized to ||v|| in the startup program
+    w = g * v / n
+    out = x.dot(w)
+    dw = x.T.dot(np.ones_like(out))
+    axes = None if dim is None else tuple(
+        i for i in range(v.ndim) if i != dim)
+    g_grad = np.sum(dw * v / n, axis=axes, keepdims=True)
+    v_grad = g / n * dw - g * v * np.sum(
+        dw * v, axis=axes, keepdims=True) / (n ** 3)
+    return g, w, out, g_grad, v_grad
+
+
+def _run_fc_weight_norm(dim, seed=7):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, size=(3, 10)).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name='x', shape=[10], dtype='float32')
+        out = fluid.layers.fc(
+            input=data, size=5,
+            param_attr=WeightNormParamAttr(
+                dim=dim, name='weight_norm_param',
+                initializer=fluid.initializer.Uniform(-1.0, 1.0)),
+            bias_attr=False, act=None)
+        loss = fluid.layers.reduce_sum(out)
+        fluid.backward.append_backward(loss=loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    g, v, g_grad, v_grad, got_out = exe.run(
+        main, feed={'x': x},
+        fetch_list=['weight_norm_param_g', 'weight_norm_param_v',
+                    'weight_norm_param_g@GRAD', 'weight_norm_param_v@GRAD',
+                    out])
+    return x, (np.asarray(g), np.asarray(v), np.asarray(g_grad),
+               np.asarray(v_grad), np.asarray(got_out))
+
+
+@pytest.mark.parametrize('dim', [None, 0, 1])
+def test_weight_normalization_fc(dim):
+    x, (g, v, g_grad, v_grad, out) = _run_fc_weight_norm(dim)
+    exp_g, _, exp_out, exp_g_grad, exp_v_grad = _oracle(x, v, dim)
+    np.testing.assert_allclose(g, exp_g, atol=1e-3)
+    np.testing.assert_allclose(out, exp_out, atol=1e-3)
+    np.testing.assert_allclose(g_grad, exp_g_grad, atol=1e-3)
+    np.testing.assert_allclose(v_grad, exp_v_grad, atol=1e-3)
+
+
+def test_weight_normalization_reference_case():
+    """The reference file's exact setup: dim=None, Constant(1.0) init."""
+    rng = np.random.RandomState(0)
+    x = rng.random_sample((3, 10)).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name='x', shape=[10], dtype='float32')
+        out = fluid.layers.fc(
+            input=data, size=5,
+            param_attr=WeightNormParamAttr(
+                dim=None, name='weight_norm_param',
+                initializer=fluid.initializer.Constant(1.0)),
+            bias_attr=False, act=None)
+        loss = fluid.layers.reduce_sum(out)
+        fluid.backward.append_backward(loss=loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    g, v, g_grad = exe.run(
+        main, feed={'x': x},
+        fetch_list=['weight_norm_param_g', 'weight_norm_param_v',
+                    'weight_norm_param_g@GRAD'])
+    # numpy oracle exactly as the reference test computes it
+    ev = np.ones((10, 5))
+    eg = np.linalg.norm(ev, axis=None, keepdims=True)
+    e_out = x.dot(eg * ev / np.linalg.norm(ev, axis=None, keepdims=True))
+    e_g_grad = (x.T.dot(np.ones_like(e_out)) *
+                (ev / np.linalg.norm(ev, axis=None, keepdims=True))).sum(
+                    axis=None, keepdims=True)
+    np.testing.assert_allclose(np.asarray(v), ev, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(g).reshape(1, 1), eg, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(g_grad).reshape(1, 1), e_g_grad, atol=1e-3)
+
+
+def test_weight_normalization_conv_trains():
+    """4-D conv weight with dim=0 trains: loss decreases and w stays
+    g-scaled. Also checks params_with_weight_norm bookkeeping."""
+    before = len(WeightNormParamAttr.params_with_weight_norm)
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, size=(2, 3, 8, 8)).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name='x', shape=[3, 8, 8],
+                                 dtype='float32')
+        conv = fluid.layers.conv2d(
+            input=data, num_filters=4, filter_size=3,
+            param_attr=WeightNormParamAttr(
+                dim=0, name='wn_conv',
+                initializer=fluid.initializer.Uniform(-0.3, 0.3)),
+            bias_attr=False, act=None)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(conv))
+        opt = fluid.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    assert len(WeightNormParamAttr.params_with_weight_norm) == before + 1
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(5):
+        l, = exe.run(main, feed={'x': x}, fetch_list=[loss])
+        losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0]
+    # g and v both moved: weight-norm trains the reparameterization
+    g, v = exe.run(main, feed={'x': x},
+                   fetch_list=['wn_conv_g', 'wn_conv_v'])
+    n = _norm_except(np.asarray(v), 0)
+    assert np.all(np.isfinite(np.asarray(g))) and np.all(np.isfinite(n))
