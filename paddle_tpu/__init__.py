@@ -31,7 +31,7 @@ from . import regularizer  # noqa
 from . import clip  # noqa
 from . import optimizer  # noqa
 from . import backward  # noqa
-from .backward import append_backward  # noqa
+from .backward import append_backward, calc_gradient, gradients  # noqa
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa
 from . import unique_name  # noqa
 from .data_feeder import DataFeeder  # noqa
@@ -71,7 +71,7 @@ __all__ = [
     'default_main_program', 'program_guard', 'get_var', 'TPUPlace',
     'CPUPlace', 'CUDAPlace', 'CUDAPinnedPlace', 'Executor', 'global_scope',
     'scope_guard', 'fetch_var', 'layers', 'initializer', 'regularizer',
-    'clip', 'optimizer', 'backward', 'append_backward', 'ParamAttr',
+    'clip', 'optimizer', 'backward', 'append_backward', 'calc_gradient', 'gradients', 'ParamAttr',
     'WeightNormParamAttr', 'unique_name', 'DataFeeder', 'SequenceTensor',
     'create_lod_tensor', 'create_random_int_lodtensor', 'io', 'nets',
     'metrics', 'evaluator', 'profiler', 'reader', 'dataset', 'batch',

@@ -261,6 +261,111 @@ def _op_writes(op):
     return writes
 
 
+# public names for backward.calc_gradient's path analysis
+op_reads = _op_reads
+op_writes = _op_writes
+
+
+def find_op_path(ops, input_names, target_names, no_grad):
+    """Ops both forward-reachable from ``input_names`` and
+    backward-reachable from ``target_names``; reachability cut at
+    ``no_grad``. Parity: the reference's _find_op_path_
+    (python/paddle/fluid/backward.py:564). Returns (path_ops,
+    forward-reachable name set)."""
+    reachable = set(input_names)
+    fwd = [False] * len(ops)
+    for i, op in enumerate(ops):
+        if not input_names or any(n in reachable for n in _op_reads(op)):
+            fwd[i] = True
+            for n in _op_writes(op):
+                if n not in no_grad:
+                    reachable.add(n)
+    needed = set(target_names)
+    keep = [False] * len(ops)
+    for i in reversed(range(len(ops))):
+        if fwd[i] and any(n in needed for n in _op_writes(ops[i])):
+            keep[i] = True
+            for n in _op_reads(ops[i]):
+                if n not in no_grad:
+                    needed.add(n)
+    return [ops[i] for i in range(len(ops)) if keep[i]], reachable
+
+
+def _register_gradient_marker():
+    """calc_gradient's runtime (parity: python/paddle/fluid/backward.py:604).
+
+    The marker replays the input->target op path under ``jax.vjp`` with
+    the inputs as leaves: targets' cotangents are the given
+    target_gradients (ones when absent), explicit ``no_grad`` names are
+    stop_gradient'ed as they are produced, and the resulting input
+    cotangents bind to the declared grad names. Self-contained — works
+    anywhere in the block, composes with backward_marker (the vjp nests
+    inside value_and_grad for double-backward), and repeated calls
+    don't collide because no internal grad vars exist."""
+    from .registry import register_kernel
+
+    @register_kernel('gradient_marker')
+    def _gradient_marker(ctx):
+        op, env = ctx.op, ctx.env
+        block = ctx.runner.block
+        ops = list(block.ops)
+        # keep earlier gradient_markers in the path: their kernel is
+        # itself differentiable JAX code, so grad-of-grad (gradient
+        # penalty) composes as nested vjp; only backward_marker (whose
+        # semantics live in lower_block) is opaque here
+        idx = next(i for i, o in enumerate(ops) if o is op)
+        pre = [o for o in ops[:idx] if o.type != 'backward_marker']
+        input_names = list(op.inputs['Inputs'])
+        target_names = list(op.inputs['Targets'])
+        tgrad_names = list(op.attrs['target_grads'])
+        out_grads = list(op.outputs['OutGrads'])
+        no_grad = set(op.attrs.get('no_grad') or ())
+        path, _ = find_op_path(pre, set(input_names), set(target_names),
+                               no_grad)
+        base_env = dict(env)
+        dynamic = ctx.runner.dynamic
+
+        def g(input_vals):
+            genv = dict(base_env)
+            genv.update(input_vals)
+            runner = BlockRunner(block, grad_mode=True, dynamic=dynamic)
+            for o in path:
+                runner.run_ops([o], genv)
+                for n in o.output_arg_names:
+                    if n in no_grad and n in genv and _is_float(genv[n]):
+                        genv[n] = jax.tree_util.tree_map(
+                            jax.lax.stop_gradient, genv[n])
+            return tuple(genv[t] for t in target_names)
+
+        input_vals = {n: env[n] for n in input_names}
+        primals, vjp_fn = jax.vjp(g, input_vals)
+        cots = []
+        for tg, primal in zip(tgrad_names, primals):
+            if tg is None:
+                cots.append(jax.tree_util.tree_map(jnp.ones_like, primal))
+            else:
+                cots.append(env[tg])
+        grads, = vjp_fn(tuple(cots))
+
+        def _fix_float0(gleaf, pleaf):
+            # float0 marks a non-differentiable leaf: zero it for float
+            # primals; carry the primal for integer structure leaves
+            # (SequenceTensor lengths, ids) so the grad stays usable
+            if getattr(gleaf, 'dtype', None) == jax.dtypes.float0:
+                p = jnp.asarray(pleaf)
+                if jnp.issubdtype(p.dtype, jnp.floating):
+                    return jnp.zeros_like(p)
+                return p
+            return gleaf
+
+        for n, gname in zip(input_names, out_grads):
+            env[gname] = jax.tree_util.tree_map(
+                _fix_float0, grads[n], env[n])
+
+
+_register_gradient_marker()
+
+
 def _run_remat_segments(block, ops, env, grad_mode):
     """memory_optimize() path: execute the forward as ~sqrt(N) segments,
     each under jax.checkpoint, so backward keeps only segment-boundary

@@ -18,7 +18,7 @@ from .core.places import (TPUPlace, CPUPlace, CUDAPlace, CUDAPinnedPlace,  # noq
                           is_compiled_with_cuda, is_compiled_with_tpu)
 from .executor import (Executor, Scope, global_scope, scope_guard, switch_scope,  # noqa
                        fetch_var)
-from .backward import append_backward  # noqa
+from .backward import append_backward, calc_gradient, gradients  # noqa
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa
 from .data_feeder import DataFeeder  # noqa
 from .lod import (SequenceTensor, create_lod_tensor,  # noqa

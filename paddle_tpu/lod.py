@@ -148,9 +148,10 @@ class SequenceTensor(object):
     def to_dense_rows(self):
         """Back to the reference's packed [sum(lengths), ...] layout (host)."""
         data = np.asarray(self.data)
-        lens = np.asarray(self.lengths)
-        return np.concatenate([data[i, :lens[i]] for i in range(len(lens))],
-                              axis=0)
+        # lengths may be a device array (e.g. on a fetched gradient)
+        lens = np.asarray(self.lengths).astype(int)
+        return np.concatenate([data[i, :int(lens[i])]
+                               for i in range(len(lens))], axis=0)
 
     def __repr__(self):
         return "SequenceTensor(data=%s %s, lengths=%s)" % (

@@ -1,0 +1,158 @@
+"""calc_gradient / fluid.gradients parity.
+
+Mirrors python/paddle/fluid/tests/unittests/test_calc_gradient.py (the
+reference exact graph: param mul -> mean, grads wrt the intermediate and
+wrt the param) and extends it with the API's documented semantics:
+target_gradients cotangent seeding, no_grad_set cuts, disconnected
+inputs -> None, repeated calls, and grad-of-grad composition.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.backward import calc_gradient
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_calc_gradient_reference_case():
+    """The reference test's exact graph: x[5,10] @ y[10,8] -> mean."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.create_parameter(dtype='float32', shape=[5, 10])
+        y = fluid.layers.create_parameter(dtype='float32', shape=[10, 8])
+        mul_out = fluid.layers.mul(x=x, y=y)
+        mean_out = fluid.layers.mean(mul_out)
+        a = calc_gradient(mean_out, mul_out)
+        b = calc_gradient(mean_out, x)
+    exe = _exe()
+    exe.run(startup)
+    av, bv, xv, yv = exe.run(main, feed={}, fetch_list=[a[0], b[0], x, y])
+    av, bv = np.asarray(av), np.asarray(bv)
+    # d(mean)/d(mul_out) = 1/40 everywhere; d(mean)/dx = (1/40) ones @ y.T
+    np.testing.assert_allclose(av, np.full((5, 8), 1.0 / 40), rtol=1e-5)
+    np.testing.assert_allclose(
+        bv, np.full((5, 8), 1.0 / 40).dot(np.asarray(yv).T),
+        rtol=1e-4, atol=1e-6)
+
+
+def test_calc_gradient_target_gradients():
+    """Seeding the cotangent: d(sum(cot * y))/dx for y = x**2."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        x.stop_gradient = False
+        y = fluid.layers.square(x)
+        cot = fluid.layers.data(name='cot', shape=[4], dtype='float32')
+        g = calc_gradient(y, x, target_gradients=cot)
+    xv = np.array([[1., 2., 3., 4.]], dtype='float32')
+    cv = np.array([[10., 20., 30., 40.]], dtype='float32')
+    got, = _exe().run(main, feed={'x': xv, 'cot': cv}, fetch_list=[g[0]])
+    np.testing.assert_allclose(np.asarray(got), 2 * xv * cv, rtol=1e-5)
+
+
+def test_calc_gradient_no_grad_set():
+    """no_grad_set cuts the path: z = x*x + h(x) with h blocked -> only
+    the direct term's gradient flows."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        x.stop_gradient = False
+        sq = fluid.layers.square(x)           # x^2
+        h = fluid.layers.scale(x, scale=5.0)  # 5x (to be blocked)
+        z = fluid.layers.elementwise_add(sq, h)
+        s = fluid.layers.reduce_sum(z)
+        g_full = calc_gradient(s, x)
+        g_cut = calc_gradient(s, x, no_grad_set={h.name})
+    xv = np.array([[1., -2., 3.]], dtype='float32')
+    full, cut = _exe().run(main, feed={'x': xv},
+                           fetch_list=[g_full[0], g_cut[0]])
+    np.testing.assert_allclose(np.asarray(full), 2 * xv + 5.0, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(cut), 2 * xv, rtol=1e-5)
+
+
+def test_calc_gradient_disconnected_returns_none():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        w = fluid.layers.data(name='w', shape=[2], dtype='float32')
+        x.stop_gradient = False
+        w.stop_gradient = False
+        y = fluid.layers.reduce_sum(fluid.layers.square(x))
+        grads = calc_gradient(y, [x, w])
+    assert grads[0] is not None
+    assert grads[1] is None  # w does not affect y
+
+
+def test_calc_gradient_shape_mismatch_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        x.stop_gradient = False
+        y = fluid.layers.square(x)
+        bad = fluid.layers.create_parameter(dtype='float32', shape=[3, 3])
+        with pytest.raises(ValueError):
+            calc_gradient(bad, x, target_gradients=x)
+
+
+def test_fluid_gradients_alias():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        x.stop_gradient = False
+        y = fluid.layers.reduce_sum(fluid.layers.exp(x))
+        g = fluid.gradients(y, x)
+    xv = np.array([[0.5, -1.0]], dtype='float32')
+    got, = _exe().run(main, feed={'x': xv}, fetch_list=[g[0]])
+    np.testing.assert_allclose(np.asarray(got), np.exp(xv), rtol=1e-5)
+
+
+def test_calc_gradient_grad_of_grad():
+    """Gradient penalty composition: gp = d(sum(x^3))/dx = 3x^2, then
+    d(sum(gp))/dx = 6x via a second calc_gradient through the first
+    marker (differentiable-marker path)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        x.stop_gradient = False
+        x3 = fluid.layers.elementwise_mul(
+            fluid.layers.square(x), x)
+        s = fluid.layers.reduce_sum(x3)
+        gp = calc_gradient(s, x)          # 3x^2
+        s2 = fluid.layers.reduce_sum(gp[0])
+        gg = calc_gradient(s2, x)         # 6x
+    xv = np.array([[1., 2., -3.]], dtype='float32')
+    g1, g2 = _exe().run(main, feed={'x': xv},
+                        fetch_list=[gp[0], gg[0]])
+    np.testing.assert_allclose(np.asarray(g1), 3 * xv ** 2, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g2), 6 * xv, rtol=1e-5)
+
+
+def test_calc_gradient_coexists_with_append_backward():
+    """calc_gradient before optimizer.minimize: both the per-target grad
+    and the training update work in one program."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        g_x = calc_gradient(loss, x)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 4).astype('float32')
+    yv = rng.randn(8, 1).astype('float32')
+    losses = []
+    for _ in range(10):
+        l, gx = exe.run(main, feed={'x': xv, 'y': yv},
+                        fetch_list=[loss, g_x[0]])
+        losses.append(float(np.asarray(l).item()))
+        assert np.asarray(gx).shape == (8, 4)
+    assert losses[-1] < losses[0]

@@ -1,0 +1,119 @@
+"""Numeric gradient checks through DynamicRNN.
+
+Mirrors python/paddle/fluid/tests/unittests/test_dynrnn_gradient_check.py
+(TestSimpleMul / TestSimpleMulWithMemory): a DynamicRNN whose step is a
+shared-weight matmul (optionally accumulating a memory), loss = mean of
+each sequence's last output. W@GRAD comes from append_backward; the
+data-input gradient X@GRAD comes from calc_gradient (the reference's
+whole-graph backward materializes input grads; here per-target gradients
+are the idiomatic route). Both are checked against central-difference
+numeric gradients of an independent numpy forward.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.backward import calc_gradient
+from paddle_tpu.lod import create_lod_tensor
+
+DATA_W, HID_W = 8, 5
+DELTA = 1e-3
+
+
+def _make_data(seed, num_seq=3, max_len=5):
+    rng = np.random.RandomState(seed)
+    lens = [int(rng.randint(1, max_len)) for _ in range(num_seq)]
+    rows = rng.uniform(-0.5, 0.5,
+                       size=(sum(lens), DATA_W)).astype('float32')
+    W = rng.uniform(-0.5, 0.5, size=(DATA_W, HID_W)).astype('float32')
+    return lens, rows, W
+
+
+def _np_forward(rows, lens, W, with_memory):
+    """loss = mean over sequences of the last step's output vector."""
+    lasts, off = [], 0
+    for n in lens:
+        mem = np.zeros(HID_W, dtype='float64')
+        for t in range(n):
+            o = rows[off + t].astype('float64').dot(W.astype('float64'))
+            if with_memory:
+                o = o + mem
+                mem = o
+        lasts.append(o)
+        off += n
+    return float(np.mean(np.stack(lasts)))
+
+
+def _numeric_grad(arr, f):
+    g = np.zeros_like(arr, dtype='float64')
+    flat, gflat = arr.reshape(-1), g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + DELTA
+        hi = f()
+        flat[i] = orig - DELTA
+        lo = f()
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * DELTA)
+    return g
+
+
+def _build_and_run(lens, rows, W, with_memory):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dat = fluid.layers.data(name='X', shape=[DATA_W],
+                                dtype='float32', lod_level=1)
+        dat.stop_gradient = False
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            d = rnn.step_input(dat)
+            o = fluid.layers.fc(input=d, size=HID_W, param_attr='W',
+                                bias_attr=False, act=None)
+            if with_memory:
+                mem = rnn.memory(shape=[HID_W], value=0.0)
+                o = fluid.layers.elementwise_add(x=o, y=mem)
+                rnn.update_memory(mem, o)
+            rnn.output(o)
+        out = rnn()
+        last = fluid.layers.sequence_pool(input=out, pool_type='last')
+        loss = fluid.layers.mean(last)
+        fluid.backward.append_backward(loss)
+        x_grad = calc_gradient(loss, dat)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {'X': create_lod_tensor(rows, [lens])}
+    # overwrite the initialized W with the oracle's fixed one via a
+    # one-off assign program
+    setter = fluid.Program()
+    gb = setter.global_block()
+    wv = gb.create_var(name='W', shape=[DATA_W, HID_W], dtype='float32',
+                       persistable=True)
+    gb.append_op(type='assign_value', outputs={'Out': wv},
+                 attrs={'shape': [DATA_W, HID_W], 'dtype': 'float32',
+                        'values': W.flatten().tolist()})
+    exe.run(setter)
+    lval, wg, xg = exe.run(
+        main, feed=feed, fetch_list=[loss, 'W@GRAD', x_grad[0]])
+    return float(np.asarray(lval).item()), np.asarray(wg), xg
+
+
+@pytest.mark.parametrize('with_memory', [False, True],
+                         ids=['simple_mul', 'mul_with_memory'])
+def test_dynrnn_gradient_check(with_memory):
+    lens, rows, W = _make_data(seed=5 if with_memory else 4)
+    loss, w_g, x_g = _build_and_run(lens, rows, W, with_memory)
+
+    np.testing.assert_allclose(
+        loss, _np_forward(rows, lens, W, with_memory), rtol=1e-4)
+
+    w_g_num = _numeric_grad(
+        W, lambda: _np_forward(rows, lens, W, with_memory))
+    np.testing.assert_allclose(w_g, w_g_num, rtol=0.05, atol=1e-5)
+
+    x_rows = x_g.to_dense_rows() if hasattr(x_g, 'to_dense_rows') \
+        else np.asarray(x_g)
+    x_g_num = _numeric_grad(
+        rows, lambda: _np_forward(rows, lens, W, with_memory))
+    np.testing.assert_allclose(
+        np.asarray(x_rows).reshape(x_g_num.shape), x_g_num,
+        rtol=0.05, atol=1e-5)

@@ -107,8 +107,10 @@ def test_weight_normalization_reference_case():
 
 
 def test_weight_normalization_conv_trains():
-    """4-D conv weight with dim=0 trains: loss decreases and w stays
-    g-scaled. Also checks params_with_weight_norm bookkeeping."""
+    """4-D conv weight with dim=0 trains: loss decreases, and after
+    training the recomposed w = g*v/||v|| still drives the conv (checked
+    against a plain-weight conv fed the recomposition). Also checks
+    params_with_weight_norm bookkeeping."""
     before = len(WeightNormParamAttr.params_with_weight_norm)
     rng = np.random.RandomState(3)
     x = rng.uniform(-1, 1, size=(2, 3, 8, 8)).astype('float32')
@@ -124,6 +126,7 @@ def test_weight_normalization_conv_trains():
                 initializer=fluid.initializer.Uniform(-0.3, 0.3)),
             bias_attr=False, act=None)
         loss = fluid.layers.reduce_mean(fluid.layers.square(conv))
+        eval_prog = main.clone(for_test=True)
         opt = fluid.optimizer.SGD(learning_rate=0.5)
         opt.minimize(loss)
     assert len(WeightNormParamAttr.params_with_weight_norm) == before + 1
@@ -132,10 +135,30 @@ def test_weight_normalization_conv_trains():
     losses = []
     for _ in range(5):
         l, = exe.run(main, feed={'x': x}, fetch_list=[loss])
-        losses.append(float(np.asarray(l)))
+        losses.append(float(np.asarray(l).item()))
     assert losses[-1] < losses[0]
-    # g and v both moved: weight-norm trains the reparameterization
-    g, v = exe.run(main, feed={'x': x},
-                   fetch_list=['wn_conv_g', 'wn_conv_v'])
-    n = _norm_except(np.asarray(v), 0)
-    assert np.all(np.isfinite(np.asarray(g))) and np.all(np.isfinite(n))
+    # eval clone: conv output and g/v fetched from the SAME (post-
+    # training) weights, with no optimizer update in between
+    got_conv, g, v = exe.run(eval_prog, feed={'x': x},
+                             fetch_list=[conv, 'wn_conv_g', 'wn_conv_v'])
+    g, v = np.asarray(g), np.asarray(v)
+    # recomposition check: a plain conv2d fed w = g*v/||v|| (computed in
+    # numpy from the TRAINED g, v) must reproduce the weight-norm conv
+    w_np = (g * v / _norm_except(v, 0)).astype('float32')
+    ref_main, ref_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(ref_main, ref_startup):
+        data = fluid.layers.data(name='x', shape=[3, 8, 8],
+                                 dtype='float32')
+        ref_conv = fluid.layers.conv2d(
+            input=data, num_filters=4, filter_size=3, param_attr='w_ref',
+            bias_attr=False, act=None)
+    gb = ref_startup.global_block()
+    wv = gb.create_var(name='w_ref', shape=list(w_np.shape),
+                       dtype='float32', persistable=True)
+    gb.append_op(type='assign_value', outputs={'Out': wv},
+                 attrs={'shape': list(w_np.shape), 'dtype': 'float32',
+                        'values': w_np.flatten().tolist()})
+    exe.run(ref_startup)
+    want_conv, = exe.run(ref_main, feed={'x': x}, fetch_list=[ref_conv])
+    np.testing.assert_allclose(np.asarray(got_conv), np.asarray(want_conv),
+                               rtol=1e-4, atol=1e-5)
