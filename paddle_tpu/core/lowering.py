@@ -463,9 +463,10 @@ def _merge_shared_muls(block, ops):
             continue
         # def-use safety: hoisting later members to the first position
         # is only sound while no intervening op REWRITES X, a group
-        # weight, or a member's Out name (reads are fine — the merged
-        # value is produced earlier and is identical). Truncate the
-        # group at the first violating write.
+        # weight, or a member's Out name, AND no intervening op READS a
+        # member's Out name (a reader of a same-named var defined before
+        # the group would otherwise see the hoisted write — WAR hazard).
+        # Truncate the group at the first violation.
         w_names = {ops[i].inputs['Y'][0] for i in idxs}
         out_names = {ops[i].outputs['Out'][0] for i in idxs}
         hazard = {x_name} | w_names | out_names
@@ -476,6 +477,8 @@ def _merge_shared_muls(block, ops):
                 safe.append(j)
                 continue
             if hazard & set(_op_writes(ops[j])):
+                break
+            if out_names & set(_op_reads(ops[j])):
                 break
         idxs = safe
         if len(idxs) < 2:

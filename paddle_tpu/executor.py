@@ -111,7 +111,13 @@ class Scope(object):
         return None
 
     def var(self, name):
-        return self.vars.setdefault(name, None)
+        """Declare (or fetch) a slot and return a usable binding, so the
+        reference pattern ``scope.var(n)`` / ``...get_tensor().set(...)``
+        works even before any value lands in the slot (ADVICE r4:
+        find_var treats a None slot as absent by design — the presence
+        test contract — so declaration must hand out its own binding)."""
+        self.vars.setdefault(name, None)
+        return VarBinding(self, name)
 
     def set_var(self, name, value):
         self.vars[name] = value

@@ -94,6 +94,7 @@ def _prod(dims):
 # #5; ref lookup_table_op.cc:37 always honors the flag, but its CPU
 # SelectedRows path has no merge-sort cost cliff to fall off).
 _SPARSE_MIN_TABLE_ELEMS = [32 * 1024 * 1024]
+_SPARSE_FALLBACK_WARNED = [False]
 
 
 def set_sparse_fallback_threshold(n_elems):
@@ -127,6 +128,19 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     padding_idx = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
     if is_sparse and _prod(size) < _SPARSE_MIN_TABLE_ELEMS[0]:
+        # ADVICE r4: the reference always honors is_sparse
+        # (lookup_table_op.cc); the rewrite is numerics-identical but
+        # visible in the program, so say it once per process
+        import warnings
+        if not _SPARSE_FALLBACK_WARNED[0]:
+            _SPARSE_FALLBACK_WARNED[0] = True
+            warnings.warn(
+                "embedding(is_sparse=True) on a %s table (< %d elements) "
+                "routes to the DENSE gradient path (measured never-worse "
+                "below the break-even on TPU). Numerics are identical; "
+                "override with set_sparse_fallback_threshold(0)."
+                % ('x'.join(str(s) for s in size),
+                   _SPARSE_MIN_TABLE_ELEMS[0]))
         is_sparse = False
     attrs = {'is_sparse': is_sparse, 'padding_idx': padding_idx}
     if is_sparse:

@@ -187,7 +187,18 @@ def iterate_reader(reader_var):
                 t.start()
                 try:
                     while True:
-                        item = q.get()
+                        # bounded wait + liveness check: if the worker
+                        # dies without posting END/_Err (interpreter
+                        # teardown killing the daemon mid-put), raise
+                        # instead of blocking forever (ADVICE r4)
+                        try:
+                            item = q.get(timeout=5.0)
+                        except queue.Empty:
+                            if not t.is_alive():
+                                raise RuntimeError(
+                                    "prefetch worker thread died "
+                                    "without signalling end-of-data")
+                            continue
                         if item is END:
                             return
                         if isinstance(item, _Err):
