@@ -74,10 +74,37 @@ class RecordIOSource(object):
         self.lod_levels = lod_levels
         self.pass_num = pass_num
 
+    def _iter_reference(self, fn):
+        """Reference-layout recordio file (recordio_compat): fluid
+        LoDTensor-bundle records become tuples (SequenceTensor for
+        lod-carrying entries); legacy v2 records are pickled samples."""
+        from . import recordio_compat as rc
+        from .lod import create_lod_tensor
+        for rec in rc.read_reference_records(fn):
+            try:
+                items = rc.unpack_lod_tensor_record(rec)
+            except Exception:
+                yield pickle.loads(rec)
+                continue
+            sample = []
+            for arr, lod in items:
+                if lod and len(lod[0]) > 1:
+                    lens = [[int(b - a) for a, b in zip(l[:-1], l[1:])]
+                            for l in lod]
+                    sample.append(create_lod_tensor(arr, lens))
+                else:
+                    sample.append(arr)
+            yield tuple(sample)
+
     def __iter__(self):
         from .native import loader as native_loader
+        from . import recordio_compat as rc
         for _ in range(self.pass_num):
             for fn in self.filenames:
+                if rc.is_reference_recordio(fn):
+                    for sample in self._iter_reference(fn):
+                        yield sample
+                    continue
                 it = native_loader.read_records(fn) \
                     if native_loader.available() else read_records(fn)
                 for payload in it:

@@ -14,10 +14,37 @@ __all__ = ['convert_reader_to_recordio_file',
 
 def convert_reader_to_recordio_file(filename, reader_creator, feeder,
                                     compressor=None, max_num_records=1000,
-                                    feed_order=None):
+                                    feed_order=None, layout='ptrc'):
+    """``layout='ptrc'`` (default) writes the repo's fast chunk format;
+    ``layout='reference'`` writes the reference fluid recordio layout
+    (recordio_compat: snappy-framed chunks of LoDTensor-bundle records)
+    so the emitted file is consumable by the reference runtime."""
     if feed_order is None:
         feed_order = feeder.feed_names
     counter = 0
+    if layout == 'reference':
+        from .recordio_compat import (ReferenceRecordIOWriter, SNAPPY,
+                                      pack_lod_tensor_record)
+        from .lod import SequenceTensor
+        comp = SNAPPY if compressor is None else compressor
+        with ReferenceRecordIOWriter(filename, comp,
+                                     max_num_records) as writer:
+            for batch in reader_creator():
+                res = feeder.feed(batch)
+                tensors = []
+                for name in feed_order:
+                    v = res[name]
+                    if isinstance(v, SequenceTensor):  # packed rows + lod
+                        rows = v.to_dense_rows()
+                        offs = [[0] + list(np.cumsum(
+                            np.asarray(lv, dtype='int64')))
+                            for lv in v.recursive_sequence_lengths()]
+                        tensors.append((rows, offs))
+                    else:
+                        tensors.append(np.asarray(v))
+                writer.write(pack_lod_tensor_record(tensors))
+                counter += 1
+        return counter
     with RecordIOWriter(filename, compressor, max_num_records) as writer:
         for batch in reader_creator():
             res = feeder.feed(batch)
