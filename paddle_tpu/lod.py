@@ -129,8 +129,19 @@ class SequenceTensor(object):
         return (jnp.arange(t)[None, :] <
                 jnp.asarray(self.lengths)[:, None]).astype(dtype)
 
+    def _inner_lengths(self):
+        """Flattened level-2 inner lengths in LoD order (one entry per
+        real sub-sequence)."""
+        lens = np.asarray(self.lengths).astype(int)
+        sub = np.asarray(self.sub_lengths)
+        return [int(sub[i, j]) for i in range(len(lens))
+                for j in range(int(lens[i]))]
+
     def recursive_sequence_lengths(self):
-        return [np.asarray(self.lengths).tolist()]
+        lens = np.asarray(self.lengths).tolist()
+        if self.sub_lengths is None:
+            return [lens]
+        return [lens, self._inner_lengths()]
 
     def lod(self):
         """Reference-style offset LoD (for compatibility display)."""
@@ -139,10 +150,8 @@ class SequenceTensor(object):
         lens = np.asarray(self.lengths)
         out = [np.concatenate([[0], np.cumsum(lens)]).tolist()]
         if self.sub_lengths is not None:
-            sub = np.asarray(self.sub_lengths)
-            inner = [int(sub[i, j]) for i in range(len(lens))
-                     for j in range(int(lens[i]))]
-            out.append(np.concatenate([[0], np.cumsum(inner)]).tolist())
+            out.append(np.concatenate(
+                [[0], np.cumsum(self._inner_lengths())]).tolist())
         return out
 
     def to_dense_rows(self):
@@ -150,6 +159,12 @@ class SequenceTensor(object):
         data = np.asarray(self.data)
         # lengths may be a device array (e.g. on a fetched gradient)
         lens = np.asarray(self.lengths).astype(int)
+        if self.sub_lengths is not None:
+            # level-2: [B, outer_pad, inner_pad, ...] -> packed tokens
+            sub = np.asarray(self.sub_lengths).astype(int)
+            return np.concatenate(
+                [data[i, j, :sub[i, j]] for i in range(len(lens))
+                 for j in range(int(lens[i]))], axis=0)
         return np.concatenate([data[i, :int(lens[i])]
                                for i in range(len(lens))], axis=0)
 

@@ -142,9 +142,13 @@ def _lod_rank_table(ctx):
     lens = jnp.asarray(st.lengths, jnp.int32)
     # reference sorts items by length descending (stable)
     order = jnp.argsort(-lens, stable=True).astype(jnp.int32)
-    ctx.env[ctx.output_name('Out')] = {
-        'lengths': lens, 'index': order,
-        'padded_len': jnp.asarray(st.data.shape[1])}
+    table = {'lengths': lens, 'index': order,
+             'padded_len': jnp.asarray(st.data.shape[1])}
+    if st.sub_lengths is not None:
+        # level-2 input: carry the inner-sequence lengths (original
+        # order) so array_to_lod_tensor can rebuild the full LoD
+        table['sub_lengths'] = jnp.asarray(st.sub_lengths, jnp.int32)
+    ctx.env[ctx.output_name('Out')] = table
 
 
 @register_kernel('max_sequence_len')
@@ -174,7 +178,16 @@ def _array_to_lod_tensor(ctx):
     inv = jnp.argsort(table['index']).astype(jnp.int32)
     data = jnp.take(data, inv, axis=0)
     lengths = jnp.take(jnp.take(table['lengths'], table['index']), inv)
-    ctx.set_output('Out', SequenceTensor(data, lengths))
+    # level-2 round trip: the rank table carries the inner lengths in
+    # original order (lod_rank_table) — but only re-attach them when the
+    # rebuilt array actually has the level-2 [B, outer_pad, inner, ...]
+    # layout; a While loop's per-step [B, hidden] emissions written to a
+    # fresh array are level-1 even under a level-2 table
+    sub = table.get('sub_lengths')
+    if sub is not None and not (
+            data.ndim >= 3 and tuple(data.shape[:2]) == tuple(sub.shape)):
+        sub = None
+    ctx.set_output('Out', SequenceTensor(data, lengths, sub))
 
 
 @register_kernel('reorder_lod_tensor_by_rank')
@@ -185,7 +198,9 @@ def _reorder_lod_tensor_by_rank(ctx):
     if isinstance(x, SequenceTensor):
         ctx.set_output('Out', SequenceTensor(
             jnp.take(jnp.asarray(x.data), order, axis=0),
-            jnp.take(jnp.asarray(x.lengths), order, axis=0)))
+            jnp.take(jnp.asarray(x.lengths), order, axis=0),
+            None if x.sub_lengths is None else
+            jnp.take(jnp.asarray(x.sub_lengths), order, axis=0)))
     else:
         ctx.set_output('Out', jnp.take(jnp.asarray(x), order, axis=0))
 

@@ -41,22 +41,57 @@ _NP_TO_PROTO = {np.dtype(v): k for k, v in _PROTO_TO_NP.items()}
 
 
 # ---- crc32c (Castagnoli) + snappy framing mask ----------------------------------
-_CRC32C_TABLE = None
+# optional accelerators (not in this image, but cheap to honor)
+try:  # pragma: no cover - environment-dependent
+    import google_crc32c as _gcrc
+except ImportError:
+    _gcrc = None
+try:  # pragma: no cover - environment-dependent
+    import snappy as _pysnappy
+except ImportError:
+    _pysnappy = None
+
+_CRC32C_TABLES = None
 
 
-def _crc32c(data):
-    global _CRC32C_TABLE
-    if _CRC32C_TABLE is None:
-        tab = []
+def _crc32c_tables():
+    """Slicing-by-8 tables: 8 lookups per 8 input bytes instead of a
+    per-byte Python loop (~8x on the pure-Python path)."""
+    global _CRC32C_TABLES
+    if _CRC32C_TABLES is None:
+        t0 = []
         for i in range(256):
             c = i
             for _ in range(8):
                 c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
-            tab.append(c)
-        _CRC32C_TABLE = tab
+            t0.append(c)
+        tables = [t0]
+        for k in range(1, 8):
+            prev = tables[k - 1]
+            tables.append([(prev[i] >> 8) ^ t0[prev[i] & 0xFF]
+                           for i in range(256)])
+        _CRC32C_TABLES = tables
+    return _CRC32C_TABLES
+
+
+def _crc32c(data):
+    if _gcrc is not None:  # pragma: no cover - environment-dependent
+        return _gcrc.value(bytes(data))
+    t = _crc32c_tables()
+    t0, t1, t2, t3, t4, t5, t6, t7 = t
     c = 0xFFFFFFFF
-    for b in data:
-        c = _CRC32C_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    n = len(data)
+    i = 0
+    while i + 8 <= n:
+        c ^= int.from_bytes(data[i:i + 4], 'little')
+        b4, b5, b6, b7 = data[i + 4], data[i + 5], data[i + 6], data[i + 7]
+        c = (t7[c & 0xFF] ^ t6[(c >> 8) & 0xFF] ^
+             t5[(c >> 16) & 0xFF] ^ t4[(c >> 24) & 0xFF] ^
+             t3[b4] ^ t2[b5] ^ t1[b6] ^ t0[b7])
+        i += 8
+    while i < n:
+        c = t0[(c ^ data[i]) & 0xFF] ^ (c >> 8)
+        i += 1
     return c ^ 0xFFFFFFFF
 
 
@@ -66,6 +101,8 @@ def _mask_crc(crc):
 
 # ---- raw snappy -----------------------------------------------------------------
 def _snappy_raw_decompress(buf):
+    if _pysnappy is not None:  # pragma: no cover - environment-dependent
+        return _pysnappy.uncompress(bytes(buf))
     pos, ulen, shift = 0, 0, 0
     while True:
         b = buf[pos]
@@ -125,8 +162,8 @@ def _snappy_raw_compress(data):
             break
     pos = 0
     while pos < len(data):
-        ln = min(len(data) - pos, 0xFFFFFFFF)
-        ln = min(ln, 1 << 20)
+        # callers feed <=64 KiB framing blocks; the cap is a safety bound
+        ln = min(len(data) - pos, 1 << 20)
         if ln <= 60:
             out.append((ln - 1) << 2)
         else:

@@ -225,3 +225,21 @@ def test_while_with_arrays_under_profiler():
         profiler.stop_profiler()
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
         np.testing.assert_allclose(np.asarray(got), xs * 8.0)
+
+
+def test_print_op_passes_value_through(capsys):
+    """layers.Print (mirrors reference test_print_op.py): logs the
+    tensor and forwards it unchanged; gradient flows through."""
+    from paddle_tpu.backward import calc_gradient
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        x.stop_gradient = False
+        y = fluid.layers.Print(x, message='print_op_test')
+        s = fluid.layers.reduce_sum(fluid.layers.square(y))
+        g = calc_gradient(s, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1., -2., 3.]], dtype='float32')
+    out, gx = exe.run(main, feed={'x': xv}, fetch_list=[y, g[0]])
+    np.testing.assert_allclose(np.asarray(out), xv)
+    np.testing.assert_allclose(np.asarray(gx), 2 * xv, rtol=1e-5)

@@ -1,0 +1,109 @@
+"""Variable operator overloading.
+
+Mirrors python/paddle/fluid/tests/unittests/test_math_op_patch.py: every
+arithmetic dunder (scalar and tensor operands, forward and reflected)
+runs through the Program->Executor path against numpy; extends the
+reference with pow, comparisons, and astype (the rest of the patched
+surface, layers/math_op_patch.py).
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    return [np.asarray(r) for r in
+            exe.run(main, feed=feeds, fetch_list=fetches)]
+
+
+def test_add_scalar_chain():
+    """The reference's exact first case: b=a+10, c=concat(a,b)+10,
+    d=concat(a,b)+a."""
+    a_np = np.random.random(size=[10, 1]).astype('float32')
+
+    def build():
+        a = fluid.layers.data(name='a', shape=[1])
+        b = a + 10
+        ab = fluid.layers.concat(input=[a, b], axis=1)
+        c = ab + 10
+        d = ab + a
+        return [b, c, d]
+
+    b_np, c_np, d_np = _run(build, {'a': a_np})
+    np.testing.assert_allclose(b_np, a_np + 10, rtol=1e-6)
+    ab_np = np.concatenate([a_np, b_np], axis=1)
+    np.testing.assert_allclose(c_np, ab_np + 10, rtol=1e-6)
+    np.testing.assert_allclose(
+        d_np, ab_np + np.concatenate([a_np, a_np], axis=1), rtol=1e-6)
+
+
+def test_scalar_ops_forward_and_reflected():
+    a_np = np.random.random(size=[10, 1]).astype('float32') + 1e-2
+    cases = [
+        (lambda a: a + 10, a_np + 10),
+        (lambda a: 10 + a, 10 + a_np),
+        (lambda a: a - 10, a_np - 10),
+        (lambda a: 10 - a, 10 - a_np),
+        (lambda a: a * 10, a_np * 10),
+        (lambda a: 10 * a, 10 * a_np),
+        (lambda a: a / 10, a_np / 10),
+        (lambda a: 10 / a, 10 / a_np),
+        (lambda a: a ** 2.0, a_np ** 2),
+        (lambda a: 2.0 ** a, 2 ** a_np),
+    ]
+
+    def build():
+        a = fluid.layers.data(name='a', shape=[1])
+        return [f(a) for f, _ in cases]
+
+    results = _run(build, {'a': a_np})
+    for got, (_, want) in zip(results, cases):
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_two_tensor_ops():
+    a_np = np.random.random(size=[10, 1]).astype('float32')
+    b_np = np.random.random(size=[10, 1]).astype('float32') + 1e-2
+
+    def build():
+        a = fluid.layers.data(name='a', shape=[1])
+        b = fluid.layers.data(name='b', shape=[1])
+        return [a + b, a - b, a * b, a / b]
+
+    add, sub, mul, div = _run(build, {'a': a_np, 'b': b_np})
+    np.testing.assert_allclose(add, a_np + b_np, rtol=1e-6)
+    np.testing.assert_allclose(sub, a_np - b_np, rtol=1e-6)
+    np.testing.assert_allclose(mul, a_np * b_np, rtol=1e-6)
+    np.testing.assert_allclose(div, a_np / b_np, rtol=1e-5)
+
+
+def test_comparisons_and_astype():
+    a_np = np.array([[1.], [2.], [3.]], dtype='float32')
+    b_np = np.array([[2.], [2.], [2.]], dtype='float32')
+
+    def build():
+        a = fluid.layers.data(name='a', shape=[1])
+        b = fluid.layers.data(name='b', shape=[1])
+        return [a < b, a <= b, a > b, a >= b, (a * 2).astype('int64')]
+
+    lt, le, gt, ge, cast = _run(build, {'a': a_np, 'b': b_np})
+    np.testing.assert_array_equal(lt.astype(bool), a_np < b_np)
+    np.testing.assert_array_equal(le.astype(bool), a_np <= b_np)
+    np.testing.assert_array_equal(gt.astype(bool), a_np > b_np)
+    np.testing.assert_array_equal(ge.astype(bool), a_np >= b_np)
+    assert cast.dtype in (np.int32, np.int64)  # int64 canonicalizes
+    np.testing.assert_array_equal(cast, (a_np * 2).astype('int64'))
+
+
+def test_variable_hash_identity_preserved():
+    """Elementwise __eq__ must not break identity-keyed dicts/sets."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name='a', shape=[1])
+        b = fluid.layers.data(name='b', shape=[1])
+    assert len({a, b}) == 2
+    assert {a: 1, b: 2}[a] == 1

@@ -1,0 +1,54 @@
+"""l2_normalize python wrapper.
+
+Mirrors python/paddle/fluid/tests/unittests/
+test_normalization_wrapper.py: same (2, 3, 7) no-batch-dim input, axis=1,
+forward through the Program/Executor path plus append_backward. The
+oracle here is the op's actual contract out = x / sqrt(sum(x^2, axis) +
+eps) — the reference file's numpy "groundtruth" divides by the SQUARED
+norm without sqrt (a known oddity of that file); our op mirrors the
+reference norm_op kernel, not that oracle.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _l2_normalize_np(data, axis, epsilon):
+    return data / np.sqrt(
+        np.sum(np.square(data), axis=axis, keepdims=True) + epsilon)
+
+
+@pytest.mark.parametrize('axis', [0, 1, 2, -1])
+def test_l2_normalize_wrapper(axis):
+    rng = np.random.RandomState(11)
+    data = rng.random_sample((2, 3, 7)).astype('float32')
+    epsilon = 1e-6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='input', shape=[2, 3, 7],
+                              dtype='float32', append_batch_size=False)
+        x.stop_gradient = False
+        l2_norm = fluid.layers.l2_normalize(x=x, axis=axis,
+                                            epsilon=epsilon)
+        out = fluid.layers.reduce_sum(l2_norm, dim=None)
+        fluid.backward.append_backward(loss=out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, feed={'input': data}, fetch_list=[l2_norm])
+    np.testing.assert_allclose(
+        np.asarray(got), _l2_normalize_np(data, axis, epsilon),
+        atol=1e-3)
+
+
+def test_l2_normalize_1d_forces_axis_0():
+    """The wrapper maps any axis to 0 for 1-D inputs (reference
+    layers/nn.py l2_normalize contract)."""
+    data = np.array([3.0, 4.0], dtype='float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='input', shape=[2], dtype='float32',
+                              append_batch_size=False)
+        l2_norm = fluid.layers.l2_normalize(x=x, axis=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, feed={'input': data}, fetch_list=[l2_norm])
+    np.testing.assert_allclose(np.asarray(got), [0.6, 0.8], atol=1e-5)

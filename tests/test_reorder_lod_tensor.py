@@ -1,0 +1,89 @@
+"""reorder_lod_tensor_by_rank.
+
+Mirrors python/paddle/fluid/tests/unittests/test_reorder_lod_tensor.py:
+a rank table built from a reference LoD input reorders another tensor's
+sequences (or rows, for a lod_level-0 input) into descending-length
+order; forward values and the input gradient (a permutation-scatter)
+are both checked against a numpy oracle. The reference's grad check
+uses loss=sum (all-ones grads); here the cotangent is seeded with
+distinct per-row weights via calc_gradient so the inverse permutation
+is actually pinned.
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.backward import calc_gradient
+from paddle_tpu.lod import create_lod_tensor
+
+
+def _rank_order(lens):
+    """Descending length, stable on ties — the reference rank table."""
+    return [i for i, _ in sorted(enumerate(lens), key=lambda p: (-p[1],
+                                                                 p[0]))]
+
+
+def test_reorder_rows_lod0_input_with_grad():
+    rng = np.random.RandomState(0)
+    n_seq = 5
+    ref_lens = [int(v) for v in rng.randint(1, 5, size=n_seq)]
+    x_np = rng.random_sample((n_seq, 9)).astype('float32')
+    ref_rows = rng.random_sample(
+        (sum(ref_lens), 5)).astype('float32')
+    w_np = rng.random_sample((n_seq, 9)).astype('float32')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dat = fluid.layers.data(name='input', shape=[9])
+        dat.stop_gradient = False
+        rank_dat = fluid.layers.data(name='ref', shape=[5], lod_level=1)
+        w = fluid.layers.data(name='w', shape=[9])
+        table = fluid.layers.lod_rank_table(rank_dat)
+        new_dat = fluid.layers.reorder_lod_tensor_by_rank(
+            x=dat, rank_table=table)
+        loss = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(new_dat, w))
+        g = calc_gradient(loss, dat)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, gx = exe.run(
+        main,
+        feed={'input': x_np, 'w': w_np,
+              'ref': create_lod_tensor(ref_rows, [ref_lens])},
+        fetch_list=[new_dat, g[0]])
+    order = _rank_order(ref_lens)
+    np.testing.assert_allclose(np.asarray(out), x_np[order], rtol=1e-6)
+    # dL/dx scatters w back through the inverse permutation
+    want_g = np.empty_like(w_np)
+    for new_pos, old_pos in enumerate(order):
+        want_g[old_pos] = w_np[new_pos]
+    np.testing.assert_allclose(np.asarray(gx), want_g, rtol=1e-6)
+
+
+def test_reorder_sequences_lod_input():
+    rng = np.random.RandomState(3)
+    n_seq = 4
+    ref_lens = [2, 4, 1, 3]
+    x_lens = [3, 1, 2, 4]
+    rows = rng.random_sample((sum(x_lens), 6)).astype('float32')
+    ref_rows = rng.random_sample((sum(ref_lens), 2)).astype('float32')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dat = fluid.layers.data(name='input', shape=[6], lod_level=1)
+        rank_dat = fluid.layers.data(name='ref', shape=[2], lod_level=1)
+        table = fluid.layers.lod_rank_table(rank_dat)
+        new_dat = fluid.layers.reorder_lod_tensor_by_rank(
+            x=dat, rank_table=table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, = exe.run(
+        main,
+        feed={'input': create_lod_tensor(rows, [x_lens]),
+              'ref': create_lod_tensor(ref_rows, [ref_lens])},
+        fetch_list=[new_dat], return_numpy=False)
+    order = _rank_order(ref_lens)  # ranks by the REF lengths
+    offs = np.concatenate([[0], np.cumsum(x_lens)])
+    want_rows = np.concatenate(
+        [rows[offs[i]:offs[i + 1]] for i in order], axis=0)
+    want_lens = [x_lens[i] for i in order]
+    np.testing.assert_allclose(np.asarray(out.to_dense_rows()),
+                               want_rows, rtol=1e-6)
+    assert out.recursive_sequence_lengths() == [want_lens]
