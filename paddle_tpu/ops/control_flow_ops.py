@@ -142,13 +142,9 @@ def _lod_rank_table(ctx):
     lens = jnp.asarray(st.lengths, jnp.int32)
     # reference sorts items by length descending (stable)
     order = jnp.argsort(-lens, stable=True).astype(jnp.int32)
-    table = {'lengths': lens, 'index': order,
-             'padded_len': jnp.asarray(st.data.shape[1])}
-    if st.sub_lengths is not None:
-        # level-2 input: carry the inner-sequence lengths (original
-        # order) so array_to_lod_tensor can rebuild the full LoD
-        table['sub_lengths'] = jnp.asarray(st.sub_lengths, jnp.int32)
-    ctx.env[ctx.output_name('Out')] = table
+    ctx.env[ctx.output_name('Out')] = {
+        'lengths': lens, 'index': order,
+        'padded_len': jnp.asarray(st.data.shape[1])}
 
 
 @register_kernel('max_sequence_len')
@@ -166,8 +162,15 @@ def _lod_tensor_to_array(ctx):
     # rank-sorted batch, time-major: buf[t] = batch slice at step t
     sorted_rows = jnp.take(data, table['index'], axis=0)
     buf = jnp.moveaxis(sorted_rows, 1, 0)
-    ctx.env[ctx.output_name('Out')] = make_array(
-        buf, jnp.max(table['lengths']))
+    arr = make_array(buf, jnp.max(table['lengths']))
+    if st.sub_lengths is not None:
+        # level-2 input: stamp the inner lengths (ORIGINAL order) on
+        # the array itself — exact provenance, so array_to_lod_tensor
+        # restores the full LoD only on arrays that really came from a
+        # level-2 tensor (a shape heuristic collides whenever a fresh
+        # While array's capacity equals the outer bucket pad)
+        arr['sub_lengths'] = jnp.asarray(st.sub_lengths, jnp.int32)
+    ctx.env[ctx.output_name('Out')] = arr
 
 
 @register_kernel('array_to_lod_tensor')
@@ -178,16 +181,11 @@ def _array_to_lod_tensor(ctx):
     inv = jnp.argsort(table['index']).astype(jnp.int32)
     data = jnp.take(data, inv, axis=0)
     lengths = jnp.take(jnp.take(table['lengths'], table['index']), inv)
-    # level-2 round trip: the rank table carries the inner lengths in
-    # original order (lod_rank_table) — but only re-attach them when the
-    # rebuilt array actually has the level-2 [B, outer_pad, inner, ...]
-    # layout; a While loop's per-step [B, hidden] emissions written to a
-    # fresh array are level-1 even under a level-2 table
-    sub = table.get('sub_lengths')
-    if sub is not None and not (
-            data.ndim >= 3 and tuple(data.shape[:2]) == tuple(sub.shape)):
-        sub = None
-    ctx.set_output('Out', SequenceTensor(data, lengths, sub))
+    # level-2 round trip: only arrays stamped by lod_tensor_to_array
+    # carry sub_lengths; per-step emissions written to fresh arrays
+    # (make_array drops extra keys) stay level-1 by construction
+    ctx.set_output('Out', SequenceTensor(
+        data, lengths, arr.get('sub_lengths')))
 
 
 @register_kernel('reorder_lod_tensor_by_rank')

@@ -69,8 +69,19 @@ def attention_reference(q, k, v, causal=True, q_off=0, k_off=0):
     return attention_reference_with_lse(q, k, v, causal, q_off, k_off)[0]
 
 
+# exp2-based softmax (VERDICT r4 #4): fold log2(e) into the score
+# scale so the VPU evaluates exp2 directly instead of exp's extra
+# multiply per element. Saved lse stays NATURAL-log so the
+# backward/ring-merge contract is unchanged. NOTE: the flag is read at
+# TRACE time — flipping it after a caller has jit-compiled reuses the
+# cached executable; A/B measurement must jax.clear_caches() between
+# legs (bench.py does). Default from the on-chip A/B in PERF.md.
+_LOG2E = 1.4426950408889634
+_USE_EXP2 = [True]
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                  acc_scr, *, block_q, block_k, causal, n_kb):
+                  acc_scr, *, block_q, block_k, causal, n_kb, exp2):
     """One (batch*head, q-block, k-block) grid step.
 
     The k-block index is the innermost grid dim, so Mosaic streams k/v
@@ -102,6 +113,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         k = k_ref[0]                              # [block_k, D]
         v = v_ref[0]
         scale = 1.0 / math.sqrt(q.shape[-1])
+        _exp = jnp.exp2 if exp2 else jnp.exp
+        if exp2:
+            scale = scale * _LOG2E  # scores live in log2 units
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
@@ -114,8 +128,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         m_prev = m_scr[:, :1]                     # [bq, 1]
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        alpha = _exp(m_prev - m_new)
+        p = _exp(s - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -133,8 +147,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     def _finalize():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        # logsumexp row stats, saved for the blockwise backward
-        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
+        # logsumexp row stats (NATURAL log even in exp2 mode), saved
+        # for the blockwise backward and the ring-attention merge
+        m_nat = m_scr[:, :1] / _LOG2E if exp2 else m_scr[:, :1]
+        lse_ref[0] = m_nat + jnp.log(l)
 
 
 def _kb_clamp(causal, block_q, block_k, n_kb):
@@ -170,7 +186,8 @@ def _flash_pallas_call(q, k, v, causal, block_q, block_k, interpret):
     kb_map = _kb_clamp(causal, block_q, block_k, n_kb)
     on, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, n_kb=n_kb),
+                          block_k=block_k, causal=causal, n_kb=n_kb,
+                          exp2=_USE_EXP2[0]),
         grid=(BH, T // block_q, n_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -196,7 +213,8 @@ def _flash_pallas_call(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, dq_scr, *, block_q, block_k, causal, n_kb):
+                     dq_ref, dq_scr, *, block_q, block_k, causal, n_kb,
+                     exp2):
     """dq pass: one (bh, q-block, k-block) step; dq accumulates in VMEM."""
     qi = pl.program_id(1)
     kb = pl.program_id(2)
@@ -213,19 +231,21 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]                          # [bq, 1]
+        lse = lse_ref[0]                          # [bq, 1] natural log
         delta = delta_ref[0]                      # [bq, 1]
         scale = 1.0 / math.sqrt(q.shape[-1])
+        _exp = jnp.exp2 if exp2 else jnp.exp
+        sscale = scale * _LOG2E if exp2 else scale
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * sscale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                      # normalised probs
+        p = _exp(s - (lse * _LOG2E if exp2 else lse))  # normalised probs
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)   # [bq, bk]
@@ -241,7 +261,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, dk_scr, dv_scr, *,
-                      block_q, block_k, causal, n_qb):
+                      block_q, block_k, causal, n_qb, exp2):
     """dk/dv pass: one (bh, k-block, q-block) step; q blocks stream
     innermost, dk/dv accumulate in VMEM. All math stays q-major so no
     in-kernel transposes are needed (dot_general contracts dim 0)."""
@@ -264,16 +284,18 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0]
         delta = delta_ref[0]
         scale = 1.0 / math.sqrt(q.shape[-1])
+        _exp = jnp.exp2 if exp2 else jnp.exp
+        sscale = scale * _LOG2E if exp2 else scale
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * sscale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+        p = _exp(s - (lse * _LOG2E if exp2 else lse))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -311,7 +333,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
     kb_map = _kb_clamp(causal, block_q, block_k, n_kb)
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, n_kb=n_kb),
+                          block_k=block_k, causal=causal, n_kb=n_kb,
+                          exp2=_USE_EXP2[0]),
         grid=(BH, n_qb, n_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -329,7 +352,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
     qi_map = _qi_clamp(causal, block_q, block_k)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, n_qb=n_qb),
+                          block_k=block_k, causal=causal, n_qb=n_qb,
+                          exp2=_USE_EXP2[0]),
         grid=(BH, n_kb, n_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, D), qi_map),

@@ -121,3 +121,81 @@ def _tp_oracle():
     """Oracle for the tp-across-processes leg: same graph, SGD. (tp
     param names/sharding don't change the math — params init by seed.)"""
     return _oracle(lambda: fluid.optimizer.SGD(learning_rate=0.1), 3)
+
+
+def _pp_oracle():
+    """Single-process oracle for the 4-process pipeline leg: identical
+    cfg/seeds/mesh-shape on this process's own 8 virtual devices."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.models import transformer as T
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                ('dp', 'pp'))
+    cfg = T.TransformerConfig(vocab=128, d_model=64, n_heads=4,
+                              n_layers=4, d_ff=128, max_len=32,
+                              dtype=jnp.float32)
+    params = T.stack_pipeline_params(T.init_params(cfg, seed=0), cfg, 4)
+    opt = T.init_adam_state(params)
+    step = T.make_pipeline_train_step(cfg, mesh, lr=1e-3, n_micro=2)
+    rng = np.random.RandomState(7)
+    tokens = rng.randint(0, cfg.vocab, size=(4, 33)).astype(np.int32)
+    losses = []
+    with mesh:
+        for _ in range(3):
+            l, params, opt = step(params, opt, tokens[:, :-1],
+                                  tokens[:, 1:])
+            losses.append(float(np.asarray(l)))
+    return losses
+
+
+def test_four_process_pipeline_crosses_process_boundary():
+    """pp ACROSS processes (VERDICT r4 #10): 4 jax.distributed
+    processes x 2 devices, mesh (dp=2, pp=4) whose ppermute ring spans
+    process boundaries; losses must match the single-process oracle.
+    Works time-shared on a single core (the workers block on gloo
+    collectives, not spin)."""
+    port = _free_port()
+    workers = []
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ('XLA_FLAGS',)}
+    for pid in range(4):
+        env = dict(base_env)
+        env.update({
+            'JAX_PLATFORMS': 'cpu',
+            'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+            'PTPU_TRAINER_ID': str(pid),
+            'PTPU_COORD': '127.0.0.1:%d' % port,
+        })
+        workers.append(subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__),
+                          'distributed_pp_worker.py')],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    outs = []
+    try:
+        for w in workers:
+            out, err = w.communicate(timeout=540)
+            assert w.returncode == 0, \
+                'pp worker failed:\n%s\n%s' % (out, err)
+            outs.append(out)
+    finally:
+        # one failed/hung worker must not orphan the others blocked in
+        # gloo collectives
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+    per_worker = []
+    for out in outs:
+        line = [l for l in out.splitlines()
+                if l.startswith('PP_LOSSES=')]
+        assert line, out
+        per_worker.append(json.loads(line[0][len('PP_LOSSES='):]))
+    for other in per_worker[1:]:
+        np.testing.assert_allclose(per_worker[0], other, rtol=1e-6)
+    oracle = _pp_oracle()
+    np.testing.assert_allclose(per_worker[0], oracle, rtol=1e-4,
+                               atol=1e-5)
+    assert per_worker[0][-1] < per_worker[0][0]

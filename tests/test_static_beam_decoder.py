@@ -1,0 +1,154 @@
+"""nets.static_beam_decoder — jitted static-width beam search.
+
+The fluid-facing opt-in for fast decode (VERDICT r4 #7; parity intent:
+the decode graph of book test_machine_translation.py, on dense [B*K]
+rows). Checked against an independent numpy beam search implementing
+the documented static semantics (finished beams frozen as single
+(end_id, score) candidates; per-sentence top-K over K*topk candidates;
+parent backtrack), plus a K=1 greedy case that must equal the argmax
+chain.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+V, H = 7, 4
+END = 2
+
+
+def _np_beam_decode(P, B, K, max_len, topk, end_id, init_id=1):
+    """Numpy oracle with the kernel's exact static semantics."""
+    ids = np.full((B, K), init_id, np.int64)
+    scores = np.zeros((B, K), np.float64)
+    hist_ids, hist_par = [], []
+    steps = 0
+    for _ in range(max_len):
+        sel_i = np.zeros((B, K), np.int64)
+        sel_s = np.zeros((B, K), np.float64)
+        sel_p = np.zeros((B, K), np.int64)
+        for b in range(B):
+            cands = []  # (score, arrival order, token, parent slot)
+            for k in range(K):
+                row = P[ids[b, k]]
+                order = np.argsort(-row, kind='stable')[:topk]
+                accu = np.log(row[order]) + scores[b, k]
+                if ids[b, k] == end_id:   # frozen: single candidate
+                    cands.append((accu[0], k * topk, end_id, k))
+                    continue
+                for c in range(topk):
+                    cands.append((accu[c], k * topk + c,
+                                  int(order[c]), k))
+            # top-K, ties broken by flattened candidate order (lax.top_k)
+            cands.sort(key=lambda t: (-t[0], t[1]))
+            for k in range(K):
+                s, _, tok, par = cands[k]
+                sel_i[b, k], sel_s[b, k], sel_p[b, k] = tok, s, par
+        hist_ids.append(sel_i.copy())
+        hist_par.append(sel_p.copy())
+        ids, scores = sel_i, sel_s
+        steps += 1
+        if np.all(sel_i == end_id):
+            break
+    # backtrack: slot k of sentence b
+    out = np.zeros((B, K, steps), np.int64)
+    for b in range(B):
+        for k in range(K):
+            slot = k
+            for t in range(steps - 1, -1, -1):
+                out[b, k, t] = hist_ids[t][b, slot]
+                slot = hist_par[t][b, slot]
+    return out, scores, steps
+
+
+def _run_decoder(P, B, K, max_len, topk, init_id=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p_var = fluid.layers.data(name='P', shape=[V, V],
+                                  dtype='float32',
+                                  append_batch_size=False)
+        st0 = fluid.layers.data(name='st0', shape=[H], dtype='float32')
+
+        def step(pre_ids, pre_state):
+            probs = fluid.layers.gather(
+                p_var, fluid.layers.reshape(pre_ids, shape=[-1]))
+            return probs, pre_state
+
+        tr_ids, tr_sc = fluid.nets.static_beam_decoder(
+            step, st0, beam_size=K, max_len=max_len, end_id=END,
+            init_id=init_id, topk_size=topk)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got_i, got_s = exe.run(
+        main,
+        feed={'P': P.astype('float32'),
+              'st0': np.zeros((B * K, H), 'float32')},
+        fetch_list=[tr_ids, tr_sc], return_numpy=False)
+    return got_i, got_s
+
+
+def _fixed_P(seed, peaked_end=False):
+    rng = np.random.RandomState(seed)
+    P = rng.dirichlet(np.ones(V) * (0.4 if not peaked_end else 0.25),
+                      size=V)
+    if peaked_end:
+        # make END strongly attractive from state 3 so beams finish early
+        P[3] = np.full(V, 0.02)
+        P[3, END] = 1.0 - 0.02 * (V - 1)
+        P[END] = np.full(V, 1e-4)
+        P[END, END] = 1.0 - 1e-4 * (V - 1)
+    return P
+
+
+@pytest.mark.parametrize('case', ['plain', 'early_finish'])
+def test_static_beam_decoder_matches_numpy(case):
+    B, K, topk, max_len = 2, 3, 4, 6
+    INIT = 1
+    P = _fixed_P(1 if case == 'plain' else 5,
+                 peaked_end=(case == 'early_finish'))
+    want_ids, want_sc, steps = _np_beam_decode(P, B, K, max_len, topk,
+                                               END, init_id=INIT)
+    got_i, got_s = _run_decoder(P, B, K, max_len, topk)
+    rows = np.asarray(got_i.data)[:, :steps + 1]  # seed + selections
+    np.testing.assert_array_equal(
+        rows[:, 0], np.full(B * K, INIT))  # sequences start at the seed
+    np.testing.assert_array_equal(
+        rows[:, 1:].reshape(B, K, steps), want_ids)
+    final = np.asarray(got_s.data)[:, steps].reshape(B, K)
+    np.testing.assert_allclose(final, want_sc, rtol=1e-5)
+    if case == 'early_finish':
+        assert steps < max_len  # the early-exit cond actually fired
+
+
+def test_greedy_k1_equals_argmax_chain():
+    B, K, topk, max_len = 3, 1, 3, 5
+    P = _fixed_P(9)
+    got_i, _ = _run_decoder(P, B, K, max_len, topk)
+    rows = np.asarray(got_i.data)
+    np.testing.assert_array_equal(rows[:, 0], np.full(B, 1))  # seed
+    cur = np.full(B, 1, np.int64)
+    for t in range(max_len):
+        nxt = np.array([END if cur[b] == END else
+                        int(np.argmax(P[cur[b]])) for b in range(B)])
+        np.testing.assert_array_equal(rows[:, t + 1], nxt)
+        cur = nxt
+
+
+def test_decoder_program_stays_jittable():
+    """The decode program must NOT trip the dynamic (eager) detector —
+    that is the whole point of the static formulation."""
+    from paddle_tpu.executor import _is_dynamic_program
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p_var = fluid.layers.data(name='P', shape=[V, V],
+                                  dtype='float32',
+                                  append_batch_size=False)
+        st0 = fluid.layers.data(name='st0', shape=[H], dtype='float32')
+
+        def step(pre_ids, pre_state):
+            probs = fluid.layers.gather(
+                p_var, fluid.layers.reshape(pre_ids, shape=[-1]))
+            return probs, pre_state
+
+        fluid.nets.static_beam_decoder(step, st0, beam_size=2,
+                                       max_len=4, end_id=END)
+    assert not _is_dynamic_program(main)
