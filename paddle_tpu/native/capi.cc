@@ -1,0 +1,242 @@
+// Inference C API: serve saved inference models from C/C++ programs.
+//
+// Parity intent: paddle/capi + paddle/fluid/inference/io.cc (the
+// reference's C-linkage predictor over save_inference_model output).
+// TPU design ruling (SURVEY §2.4): the compute path IS XLA-driven-by-
+// JAX, so this API embeds the CPython runtime and drives
+// fluid.io.load_inference_model / Executor.run — the standard way to
+// serve a JAX program from native code. Re-implementing the op set in
+// C++ would be a second framework, not parity.
+//
+// Surface (all C linkage, see capi.h-style decls below):
+//   ptpu_predictor_create(model_dir)      -> handle (NULL on error)
+//   ptpu_predictor_num_inputs / _num_outputs
+//   ptpu_predictor_input_name(i) / _output_index-less single-feed run
+//   ptpu_predictor_run_f32: single float32 input -> float32 output[idx]
+//   ptpu_predictor_destroy
+//   ptpu_last_error()                     -> message for the last failure
+//
+// Works both from a pure C program (initializes the interpreter) and
+// from inside an already-running Python process (GIL-state aware) —
+// tests cover both paths.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+// Python-side helper, compiled once per process. Keeps ALL object
+// plumbing in Python (only bytes/ints cross the C boundary).
+const char* kHelperSrc = R"PY(
+import numpy as np
+import paddle_tpu.fluid as fluid
+
+def _create(model_dir):
+    exe = fluid.Executor(fluid.CPUPlace())
+    prog, feed_names, fetch_targets = fluid.io.load_inference_model(
+        model_dir, exe)
+    return {'exe': exe, 'prog': prog, 'feeds': list(feed_names),
+            'fetches': list(fetch_targets)}
+
+def _run_f32(state, name, buf, shape, out_idx):
+    arr = np.frombuffer(buf, dtype=np.float32).reshape(shape)
+    outs = state['exe'].run(state['prog'], feed={name: arr},
+                            fetch_list=state['fetches'])
+    out = np.ascontiguousarray(np.asarray(outs[out_idx]),
+                               dtype=np.float32)
+    return out.tobytes(), list(out.shape)
+)PY";
+
+struct Predictor {
+  PyObject* state;    // dict from _create
+  PyObject* helpers;  // module-globals dict holding _create/_run_f32
+  bool we_initialized_python;
+};
+
+PyObject* helper_dict() {
+  PyObject* globals = PyDict_New();
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* r = PyRun_String(kHelperSrc, Py_file_input, globals, globals);
+  if (!r) {
+    set_error_from_python();
+    Py_DECREF(globals);
+    return nullptr;
+  }
+  Py_DECREF(r);
+  return globals;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* ptpu_last_error() { return g_last_error.c_str(); }
+
+void* ptpu_predictor_create(const char* model_dir) {
+  bool we_init = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_init = true;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  Predictor* p = nullptr;
+  PyObject* globals = helper_dict();
+  if (globals) {
+    PyObject* create = PyDict_GetItemString(globals, "_create");
+    PyObject* state =
+        PyObject_CallFunction(create, "s", model_dir);
+    if (state) {
+      p = new Predictor{state, globals, we_init};
+    } else {
+      set_error_from_python();
+      Py_DECREF(globals);
+    }
+  }
+  PyGILState_Release(g);
+  return p;
+}
+
+int ptpu_predictor_num_inputs(void* pred) {
+  if (!pred) return -1;
+  Predictor* p = static_cast<Predictor*>(pred);
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* feeds = PyDict_GetItemString(p->state, "feeds");
+  int n = feeds ? static_cast<int>(PyList_Size(feeds)) : -1;
+  PyGILState_Release(g);
+  return n;
+}
+
+int ptpu_predictor_num_outputs(void* pred) {
+  if (!pred) return -1;
+  Predictor* p = static_cast<Predictor*>(pred);
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* fetches = PyDict_GetItemString(p->state, "fetches");
+  int n = fetches ? static_cast<int>(PyList_Size(fetches)) : -1;
+  PyGILState_Release(g);
+  return n;
+}
+
+// Copies input name i into buf (NUL-terminated, truncated to cap).
+// Returns name length or -1.
+int ptpu_predictor_input_name(void* pred, int i, char* buf, int cap) {
+  if (!pred) return -1;
+  Predictor* p = static_cast<Predictor*>(pred);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int n = -1;
+  PyObject* feeds = PyDict_GetItemString(p->state, "feeds");
+  if (feeds && i >= 0 && i < PyList_Size(feeds)) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(feeds, i));
+    if (s) {
+      n = static_cast<int>(strlen(s));
+      if (buf && cap > 0) {
+        strncpy(buf, s, cap - 1);
+        buf[cap - 1] = '\0';
+      }
+    }
+  }
+  PyGILState_Release(g);
+  return n;
+}
+
+// Single float32 input (fed to `input_name`, or the model's first feed
+// when NULL) -> float32 output `out_idx`. `out_shape`/`out_ndim`
+// report the result shape; data is copied into out_buf when capacity
+// (in elements) suffices. Returns the element count of the output, or
+// -1 on error.
+int64_t ptpu_predictor_run_f32(void* pred, const char* input_name,
+                               const float* data, const int64_t* shape,
+                               int ndim, int out_idx, float* out_buf,
+                               int64_t out_capacity, int64_t* out_shape,
+                               int out_shape_cap, int* out_ndim) {
+  if (!pred) {
+    set_error("null predictor");
+    return -1;
+  }
+  Predictor* p = static_cast<Predictor*>(pred);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int64_t count = -1;
+  do {
+    PyObject* run = PyDict_GetItemString(p->helpers, "_run_f32");
+    int64_t n_el = 1;
+    for (int i = 0; i < ndim; ++i) n_el *= shape[i];
+    PyObject* buf = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(data),
+        static_cast<Py_ssize_t>(n_el * sizeof(float)));
+    PyObject* shp = PyList_New(ndim);
+    for (int i = 0; i < ndim; ++i)
+      PyList_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+    PyObject* name;
+    if (input_name) {
+      name = PyUnicode_FromString(input_name);
+    } else {
+      PyObject* feeds = PyDict_GetItemString(p->state, "feeds");
+      name = PyList_GetItem(feeds, 0);
+      Py_INCREF(name);
+    }
+    PyObject* res = PyObject_CallFunctionObjArgs(
+        run, p->state, name, buf, shp,
+        PyLong_FromLong(out_idx), nullptr);
+    Py_DECREF(buf);
+    Py_DECREF(shp);
+    Py_DECREF(name);
+    if (!res) {
+      set_error_from_python();
+      break;
+    }
+    PyObject* out_bytes = PyTuple_GetItem(res, 0);
+    PyObject* out_shp = PyTuple_GetItem(res, 1);
+    int od = static_cast<int>(PyList_Size(out_shp));
+    if (out_ndim) *out_ndim = od;
+    count = 1;
+    for (int i = 0; i < od; ++i) {
+      int64_t d = PyLong_AsLongLong(PyList_GetItem(out_shp, i));
+      count *= d;
+      if (out_shape && i < out_shape_cap) out_shape[i] = d;
+    }
+    if (out_buf && out_capacity >= count) {
+      memcpy(out_buf, PyBytes_AsString(out_bytes),
+             static_cast<size_t>(count) * sizeof(float));
+    }
+    Py_DECREF(res);
+  } while (false);
+  PyGILState_Release(g);
+  return count;
+}
+
+void ptpu_predictor_destroy(void* pred) {
+  if (!pred) return;
+  Predictor* p = static_cast<Predictor*>(pred);
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_XDECREF(p->state);
+  Py_XDECREF(p->helpers);
+  PyGILState_Release(g);
+  // NB: we never finalize the interpreter — other predictors (or the
+  // embedding application's own Python use) may still be live.
+  delete p;
+}
+
+}  // extern "C"
