@@ -748,6 +748,10 @@ def bench_flash_attention(on_tpu):
             'xla %.2fms (%.2fx) engaged=%s' % (
                 B, T, B * H * T // 1024, row['pallas_ms_per_step'],
                 row['xla_ms_per_step'], row['speedup'], row['engaged']))
+    # VERDICT r4 #5 soundness contract, checked in the artifact itself
+    out['policy_sound'] = all(
+        (r['speedup'] >= 1.0 if r['engaged'] else r['speedup'] <= 1.05)
+        for r in out.values() if isinstance(r, dict))
     return out
 
 
