@@ -479,13 +479,20 @@ def _time_attn_fwd_bwd(attn, q, k, v, chain, trials=3):
 
     s = chained(q, k, v)
     float(s[1])                      # compile + drain
-    best = 1e9
-    for _ in range(trials):
+    times = []
+    for t in range(trials):
+        # DISTINCT inputs per trial: identical buffers can hit the
+        # tunnel's dispatch memoization and report a bogus fast trial,
+        # which min-of-trials would then latch onto (seen as an
+        # impossible 0.5x row in the r5 engagement table). Median over
+        # distinct-input trials is robust in both directions.
+        scale = jnp.asarray(1.0001 + 1e-4 * t, q.dtype)
         t0 = time.perf_counter()
-        s = chained(q * jnp.asarray(1.0001, q.dtype), k, v)
+        s = chained(q * scale, k, v)
         float(s[1])
-        best = min(best, (time.perf_counter() - t0) / chain)
-    return best * 1e3
+        times.append((time.perf_counter() - t0) / chain)
+    times.sort()
+    return times[len(times) // 2] * 1e3
 
 
 def bench_long_context(on_tpu):

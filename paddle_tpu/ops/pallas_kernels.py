@@ -448,7 +448,7 @@ _FLASH_MIN_T = 512
 _FLASH_MIN_ROWS = 64 * 1024  # B*H*T break-even (measured, v5e)
 
 
-def flash_attention(q, k, v, causal=True, block_q=1024, block_k=512,
+def flash_attention(q, k, v, causal=True, block_q=512, block_k=1024,
                     interpret=None, force=None):
     """Blockwise attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
 
@@ -463,8 +463,8 @@ def flash_attention(q, k, v, causal=True, block_q=1024, block_k=512,
                                     interpret, force)[0]
 
 
-def flash_attention_with_lse(q, k, v, causal=True, block_q=1024,
-                             block_k=512, interpret=None, force=None):
+def flash_attention_with_lse(q, k, v, causal=True, block_q=512,
+                             block_k=1024, interpret=None, force=None):
     """flash_attention that also returns per-row logsumexp [B, H, T].
 
     This is the ring-attention building block: each device computes its
