@@ -176,8 +176,10 @@ def _resnet_traffic_ledger(batch, ips, hbm_gbps=819.0):
 
 
 def bench_se_resnext(on_tpu):
-    """SE-ResNeXt-50 (BASELINE config) through the fluid path."""
-    batch = 64 if on_tpu else 2
+    """SE-ResNeXt-50 (BASELINE config) through the fluid path. Batch
+    128 from the r5 sweep: 996 img/s @64, 1299 @128, 1304 @256 —
+    the knee is at 128."""
+    batch = 128 if on_tpu else 2
     warmup, steps = (3, 20) if on_tpu else (1, 2)
     ips, last = _bench_image_model('se_resnext', batch, warmup, steps,
                                    on_tpu)
@@ -209,9 +211,11 @@ def bench_machine_translation(on_tpu):
 
 
 def bench_lstm(on_tpu):
+    """Batch 256 from the r5 sweep: 454k words/s @64, 470k @128,
+    593k @256, 597k @512 — the knee is at 256."""
     import jax
     import paddle_tpu.fluid as fluid
-    batch = 64 if on_tpu else 4
+    batch = 256 if on_tpu else 4
     warmup, steps = (3, 20) if on_tpu else (1, 2)
     main, startup, loss, feed = _build_model('stacked_dynamic_lstm',
                                              batch)[:4]
