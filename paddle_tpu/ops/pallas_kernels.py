@@ -448,7 +448,7 @@ _FLASH_MIN_T = 512
 _FLASH_MIN_ROWS = 64 * 1024  # B*H*T break-even (measured, v5e)
 
 
-def flash_attention(q, k, v, causal=True, block_q=512, block_k=1024,
+def flash_attention(q, k, v, causal=True, block_q=None, block_k=None,
                     interpret=None, force=None):
     """Blockwise attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
 
@@ -463,8 +463,8 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=1024,
                                     interpret, force)[0]
 
 
-def flash_attention_with_lse(q, k, v, causal=True, block_q=512,
-                             block_k=1024, interpret=None, force=None):
+def flash_attention_with_lse(q, k, v, causal=True, block_q=None,
+                             block_k=None, interpret=None, force=None):
     """flash_attention that also returns per-row logsumexp [B, H, T].
 
     This is the ring-attention building block: each device computes its
@@ -476,6 +476,13 @@ def flash_attention_with_lse(q, k, v, causal=True, block_q=512,
     B, T, H = q.shape[0], q.shape[1], q.shape[2]
     if interpret is None:
         interpret = False
+    # dtype-aware default blocks (r5 full-backward sweep, PERF.md):
+    # bf16 halves VMEM per block, so 1024x1024 fits and wins ~5%;
+    # f32 1024x1024 exceeds the VMEM scoped limit -> 512/1024
+    if block_q is None:
+        block_q = 1024 if q.dtype == jnp.bfloat16 else 512
+    if block_k is None:
+        block_k = 1024
     work = B * H * T
     use_pallas = _HAS_PALLAS and (interpret or (
         _on_tpu() and T >= _FLASH_MIN_T and work >= _FLASH_MIN_ROWS))
