@@ -514,12 +514,20 @@ def _merge_shared_muls(block, ops):
     return out
 
 
+# op input slots whose VALUES define shapes: feeds consumed only through
+# these are bound statically at trace time (part of the jit cache key) —
+# the TPU analog of the reference's runtime shape tensors
+SHAPE_INPUT_SLOTS = frozenset({('reshape', 'Shape')})
+
+
 def lower_block(program, block, feed_names, fetch_names, state_in_names,
-                state_out_names, dynamic=False):
+                state_out_names, dynamic=False, static_env=None):
     """Build ``fn(feeds, state) -> (fetches, new_state)`` for jit.
 
     ``feeds``/``state`` are dicts name->array (SequenceTensor allowed).
     ``state`` includes the PRNG key under ``RNG_KEY``.
+    ``static_env`` binds names to CONCRETE numpy values baked into the
+    trace (shape-like feeds; see SHAPE_INPUT_SLOTS).
     """
     ops = list(block.ops)
     marker_idx = _find_marker(ops)
@@ -533,6 +541,8 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
 
     def fn(feeds, state):
         env = {}
+        if static_env:
+            env.update(static_env)
         env.update(state)
         env.update(feeds)
         if marker_idx < 0:

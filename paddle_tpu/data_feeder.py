@@ -98,9 +98,29 @@ class DataFeeder(object):
     def decorate_reader(self, reader, multi_devices=False,
                         num_places=None, drop_last=True):
         """Wrap a batch reader so it yields ready feed dicts.
-        Parity: data_feeder.py::DataFeeder.decorate_reader (the
-        multi-device split is unnecessary here — the SPMD executor shards
-        the full batch over the mesh)."""
+        Parity: data_feeder.py::DataFeeder.decorate_reader. The
+        per-device split itself is unnecessary here — the SPMD executor
+        shards the full batch over the mesh — but divisibility still
+        matters: with multi_devices, batches whose size doesn't divide
+        the device count are dropped (drop_last=True, the reference's
+        behavior of discarding the incomplete tail) or raise
+        (drop_last=False, mirroring the reference ValueError)."""
+        if multi_devices:
+            import jax
+            n = int(num_places or jax.device_count())
+
+            def __reader_creator__():
+                for item in reader():
+                    if len(item) % n != 0:
+                        if drop_last:
+                            continue
+                        raise ValueError(
+                            "The data batch size %d cannot be evenly "
+                            "split over the %d devices; use "
+                            "drop_last=True" % (len(item), n))
+                    yield self.feed(item)
+            return __reader_creator__
+
         def __reader_creator__():
             for item in reader():
                 yield self.feed(item)

@@ -453,21 +453,53 @@ class Executor(object):
                        dynamic=False, consume_readers=True):
         """Shared lowering preamble (run / cost_analysis /
         ParallelExecutor): program-reader batch injection, fetch-name
-        normalization, feed preparation, persistable-state name union
-        with the PRNG key. Analysis paths pass consume_readers=False
-        so they PEEK (no training batch is dropped)."""
+        normalization, feed preparation, shape-feed extraction,
+        persistable-state name union with the PRNG key. Analysis paths
+        pass consume_readers=False so they PEEK (no training batch is
+        dropped). Returns a 5-tuple ending with ``static_env`` — feeds
+        consumed only through shape-defining slots, bound statically at
+        trace time (their values must join any jit cache key)."""
         feed = self._pull_program_readers(program, feed, scope,
                                           consume=consume_readers)
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
         feed = self._prepare_feed(program, feed, dynamic=dynamic)
+        static_env = self._extract_static_feeds(program, feed)
         state_in, state_out = self._state_names(program, scope)
         if scope.find_var(RNG_KEY) is None:
             scope.set_var(RNG_KEY,
                           jax.random.PRNGKey(program.random_seed or 0))
         state_in = sorted(set(state_in) | {RNG_KEY})
         state_out = sorted(set(state_out) | {RNG_KEY})
-        return fetch_names, feed, state_in, state_out
+        return fetch_names, feed, state_in, state_out, static_env
+
+    def _extract_static_feeds(self, program, feed):
+        """Pop feeds consumed ONLY through shape-defining input slots
+        (lowering.SHAPE_INPUT_SLOTS) and return them as concrete numpy
+        values to bake into the trace — the TPU analog of the
+        reference's runtime shape tensors (e.g. reshape's Shape input).
+        Their values join the program-cache key."""
+        memo = program.__dict__.setdefault('_shape_feed_memo', {})
+        fp = program.fingerprint()
+        names = memo.get(fp)
+        if names is None:
+            shape_only, data_used = set(), set()
+            for blk in program.blocks:
+                for op in blk.ops:
+                    for slot, vals in (op.inputs or {}).items():
+                        vlist = vals if isinstance(vals, (list, tuple)) \
+                            else [vals]
+                        for v in vlist:
+                            n = getattr(v, 'name', v)
+                            if (op.type, slot) in lowering.SHAPE_INPUT_SLOTS:
+                                shape_only.add(n)
+                            else:
+                                data_used.add(n)
+            names = memo[fp] = frozenset(shape_only - data_used)
+        static_env = {}
+        for n in names & set(feed.keys()):
+            static_env[n] = np.asarray(as_numpy(feed.pop(n)))
+        return static_env
 
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name='feed', fetch_var_name='fetch', scope=None,
@@ -486,7 +518,7 @@ class Executor(object):
         if dynamic is None:
             dynamic = _is_dynamic_program(program)
             program._dynamic_memo[program.fingerprint()] = dynamic
-        fetch_names, feed, state_in_names, state_out_names = \
+        fetch_names, feed, state_in_names, state_out_names, static_env = \
             self._prep_lowering(program, feed, fetch_list, scope,
                                 dynamic=dynamic)
 
@@ -496,6 +528,7 @@ class Executor(object):
         profiling = _prof.op_profiling_enabled()
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
+               tuple(sorted((n, v.tobytes()) for n, v in static_env.items())),
                tuple(fetch_names), tuple(state_in_names),
                tuple(state_out_names), guard, profiling,
                lowering.MERGE_SHARED_MULS[0])
@@ -505,7 +538,7 @@ class Executor(object):
             fn = lower_block(lower_prog, lower_prog.global_block(),
                              sorted(feed.keys()), fetch_names,
                              state_in_names, state_out_names,
-                             dynamic=dynamic)
+                             dynamic=dynamic, static_env=static_env)
             if profiling or dynamic:
                 # Per-op profiling and dynamic (beam-decode) programs run
                 # UN-jitted: the lowering executes op by op on the device
@@ -551,13 +584,14 @@ class Executor(object):
         whole block is ONE XLA program so the ledger is the natural
         analog)."""
         scope = scope or global_scope()
-        fetch_names, feed, state_in_names, state_out_names = \
+        fetch_names, feed, state_in_names, state_out_names, static_env = \
             self._prep_lowering(program, feed, fetch_list, scope,
                                 consume_readers=False)
         lower_prog = self._maybe_prune(program, fetch_names)
         fn = lower_block(lower_prog, lower_prog.global_block(),
                          sorted(feed.keys()), fetch_names,
-                         state_in_names, state_out_names)
+                         state_in_names, state_out_names,
+                         static_env=static_env)
         state = {n: scope.raw(n) for n in state_in_names}
         comp = jax.jit(fn).lower(feed, state).compile()
         ca = comp.cost_analysis()

@@ -94,7 +94,12 @@ def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
     helper.append_op(type='print', inputs={'X': input},
                      outputs={'Out': out},
                      attrs={'first_n': first_n, 'summarize': summarize,
-                            'message': message or ""})
+                            'message': message or "",
+                            'print_tensor_name': print_tensor_name,
+                            'print_tensor_type': print_tensor_type,
+                            'print_tensor_shape': print_tensor_shape,
+                            'print_tensor_lod': print_tensor_lod,
+                            'print_phase': print_phase})
     return out
 
 
@@ -668,6 +673,12 @@ class DynamicRNN(object):
 
     def memory(self, init=None, shape=None, value=0.0, dtype='float32',
                need_reorder=False):
+        """``need_reorder`` is a design no-op here: the reference sorts
+        sequences by length (lod_rank_table) so an external ``init``
+        must be re-ordered to match (control_flow.py:1442-1456); this
+        DynamicRNN scans mask-padded batches in ORIGINAL batch order,
+        so ``init`` rows already align with their sequences for either
+        flag value."""
         if self.status != DynamicRNN.IN_RNN:
             raise ValueError("memory must be invoked inside rnn.block()")
         pre = self.helper.create_variable(

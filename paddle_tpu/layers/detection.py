@@ -70,8 +70,16 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
               variance=None, flip=False, clip=False, steps=None,
               offset=0.5, name=None):
     helper = LayerHelper("prior_box", name=name)
-    box = helper.create_tmp_variable(dtype=input.dtype)
-    var = helper.create_tmp_variable(dtype=input.dtype)
+    # static output shape [H*W*P, 4] when the feature map shape is known
+    # (P from the shared kernel-side counting rule)
+    from ..ops.detection_ops import priors_per_cell
+    shape = None
+    in_shape = tuple(getattr(input, 'shape', ()) or ())
+    if len(in_shape) == 4 and in_shape[2] > 0 and in_shape[3] > 0:
+        p = priors_per_cell(min_sizes, max_sizes, aspect_ratios, flip)
+        shape = (in_shape[2] * in_shape[3] * p, 4)
+    box = helper.create_tmp_variable(dtype=input.dtype, shape=shape)
+    var = helper.create_tmp_variable(dtype=input.dtype, shape=shape)
     helper.append_op(
         type="prior_box",
         inputs={"Input": input, "Image": image},
@@ -91,8 +99,26 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                    offset=0.5, variance=None, flip=True, clip=False,
                    kernel_size=1, pad=0, stride=1, name=None,
                    min_max_aspect_ratios_order=False):
-    """Parity: layers/detection.py::multi_box_head (SSD heads)."""
+    """Parity: layers/detection.py::multi_box_head (SSD heads).
+
+    ``steps`` is shorthand for equal ``step_w``/``step_h`` per input
+    (reference detection.py:847-853). ``min_max_aspect_ratios_order`` is
+    not a knob this reference version has (its prior_box op always emits
+    min, ratios, max order) — only the default False is supported.
+    """
     helper = LayerHelper("multi_box_head", name=name)
+    if min_max_aspect_ratios_order:
+        raise NotImplementedError(
+            "min_max_aspect_ratios_order=True is not part of the "
+            "reference surface being rebuilt (prior_box emits the "
+            "fixed min/ratios/max order)")
+    if steps is not None:
+        if not isinstance(steps, (list, tuple)) or len(steps) != len(inputs):
+            raise ValueError(
+                "steps should be list or tuple, and the length of inputs "
+                "and steps should be the same.")
+        step_w = steps
+        step_h = steps
     if min_sizes is None:
         num_layer = len(inputs)
         min_sizes = []
@@ -121,25 +147,23 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                               step_h[i] if step_h else 0.0], offset)
         boxes.append(box)
         vars_.append(var)
-        num_boxes = len(min_size) * len(aspect_ratio)
-        if max_size:
-            num_boxes += len(max_size)
-        if flip:
-            num_boxes += len(min_size) * (len(aspect_ratio) - 1 if 1.0 in
-                                          aspect_ratio else
-                                          len(aspect_ratio))
+        # conv widths must agree with the kernel's per-cell enumeration
+        # (the reference reads box.shape[2] instead, detection.py:856;
+        # our priors are emitted flattened)
+        from ..ops.detection_ops import priors_per_cell
+        num_boxes = priors_per_cell(min_size, max_size, aspect_ratio, flip)
         mbox_loc = nn.conv2d(input=ipt, num_filters=num_boxes * 4,
                              filter_size=kernel_size, padding=pad,
                              stride=stride)
         loc = nn.transpose(mbox_loc, perm=[0, 2, 3, 1])
-        locs.append(nn.reshape(loc, shape=(loc.shape[0], -1, 4)))
+        # 0 = copy the (possibly symbolic -1) batch dim
+        locs.append(nn.reshape(loc, shape=(0, -1, 4)))
         mbox_conf = nn.conv2d(input=ipt,
                               num_filters=num_boxes * num_classes,
                               filter_size=kernel_size, padding=pad,
                               stride=stride)
         conf = nn.transpose(mbox_conf, perm=[0, 2, 3, 1])
-        confs.append(nn.reshape(conf,
-                                shape=(conf.shape[0], -1, num_classes)))
+        confs.append(nn.reshape(conf, shape=(0, -1, num_classes)))
 
     mbox_locs_concat = tensor.concat(locs, axis=1)
     mbox_confs_concat = tensor.concat(confs, axis=1)
@@ -192,20 +216,36 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              conf_loss_weight=1.0, match_type='per_prediction',
              mining_type='max_negative', normalize=True, sample_size=None):
     """Composite SSD loss built from matching + target assign + smooth-l1 +
-    softmax xent (parity: layers/detection.py::ssd_loss)."""
+    softmax xent (parity: layers/detection.py::ssd_loss).
+
+    ``overlap_threshold`` drives the per-prediction extra-matching pass
+    (the reference passes it to bipartite_match, detection.py:472-473);
+    ``prior_box_var`` scales the encoded regression targets (box_coder
+    encode variances). ``neg_overlap``/``sample_size`` are accepted for
+    signature parity: the reference wires ``neg_pos_ratio`` into
+    mine_hard_examples' neg_dist_threshold slot (detection.py:508 — with
+    IOU dists <= 1 the filter never fires), so negative mining is
+    effectively by-top-conf-loss there too.
+    """
+    if mining_type != 'max_negative':
+        # reference contract (layers/detection.py:465-466)
+        raise ValueError("Only support mining_type == max_negative now.")
     helper = LayerHelper('ssd_loss', **{})
     iou = iou_similarity(x=gt_box, y=prior_box)
     matched_indices, matched_dist = bipartite_match(iou, match_type,
-                                                    neg_overlap)
+                                                    overlap_threshold)
     loss = helper.create_tmp_variable(dtype=location.dtype,
                                       shape=(location.shape[0], 1))
+    inputs = {'Location': location, 'Confidence': confidence,
+              'GTBox': gt_box, 'GTLabel': gt_label,
+              'PriorBox': prior_box,
+              'MatchIndices': matched_indices,
+              'MatchDist': matched_dist}
+    if prior_box_var is not None:
+        inputs['PriorBoxVar'] = prior_box_var
     helper.append_op(
         type='ssd_loss_fused',
-        inputs={'Location': location, 'Confidence': confidence,
-                'GTBox': gt_box, 'GTLabel': gt_label,
-                'PriorBox': prior_box,
-                'MatchIndices': matched_indices,
-                'MatchDist': matched_dist},
+        inputs=inputs,
         attrs={'background_label': background_label,
                'neg_pos_ratio': neg_pos_ratio,
                'loc_loss_weight': loc_loss_weight,
@@ -219,6 +259,18 @@ def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.3, evaluate_difficult=True,
                   has_state=None, input_states=None, out_states=None,
                   ap_version='integral'):
+    """Per-batch mAP in-XLA. The reference op's cross-batch Accum* LoD
+    states (``has_state``/``input_states``/``out_states``) are design-
+    superseded: streaming accumulation lives host-side in
+    evaluator.DetectionMAP / metrics.DetectionMAP (DetectionMAPState) —
+    ragged cross-batch LoD state cannot live in a fixed-shape XLA
+    program. Passing states here warns once and computes per-batch mAP."""
+    if input_states is not None or out_states is not None:
+        import warnings
+        warnings.warn(
+            "detection_map input_states/out_states are superseded by the "
+            "host-side DetectionMAP evaluator state; returning per-batch "
+            "mAP", stacklevel=2)
     helper = LayerHelper("detection_map", **{})
     map_out = helper.create_tmp_variable(dtype='float32', shape=(1,))
     helper.append_op(

@@ -489,7 +489,16 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
 
 
 def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):
+    """``actual_shape`` overrides ``shape`` (reference nn.py:3441-3529:
+    the Shape input wins at runtime). On the static-shape XLA path a
+    Variable actual_shape is lowered as a STATIC feed: the Executor binds
+    its value at trace time (part of the jit cache key) — the TPU analog
+    of the reference's runtime shape tensor. A mid-graph computed
+    actual_shape (not a feed) raises at lowering."""
     helper = LayerHelper("reshape", name=name, act=act)
+    if actual_shape is not None and not hasattr(actual_shape, 'name'):
+        # python list/tuple/ndarray: a fully static override
+        shape = [int(s) for s in actual_shape]
     new_shape = []
     for i, s in enumerate(shape):
         if s == 0:
@@ -503,7 +512,10 @@ def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):
         if all(d >= 0 for d in x.shape) and known:
             new_shape[idx] = total // known
     out = helper.create_tmp_variable(dtype=x.dtype, shape=tuple(new_shape))
-    helper.append_op(type="reshape", inputs={"X": x},
+    inputs = {"X": x}
+    if actual_shape is not None and hasattr(actual_shape, 'name'):
+        inputs["Shape"] = actual_shape
+    helper.append_op(type="reshape", inputs=inputs,
                      attrs={"shape": list(shape)}, outputs={"Out": out})
     return helper.append_activation(out)
 
@@ -794,9 +806,13 @@ def nce(input, label, num_total_classes, sample_weight=None,
     sample_labels = helper.create_tmp_variable(dtype='int64',
                                                stop_gradient=True)
     num_neg_samples = 10 if num_neg_samples is None else int(num_neg_samples)
+    inputs = {'Input': input, 'Label': label, 'Weight': w, 'Bias': b}
+    if sample_weight is not None:
+        # per-example loss weight (reference nce layer threads it as the
+        # SampleWeight input, nn.py:2966; nce_op.h scales each row's cost)
+        inputs['SampleWeight'] = sample_weight
     helper.append_op(type='nce',
-                     inputs={'Input': input, 'Label': label, 'Weight': w,
-                             'Bias': b},
+                     inputs=inputs,
                      outputs={'Cost': cost, 'SampleLogits': sample_logits,
                               'SampleLabels': sample_labels},
                      attrs={'num_total_classes': int(num_total_classes),

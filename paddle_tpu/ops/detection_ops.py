@@ -17,6 +17,21 @@ from .common import unwrap
 _NEG = -1e9
 
 
+def priors_per_cell(min_sizes, max_sizes, aspect_ratios, flip):
+    """Per-cell prior-box count. The ONE place that mirrors
+    _prior_box's whs enumeration (implicit leading 1.0 ratio, non-1
+    ratios once each plus flipped, one sqrt(min*max) box per min/max
+    pair) — the layer shapes (prior_box, multi_box_head conv widths)
+    derive from here, and the kernel asserts against it."""
+    per_ar = 1
+    for ar in (aspect_ratios or [1.0]):
+        if abs(float(ar) - 1.0) < 1e-6:
+            continue
+        per_ar += 2 if flip else 1
+    n_min = len(list(min_sizes))
+    return n_min * per_ar + min(len(list(max_sizes or [])), n_min)
+
+
 # ---- prior box ------------------------------------------------------------------
 @register_kernel('prior_box')
 def _prior_box(ctx):
@@ -59,6 +74,8 @@ def _prior_box(ctx):
         if i < len(max_sizes):
             s = (m * max_sizes[i]) ** 0.5
             whs.append((s, s))
+    assert len(whs) == priors_per_cell(min_sizes, max_sizes, ars, flip), \
+        "prior enumeration diverged from priors_per_cell"
     whs = jnp.asarray(whs, jnp.float32)  # [P, 2]
 
     cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
@@ -367,9 +384,15 @@ def _ssd_loss_fused(ctx):
     pos = (match >= 0).astype(jnp.float32)
 
     # encode matched gt against priors (the loc regression target);
-    # SSD default variances, as the ssd_loss layer does not thread
-    # prior_box_var into the fused op
-    var = jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32)
+    # PriorBoxVar scales the encoding like box_coder's encode path
+    # (SSD default variances when the layer passes none)
+    if ctx.has_input('PriorBoxVar'):
+        var = unwrap(ctx.input('PriorBoxVar'))
+        if var.ndim == 1:
+            var = jnp.broadcast_to(var, prior.shape)
+        var = var[None]                          # [1, P, 4]
+    else:
+        var = jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32)
     tgt = _encode_center_size(matched_gt, prior[None], var)
 
     d = jnp.abs(loc - tgt)

@@ -425,7 +425,12 @@ def _nce(ctx):
     pos_loss = -jax.nn.log_sigmoid(pos_logit - jnp.log(num_neg * p_noise))
     neg_loss = -jnp.sum(jax.nn.log_sigmoid(
         -(neg_logit - jnp.log(num_neg * p_noise))), -1, keepdims=True)
-    ctx.set_output('Cost', pos_loss + neg_loss)
+    cost = pos_loss + neg_loss
+    if ctx.has_input('SampleWeight'):
+        # nce_op.h: sample_weight[i] scales example i's whole cost row
+        sw = unwrap(ctx.input('SampleWeight')).reshape((-1, 1))
+        cost = cost * sw.astype(cost.dtype)
+    ctx.set_output('Cost', cost)
     if ctx.output_names('SampleLogits'):
         ctx.set_output('SampleLogits', neg_logit)
     if ctx.output_names('SampleLabels'):

@@ -6,6 +6,7 @@ gaussian_random,lookup_table,...}_op.*
 """
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.registry import register_kernel
 from ..core.lowering import runtime_dtype
@@ -128,7 +129,19 @@ def _split(ctx):
 @register_kernel('reshape')
 def _reshape(ctx):
     x = unwrap(ctx.input('X'))
-    shape = list(ctx.attr('shape'))
+    if ctx.has_input('Shape'):
+        # runtime Shape input (reference reshape_op.cc: wins over the
+        # attr). Static-shape design: the value must be concrete at
+        # trace time — the Executor binds shape-like feeds statically.
+        sval = unwrap(ctx.input('Shape'))
+        if isinstance(sval, jax.core.Tracer):
+            raise NotImplementedError(
+                "reshape(actual_shape=...) needs a trace-time-static "
+                "shape; feed the shape tensor directly (the Executor "
+                "binds shape-like feeds statically) or pass shape=")
+        shape = [int(s) for s in np.asarray(sval).ravel()]
+    else:
+        shape = list(ctx.attr('shape'))
     # fluid semantics: 0 means copy input dim; -1 infers
     for i, s in enumerate(shape):
         if s == 0:
@@ -332,8 +345,56 @@ def _arg_min(ctx):
 
 @register_kernel('print')
 def _print(ctx):
-    # Parity: operators/print_op (host callback avoided; debug via fetch).
+    """Parity: operators/print_op.cc TensorPrint — a real host-side print
+    via jax.debug.callback (fires per execution, also under jit).
+    print_phase='backward' is accepted but grad printing is not wired:
+    the fused-backward design has no per-op grad stream to tap; use a
+    fetch on the grad var instead."""
     x = ctx.input('X')
+    val = unwrap(x)
+    msg = ctx.attr('message', '') or ''
+    first_n = int(ctx.attr('first_n', -1) or -1)
+    summarize = int(ctx.attr('summarize', -1) or -1)
+    show_name = bool(ctx.attr('print_tensor_name', True))
+    show_type = bool(ctx.attr('print_tensor_type', True))
+    show_shape = bool(ctx.attr('print_tensor_shape', True))
+    show_lod = bool(ctx.attr('print_tensor_lod', True))
+    phase = str(ctx.attr('print_phase', 'both') or 'both').lower()
+    var_name = (ctx.op.inputs.get('X') or ['?'])[0]
+    var_name = getattr(var_name, 'name', var_name)
+    lengths = getattr(x, 'lengths', None)
+    if phase in ('forward', 'both'):
+        # counter lives on THIS op instance (first_n is per-op and dies
+        # with the program, like the reference op's times_ member)
+        count = ctx.op.__dict__.setdefault('_print_count', [0])
+
+        def _emit(arr, lens=None):
+            # reference print_op.cc: only a POSITIVE first_n limits
+            if first_n > 0 and count[0] >= first_n:
+                return
+            count[0] += 1
+            parts = [msg] if msg else []
+            if show_name:
+                parts.append("Tensor[%s]" % var_name)
+            if show_shape:
+                parts.append("shape: %s" % (tuple(arr.shape),))
+            if show_type:
+                parts.append("dtype: %s" % arr.dtype)
+            if show_lod and lens is not None:
+                parts.append("lod: %s" % (np.asarray(lens).tolist(),))
+            flat = np.asarray(arr).ravel()
+            if summarize >= 0:
+                flat = flat[:summarize]
+            parts.append("data: %s" % np.array2string(flat, threshold=20))
+            import sys
+            print("  ".join(parts), file=sys.stderr)
+
+        if show_lod and lengths is not None:
+            # lengths may itself be traced — route it through the
+            # callback like the data
+            jax.debug.callback(_emit, val, lengths)
+        else:
+            jax.debug.callback(_emit, val)
     ctx.set_output('Out', x)
 
 

@@ -90,7 +90,7 @@ class ParallelExecutor(object):
         feed = feed if feed is not None else feed_dict or {}
         program = self._program
         scope = self._scope
-        fetch_names, feed, state_in, state_out = \
+        fetch_names, feed, state_in, state_out, static_env = \
             self._exe._prep_lowering(program, feed, fetch_list, scope)
 
         from ..executor import _spec
@@ -99,6 +99,8 @@ class ParallelExecutor(object):
         from ..core import lowering as _lowering_mod
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
+               tuple(sorted((n, v.tobytes())
+                            for n, v in static_env.items())),
                tuple(fetch_names), tuple(state_in), tuple(state_out),
                guard, _lowering_mod.MERGE_SHARED_MULS[0])
         multiproc = jax.process_count() > 1
@@ -112,7 +114,7 @@ class ParallelExecutor(object):
             from ..core import lowering as _lowering
             fn = lower_block(program, program.global_block(),
                              sorted(feed.keys()), fetch_names, state_in,
-                             state_out)
+                             state_out, static_env=static_env)
 
             def fn_with_mesh(feeds, state, _fn=fn):
                 # activations with Variable.sharding get a
@@ -192,7 +194,7 @@ class ParallelExecutor(object):
         device of the mesh."""
         program = self._program
         scope = self._scope
-        fetch_names, feed, state_in, state_out = \
+        fetch_names, feed, state_in, state_out, static_env = \
             self._exe._prep_lowering(program, feed, fetch_list, scope,
                                      consume_readers=False)
         # NB: lowers the FULL program (no pruning), mirroring
@@ -201,7 +203,7 @@ class ParallelExecutor(object):
         from ..core import lowering as _lowering
         fn = lower_block(program, program.global_block(),
                          sorted(feed.keys()), fetch_names, state_in,
-                         state_out)
+                         state_out, static_env=static_env)
 
         def fn_with_mesh(feeds, state, _fn=fn):
             with _lowering.sharding_mesh(self._mesh):

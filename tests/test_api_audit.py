@@ -1,0 +1,398 @@
+"""Accept-and-ignore audit (r5): parameters that used to be silently
+swallowed now either work or raise.
+
+Reference ground truth:
+- python/paddle/fluid/layers/nn.py:3441-3529 (reshape actual_shape)
+- python/paddle/fluid/layers/detection.py:350-565 (ssd_loss knobs)
+- python/paddle/fluid/layers/detection.py:677-900 (multi_box_head steps)
+- python/paddle/fluid/layers/nn.py:2905-2975 (nce SampleWeight)
+- paddle/fluid/operators/print_op.cc (Print really prints)
+- python/paddle/fluid/data_feeder.py decorate_reader drop_last
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _fresh():
+    return fluid.Program(), fluid.Program()
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+# ---- reshape actual_shape -------------------------------------------------------
+def test_reshape_actual_shape_variable_overrides_attr():
+    """Mirror of reference TestReshapeOpWithInputShape: the Shape input
+    wins over the shape attr ((6,5) -> (2,3,5), attr says (0,-1,5))."""
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[5], dtype='float32')
+        shp = layers.data(name='shp', shape=[3], dtype='int32',
+                          append_batch_size=False)
+        out = layers.reshape(x, shape=[0, -1, 5], actual_shape=shp)
+    exe = _exe()
+    exe.run(start)
+    xv = np.random.RandomState(0).rand(6, 5).astype('float32')
+    res, = exe.run(main, feed={'x': xv, 'shp': np.array([2, 3, 5], 'int32')},
+                   fetch_list=[out])
+    assert res.shape == (2, 3, 5)
+    np.testing.assert_allclose(res, xv.reshape(2, 3, 5))
+    # a NEW shape value retraces with the new static shape
+    res2, = exe.run(main, feed={'x': xv,
+                                'shp': np.array([3, 2, 5], 'int32')},
+                    fetch_list=[out])
+    assert res2.shape == (3, 2, 5)
+    np.testing.assert_allclose(res2, xv.reshape(3, 2, 5))
+
+
+def test_reshape_actual_shape_static_sequence():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[5], dtype='float32')
+        out = layers.reshape(x, shape=[0, -1, 5], actual_shape=(2, 3, 5))
+    assert tuple(out.shape) == (2, 3, 5)
+
+
+def test_reshape_actual_shape_grad_flows():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        w = layers.create_parameter(shape=[4, 5], dtype='float32',
+                                    name='audit_w')
+        shp = layers.data(name='shp', shape=[2], dtype='int32',
+                          append_batch_size=False)
+        out = layers.reshape(w, shape=[-1, 5], actual_shape=shp)
+        loss = layers.mean(layers.square(out))
+        fluid.backward.append_backward(loss)
+    exe = _exe()
+    exe.run(start)
+    g, wv = exe.run(main, feed={'shp': np.array([2, 10], 'int32')},
+                    fetch_list=['audit_w@GRAD', 'audit_w'])
+    np.testing.assert_allclose(np.asarray(g),
+                               2.0 * np.asarray(wv) / wv.size, rtol=1e-5)
+
+
+# ---- ssd_loss -------------------------------------------------------------------
+def _ssd_programs(use_pbv):
+    rng = np.random.RandomState(0)
+    P, C = 8, 4
+    prior = np.linspace(0.05, 0.9, P * 4).reshape(P, 4).astype('float32')
+    prior[:, 2:] = prior[:, :2] + 0.2
+    feed = {
+        'loc': rng.randn(2, P, 4).astype('float32') * 0.1,
+        'conf': rng.randn(2, P, C).astype('float32'),
+        'gb': prior[[1, 5]] + 0.01,
+        'gl': np.array([1, 2], np.int32),
+        'pb': prior,
+    }
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        lv = layers.data(name='loc', shape=[P, 4], dtype='float32')
+        cv = layers.data(name='conf', shape=[P, C], dtype='float32')
+        gb = layers.data(name='gb', shape=[4], dtype='float32')
+        gl = layers.data(name='gl', shape=[1], dtype='int32')
+        pb = layers.data(name='pb', shape=[4], dtype='float32')
+        kw = {}
+        if use_pbv:
+            pv = layers.data(name='pbv', shape=[4], dtype='float32')
+            feed['pbv'] = np.full((P, 4), 0.2, 'float32')
+            kw['prior_box_var'] = pv
+        loss = layers.detection.ssd_loss(lv, cv, gb, gl, pb, **kw)
+    return main, start, feed, loss
+
+
+def test_ssd_loss_prior_box_var_changes_loss():
+    exe = _exe()
+    vals = []
+    for use_pbv in (False, True):
+        main, start, feed, loss = _ssd_programs(use_pbv)
+        exe.run(start)
+        out, = exe.run(main, feed=feed, fetch_list=[loss])
+        out = np.asarray(out)
+        assert np.isfinite(out).all() and (out > 0).all()
+        vals.append(out)
+    # var=0.2 rescales the encoded regression targets vs the default
+    # [0.1, 0.1, 0.2, 0.2] -> different smooth-l1 loss
+    assert not np.allclose(vals[0], vals[1])
+
+
+def test_ssd_loss_overlap_threshold_reaches_matching():
+    """overlap_threshold feeds the per-prediction extra-matching pass.
+    Geometry: prior0 == gt (bipartite match); prior1 overlaps gt at IOU
+    0.6 -> extra-matched iff threshold <= 0.6; priors 2/3 are far away.
+    The extra positive changes both loc and conf loss."""
+    P, C = 4, 3
+    prior = np.array([[0.0, 0.0, 0.4, 0.4],
+                      [0.1, 0.0, 0.5, 0.4],     # IOU 0.6 with gt
+                      [0.6, 0.6, 0.9, 0.9],
+                      [0.7, 0.1, 0.9, 0.3]], 'float32')
+    feed = {
+        'loc': np.full((1, P, 4), 0.05, 'float32'),
+        'conf': np.tile(np.array([0.5, 1.5, -0.5], 'float32'),
+                        (1, P, 1)),
+        'gb': prior[[0]].copy(),
+        'gl': np.array([1], np.int32),
+        'pb': prior,
+    }
+    exe = _exe()
+    outs = {}
+    for thr in (0.5, 0.9):
+        main, start = _fresh()
+        with fluid.program_guard(main, start):
+            lv = layers.data(name='loc', shape=[P, 4], dtype='float32')
+            cv = layers.data(name='conf', shape=[P, C], dtype='float32')
+            gb = layers.data(name='gb', shape=[4], dtype='float32')
+            gl = layers.data(name='gl', shape=[1], dtype='int32')
+            pb = layers.data(name='pb', shape=[4], dtype='float32')
+            loss = layers.detection.ssd_loss(lv, cv, gb, gl, pb,
+                                             overlap_threshold=thr)
+        exe.run(start)
+        out, = exe.run(main, feed=feed, fetch_list=[loss])
+        outs[thr] = np.asarray(out)
+    assert not np.allclose(outs[0.5], outs[0.9]), outs
+
+
+def test_ssd_loss_rejects_hard_example_mining():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        P, C = 8, 4
+        lv = layers.data(name='loc', shape=[P, 4], dtype='float32')
+        cv = layers.data(name='conf', shape=[P, C], dtype='float32')
+        gb = layers.data(name='gb', shape=[4], dtype='float32')
+        gl = layers.data(name='gl', shape=[1], dtype='int32')
+        pb = layers.data(name='pb', shape=[4], dtype='float32')
+        with pytest.raises(ValueError, match='max_negative'):
+            layers.detection.ssd_loss(lv, cv, gb, gl, pb,
+                                      mining_type='hard_example')
+
+
+# ---- multi_box_head -------------------------------------------------------------
+def _mbh_feed(rng):
+    return {'img': rng.rand(2, 3, 64, 64).astype('float32'),
+            'f1': rng.rand(2, 8, 8, 8).astype('float32'),
+            'f2': rng.rand(2, 8, 4, 4).astype('float32'),
+            'f3': rng.rand(2, 8, 2, 2).astype('float32')}
+
+
+def _mbh_build(**kw):
+    img = layers.data(name='img', shape=[3, 64, 64], dtype='float32')
+    f1 = layers.data(name='f1', shape=[8, 8, 8], dtype='float32')
+    f2 = layers.data(name='f2', shape=[8, 4, 4], dtype='float32')
+    f3 = layers.data(name='f3', shape=[8, 2, 2], dtype='float32')
+    return layers.multi_box_head(
+        inputs=[f1, f2, f3], image=img, base_size=64, num_classes=3,
+        aspect_ratios=[[2.], [2.], [2.]], min_ratio=20, max_ratio=90, **kw)
+
+
+def test_multi_box_head_steps_position_priors():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        mbox, confs, box, var = _mbh_build(steps=[8.0, 16.0, 32.0])
+    exe = _exe()
+    exe.run(start)
+    b, l, c = exe.run(main, feed=_mbh_feed(np.random.RandomState(1)),
+                      fetch_list=[box, mbox, confs])
+    # loc/conf prediction counts match the prior count (was broken when
+    # num_boxes ignored the implicit 1.0 aspect ratio)
+    assert b.shape[0] == l.shape[1] == c.shape[1]
+    # steps=8 on the 8x8 map: first prior centered at (0+0.5)*8 = 4px
+    cx = (b[0, 0] + b[0, 2]) / 2 * 64
+    assert abs(cx - 4.0) < 1e-3
+
+
+def test_multi_box_head_flip_keeps_counts_consistent():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        mbox, confs, box, var = _mbh_build(flip=True)
+    exe = _exe()
+    exe.run(start)
+    b, l = exe.run(main, feed=_mbh_feed(np.random.RandomState(2)),
+                   fetch_list=[box, mbox])
+    assert b.shape[0] == l.shape[1]
+
+
+def test_multi_box_head_rejects_unknown_order_flag():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        with pytest.raises(NotImplementedError):
+            _mbh_build(min_max_aspect_ratios_order=True)
+
+
+def test_multi_box_head_steps_length_validated():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        with pytest.raises(ValueError):
+            _mbh_build(steps=[8.0])
+
+
+# ---- nce sample_weight ----------------------------------------------------------
+def test_nce_sample_weight_scales_cost():
+    from paddle_tpu.executor import Scope, scope_guard
+
+    def run_one(with_weight):
+        main, start = _fresh()
+        main.random_seed = 11           # same negative draws both runs
+        with fluid.program_guard(main, start):
+            inp = layers.data(name='inp', shape=[8], dtype='float32')
+            lbl = layers.data(name='lbl', shape=[1], dtype='int64')
+            kw = {}
+            if with_weight:
+                sw = layers.data(name='sw', shape=[1], dtype='float32')
+                kw['sample_weight'] = sw
+            cost = layers.nce(input=inp, label=lbl, num_total_classes=20,
+                              num_neg_samples=5, **kw)
+        exe = _exe()
+        with scope_guard(Scope()):      # fresh RNG key -> same negatives
+            exe.run(start)
+            rng = np.random.RandomState(3)
+            feed = {'inp': rng.rand(4, 8).astype('float32'),
+                    'lbl': np.array([[1], [2], [3], [4]], 'int64')}
+            if with_weight:
+                feed['sw'] = np.array([[2.0], [0.0], [1.0], [3.0]],
+                                      'float32')
+            out, = exe.run(main, feed=feed, fetch_list=[cost])
+        return np.asarray(out).ravel()
+
+    base = run_one(False)
+    weighted = run_one(True)
+    np.testing.assert_allclose(weighted, base * np.array([2.0, 0.0, 1.0, 3.0]),
+                               rtol=1e-5)
+
+
+# ---- Print ----------------------------------------------------------------------
+def test_print_emits_and_respects_first_n(capfd):
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[3], dtype='float32')
+        y = layers.Print(x, message='audit-print', first_n=2, summarize=3)
+        z = layers.scale(y, scale=2.0)
+    exe = _exe()
+    exe.run(start)
+    for i in range(4):
+        r, = exe.run(main, feed={'x': np.full((2, 3), i, 'float32')},
+                     fetch_list=[z])
+    np.testing.assert_allclose(np.asarray(r), 6.0)
+    err = capfd.readouterr().err
+    assert err.count('audit-print') == 2          # first_n honored
+    assert 'Tensor[x]' in err and 'shape: (2, 3)' in err
+
+
+def test_print_lod_tensor_under_jit(capfd):
+    """A Print on an LoD input must not crash under jit (the lengths
+    array is traced; it rides the debug callback like the data)."""
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[1], dtype='float32', lod_level=1)
+        y = layers.Print(x, message='lod-print')
+        s = layers.sequence_pool(y, pool_type='sum')
+    exe = _exe()
+    exe.run(start)
+    lt = fluid.create_lod_tensor(
+        np.arange(5, dtype='float32').reshape(5, 1), [[2, 3]],
+        fluid.CPUPlace())
+    r, = exe.run(main, feed={'x': lt}, fetch_list=[s])
+    np.testing.assert_allclose(np.asarray(r).ravel(), [1.0, 9.0])
+    err = capfd.readouterr().err
+    assert 'lod-print' in err and 'lod:' in err
+
+
+def test_print_first_n_nonpositive_always_prints(capfd):
+    """Reference print_op.cc: only a POSITIVE first_n limits output."""
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[2], dtype='float32')
+        y = layers.Print(x, message='always-print', first_n=0)
+    exe = _exe()
+    exe.run(start)
+    for _ in range(3):
+        exe.run(main, feed={'x': np.zeros((1, 2), 'float32')},
+                fetch_list=[y])
+    assert capfd.readouterr().err.count('always-print') == 3
+
+
+def test_reshape_actual_shape_through_parallel_executor():
+    """The static shape-feed extraction lives in the shared lowering
+    preamble, so ParallelExecutor programs get it too."""
+    import jax
+    n = jax.device_count()
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[6], dtype='float32')
+        shp = layers.data(name='shp', shape=[2], dtype='int32',
+                          append_batch_size=False)
+        o = layers.reshape(x, shape=[0, 6], actual_shape=shp)
+        loss = layers.mean(o)
+    exe = _exe()
+    exe.run(start)
+    pexe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                  main_program=main)
+    xv = np.random.RandomState(0).rand(2 * n, 6).astype('float32')
+    r, = pexe.run(fetch_list=[o.name],
+                  feed={'x': xv, 'shp': np.array([2 * n, 6], 'int32')})
+    np.testing.assert_allclose(np.asarray(r), xv, rtol=1e-6)
+
+
+def test_print_knobs_suppress_fields(capfd):
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[3], dtype='float32')
+        y = layers.Print(x, message='quiet-print', print_tensor_name=False,
+                         print_tensor_shape=False, print_tensor_type=False)
+    exe = _exe()
+    exe.run(start)
+    exe.run(main, feed={'x': np.zeros((1, 3), 'float32')}, fetch_list=[y])
+    err = capfd.readouterr().err
+    assert 'quiet-print' in err
+    line = [l for l in err.splitlines() if 'quiet-print' in l][0]
+    assert 'Tensor[' not in line and 'shape:' not in line \
+        and 'dtype:' not in line
+
+
+# ---- decorate_reader drop_last --------------------------------------------------
+def test_decorate_reader_multi_devices_drop_last():
+    n = 2           # pinned via num_places: device count independent
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[2], dtype='float32')
+    feeder = fluid.DataFeeder(feed_list=[x], place=fluid.CPUPlace())
+
+    def reader():
+        yield [(np.zeros(2, 'float32'),)] * n          # divisible
+        yield [(np.zeros(2, 'float32'),)] * (n + 1)    # not divisible
+
+    batches = list(feeder.decorate_reader(reader, multi_devices=True,
+                                          num_places=n)())
+    assert len(batches) == 1                            # tail dropped
+
+    strict = feeder.decorate_reader(reader, multi_devices=True,
+                                    num_places=n, drop_last=False)
+    with pytest.raises(ValueError, match='evenly'):
+        list(strict())
+
+
+def test_decorate_reader_single_device_passthrough():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[2], dtype='float32')
+    feeder = fluid.DataFeeder(feed_list=[x], place=fluid.CPUPlace())
+
+    def reader():
+        for _ in range(3):
+            yield [(np.zeros(2, 'float32'),)]
+
+    assert len(list(feeder.decorate_reader(reader)())) == 3
+
+
+# ---- detection_map states -------------------------------------------------------
+def test_detection_map_states_warn_once():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        det = layers.data(name='det', shape=[6], dtype='float32')
+        gt = layers.data(name='gt', shape=[5], dtype='float32')
+        st = layers.data(name='st', shape=[1], dtype='float32')
+        with pytest.warns(UserWarning, match='superseded'):
+            layers.detection.detection_map(det, gt, class_num=3,
+                                           input_states=[st])
