@@ -98,27 +98,29 @@ class DataFeeder(object):
     def decorate_reader(self, reader, multi_devices=False,
                         num_places=None, drop_last=True):
         """Wrap a batch reader so it yields ready feed dicts.
-        Parity: data_feeder.py::DataFeeder.decorate_reader. The
-        per-device split itself is unnecessary here — the SPMD executor
-        shards the full batch over the mesh — but divisibility still
-        matters: with multi_devices, batches whose size doesn't divide
-        the device count are dropped (drop_last=True, the reference's
-        behavior of discarding the incomplete tail) or raise
-        (drop_last=False, mirroring the reference ValueError)."""
+        Parity: data_feeder.py::DataFeeder.decorate_reader (:153-176) —
+        the reference groups ``num`` consecutive reader batches, one per
+        device, and feed_parallel's them; the SPMD executor takes ONE
+        mesh-sharded feed instead, so each group is concatenated into a
+        single super-batch (device i's shard = original batch i). The
+        trailing incomplete group is dropped (drop_last=True) or raises
+        the reference's ValueError (drop_last=False)."""
         if multi_devices:
             import jax
             n = int(num_places or jax.device_count())
 
             def __reader_creator__():
-                for item in reader():
-                    if len(item) % n != 0:
-                        if drop_last:
-                            continue
-                        raise ValueError(
-                            "The data batch size %d cannot be evenly "
-                            "split over the %d devices; use "
-                            "drop_last=True" % (len(item), n))
-                    yield self.feed(item)
+                group = []
+                for batch in reader():
+                    group.append(batch)
+                    if len(group) == n:
+                        yield self.feed([row for b in group for row in b])
+                        group = []
+                if not drop_last and group:
+                    raise ValueError(
+                        "The data batch which cannot fit for devices "
+                        "will be dropped is not implementation. Other "
+                        "strategies are not implemented")
             return __reader_creator__
 
         def __reader_creator__():

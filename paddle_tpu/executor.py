@@ -528,7 +528,8 @@ class Executor(object):
         profiling = _prof.op_profiling_enabled()
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
-               tuple(sorted((n, v.tobytes()) for n, v in static_env.items())),
+               tuple(sorted((n, v.dtype.str, v.shape, v.tobytes())
+                            for n, v in static_env.items())),
                tuple(fetch_names), tuple(state_in_names),
                tuple(state_out_names), guard, profiling,
                lowering.MERGE_SHARED_MULS[0])

@@ -17,11 +17,13 @@ from .tensor import assign, fill_constant, cast
 from . import ops as _ops
 
 __all__ = [
-    'split_lod_tensor', 'merge_lod_tensor', 'BlockGuard', 'While', 'Switch',
-    'lod_rank_table', 'max_sequence_len', 'lod_tensor_to_array',
-    'array_to_lod_tensor', 'increment', 'array_write', 'create_array',
-    'less_than', 'equal', 'array_read', 'array_length', 'IfElse',
-    'DynamicRNN', 'StaticRNN', 'reorder_lod_tensor_by_rank', 'ParallelDo',
+    'split_lod_tensor', 'merge_lod_tensor', 'BlockGuard',
+    'BlockGuardWithCompletion', 'StaticRNNMemoryLink', 'WhileGuard',
+    'While', 'Switch', 'lod_rank_table', 'max_sequence_len',
+    'lod_tensor_to_array', 'array_to_lod_tensor', 'increment',
+    'array_write', 'create_array', 'less_than', 'equal', 'array_read',
+    'shrink_memory', 'array_length', 'IfElse', 'DynamicRNN', 'StaticRNN',
+    'ConditionalBlock', 'reorder_lod_tensor_by_rank', 'ParallelDo',
     'Print', 'is_empty',
 ]
 
@@ -135,6 +137,21 @@ def array_read(array, i):
     helper.append_op(type='read_from_array',
                      inputs={'X': [array], 'I': [i]},
                      outputs={'Out': [out]})
+    return out
+
+
+def shrink_memory(x, i, table):
+    """Parity: control_flow.py::shrink_memory (shrink_rnn_memory op).
+    The reference trims the memory batch to the sequences still alive at
+    step ``i`` of the length-sorted rank table; the masked-scan design
+    keeps the full batch alive, so the op is the identity contract
+    (kernel: ops/control_flow_ops.py::_shrink_rnn_memory)."""
+    helper = LayerHelper('shrink_memory', **{})
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape,
+                                     lod_level=x.lod_level)
+    helper.append_op(type='shrink_rnn_memory',
+                     inputs={'X': [x], 'I': [i], 'RankTable': [table]},
+                     outputs={'Out': [out]}, attrs={})
     return out
 
 

@@ -6,6 +6,7 @@ box counts, masked invalids) instead of the reference's dynamic outputs.
 """
 from ..layer_helper import LayerHelper
 from ..framework import Variable
+from ..ops.detection_ops import priors_per_cell
 from . import nn, tensor, ops
 
 __all__ = ['prior_box', 'multi_box_head', 'bipartite_match',
@@ -72,7 +73,6 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
     helper = LayerHelper("prior_box", name=name)
     # static output shape [H*W*P, 4] when the feature map shape is known
     # (P from the shared kernel-side counting rule)
-    from ..ops.detection_ops import priors_per_cell
     shape = None
     in_shape = tuple(getattr(input, 'shape', ()) or ())
     if len(in_shape) == 4 and in_shape[2] > 0 and in_shape[3] > 0:
@@ -150,7 +150,6 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         # conv widths must agree with the kernel's per-cell enumeration
         # (the reference reads box.shape[2] instead, detection.py:856;
         # our priors are emitted flattened)
-        from ..ops.detection_ops import priors_per_cell
         num_boxes = priors_per_cell(min_size, max_size, aspect_ratio, flip)
         mbox_loc = nn.conv2d(input=ipt, num_filters=num_boxes * 4,
                              filter_size=kernel_size, padding=pad,
@@ -265,7 +264,8 @@ def detection_map(detect_res, label, class_num, background_label=0,
     evaluator.DetectionMAP / metrics.DetectionMAP (DetectionMAPState) —
     ragged cross-batch LoD state cannot live in a fixed-shape XLA
     program. Passing states here warns once and computes per-batch mAP."""
-    if input_states is not None or out_states is not None:
+    if (has_state is not None or input_states is not None
+            or out_states is not None):
         import warnings
         warnings.warn(
             "detection_map input_states/out_states are superseded by the "

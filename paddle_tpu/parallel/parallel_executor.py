@@ -99,7 +99,7 @@ class ParallelExecutor(object):
         from ..core import lowering as _lowering_mod
         key = (program.fingerprint(),
                tuple(sorted((n, _spec(v)) for n, v in feed.items())),
-               tuple(sorted((n, v.tobytes())
+               tuple(sorted((n, v.dtype.str, v.shape, v.tobytes())
                             for n, v in static_env.items())),
                tuple(fetch_names), tuple(state_in), tuple(state_out),
                guard, _lowering_mod.MERGE_SHARED_MULS[0])

@@ -352,7 +352,11 @@ def test_print_knobs_suppress_fields(capfd):
 
 
 # ---- decorate_reader drop_last --------------------------------------------------
-def test_decorate_reader_multi_devices_drop_last():
+def test_decorate_reader_multi_devices_groups_batches():
+    """Reference grouping semantics (data_feeder.py:158-174): num_places
+    consecutive reader batches form one multi-device feed (here: one
+    concatenated SPMD super-batch); the incomplete trailing group is
+    dropped, or raises with drop_last=False."""
     n = 2           # pinned via num_places: device count independent
     main, start = _fresh()
     with fluid.program_guard(main, start):
@@ -360,16 +364,18 @@ def test_decorate_reader_multi_devices_drop_last():
     feeder = fluid.DataFeeder(feed_list=[x], place=fluid.CPUPlace())
 
     def reader():
-        yield [(np.zeros(2, 'float32'),)] * n          # divisible
-        yield [(np.zeros(2, 'float32'),)] * (n + 1)    # not divisible
+        for i in range(3):                     # 3 batches of 4 rows
+            yield [(np.full(2, i, 'float32'),)] * 4
 
     batches = list(feeder.decorate_reader(reader, multi_devices=True,
                                           num_places=n)())
-    assert len(batches) == 1                            # tail dropped
+    # batches 0+1 grouped into one 8-row super-batch; batch 2 dropped
+    assert len(batches) == 1
+    assert np.asarray(batches[0]['x']).shape[0] == 8
 
     strict = feeder.decorate_reader(reader, multi_devices=True,
                                     num_places=n, drop_last=False)
-    with pytest.raises(ValueError, match='evenly'):
+    with pytest.raises(ValueError, match='dropped'):
         list(strict())
 
 
@@ -396,3 +402,24 @@ def test_detection_map_states_warn_once():
         with pytest.warns(UserWarning, match='superseded'):
             layers.detection.detection_map(det, gt, class_num=3,
                                            input_states=[st])
+
+
+# ---- shrink_memory layer (exported surface) ------------------------------------
+def test_shrink_memory_layer_identity_contract():
+    """Parity surface for control_flow.shrink_memory; the masked-scan
+    design keeps the full batch so the op is the identity."""
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[3], dtype='float32')
+        seq = layers.data(name='seq', shape=[1], dtype='float32',
+                          lod_level=1)
+        i = layers.zeros(shape=[1], dtype='int64')
+        table = layers.lod_rank_table(seq)
+        out = layers.shrink_memory(x, i, table)
+    exe = _exe()
+    exe.run(start)
+    xv = np.random.RandomState(0).rand(2, 3).astype('float32')
+    lt = fluid.create_lod_tensor(
+        np.zeros((5, 1), 'float32'), [[2, 3]], fluid.CPUPlace())
+    r, = exe.run(main, feed={'x': xv, 'seq': lt}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(r), xv)
