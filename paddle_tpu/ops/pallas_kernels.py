@@ -212,6 +212,34 @@ def _flash_pallas_call(q, k, v, causal, block_q, block_k, interpret):
     return on, lse
 
 
+def _bwd_p_ds(q, k, v, do, lse, delta, qi, kb, block_q, block_k, causal,
+              exp2):
+    """Shared backward recompute: normalised probs ``p`` and the score
+    cotangent ``ds = p * (dp - delta)`` for one (q-block, k-block) tile,
+    plus the softmax ``scale``. The ONE copy of the score/mask/prob
+    math used by all three backward kernels (two-pass dq, two-pass
+    dk/dv, merged) — they are selected at runtime, so their tile math
+    must never diverge."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    _exp = jnp.exp2 if exp2 else jnp.exp
+    sscale = scale * _LOG2E if exp2 else scale
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sscale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = _exp(s - (lse * _LOG2E if exp2 else lse))   # normalised probs
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [bq, bk]
+    ds = p * (dp - delta)
+    return p, ds, scale
+
+
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dq_ref, dq_scr, *, block_q, block_k, causal, n_kb,
                      exp2):
@@ -227,29 +255,10 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0]
         k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0]                          # [bq, 1] natural log
-        delta = delta_ref[0]                      # [bq, 1]
-        scale = 1.0 / math.sqrt(q.shape[-1])
-        _exp = jnp.exp2 if exp2 else jnp.exp
-        sscale = scale * _LOG2E if exp2 else scale
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sscale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = _exp(s - (lse * _LOG2E if exp2 else lse))  # normalised probs
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)   # [bq, bk]
-        ds = p * (dp - delta)
+        _, ds, scale = _bwd_p_ds(q_ref[0], k, v_ref[0], do_ref[0],
+                                 lse_ref[0], delta_ref[0], qi, kb,
+                                 block_q, block_k, causal, exp2)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -278,28 +287,10 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(live)
     def _compute():
         q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        scale = 1.0 / math.sqrt(q.shape[-1])
-        _exp = jnp.exp2 if exp2 else jnp.exp
-        sscale = scale * _LOG2E if exp2 else scale
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sscale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = _exp(s - (lse * _LOG2E if exp2 else lse))
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        p, ds, scale = _bwd_p_ds(q, k_ref[0], v_ref[0], do, lse_ref[0],
+                                 delta_ref[0], qi, kb, block_q, block_k,
+                                 causal, exp2)
         # p^T @ do and ds^T @ q via dim-0 contractions (no transposes)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -312,6 +303,107 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_dkvdq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr, *,
+                        block_q, block_k, causal, n_qb, exp2):
+    """Merged backward: ONE kv-major sweep computes dk/dv (VMEM
+    accumulators, as in _flash_dkv_kernel) AND the dq contribution of
+    this k block, written to a per-(kb) partial slab that XLA sums
+    afterwards. Saves the dq pass's full score/prob recomputation — one
+    of the two exp sweeps and two of the seven backward T^2 dots — at
+    the cost of an f32 [n_kb, T, D] partial buffer, so the caller only
+    routes here for small n_kb. Race-free by construction: every grid
+    step owns its dqp block exclusively (no output revisiting, which
+    Pallas leaves undefined across non-consecutive steps)."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = ((qi + 1) * block_q - 1 >= kb * block_k) if causal else (qi >= 0)
+
+    # dead diagonal blocks still own a dqp slab slot — zero it so the
+    # XLA sum sees defined content
+    dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
+        p, ds, scale = _bwd_p_ds(q, k, v_ref[0], do, lse_ref[0],
+                                 delta_ref[0], qi, kb, block_q, block_k,
+                                 causal, exp2)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds_lp = ds.astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds_lp, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        # this k block's dq contribution (the dq pass's third dot,
+        # without re-deriving s/p)
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            ds_lp, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# merged-backward routing: ON, but only while the f32 dq-partials slab
+# stays affordable (it scales with n_kb; the two-pass path has no such
+# cost). Measured on v5e: 1.11x at n_kb=2 (flagship), 1.07x at n_kb=8;
+# the win shrinks as partial traffic grows, and very long T would need
+# gigabytes of slab — cap the slab, not n_kb.
+_MERGED_BWD = [True]
+_MERGED_BWD_MAX_SLAB_BYTES = 512 * 1024 * 1024
+
+
+def _flash_bwd_merged(q, k, v, do, lse, delta, causal, block_q, block_k,
+                      interpret):
+    """One-sweep dk/dv/dq-partials call; returns (dq, dk, dv)."""
+    BH, T, D = q.shape
+    n_qb = T // block_q
+    n_kb = T // block_k
+    qi_map = _qi_clamp(causal, block_q, block_k)
+    dk, dv, dqp = pl.pallas_call(
+        functools.partial(_flash_dkvdq_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, n_qb=n_qb,
+                          exp2=_USE_EXP2[0]),
+        grid=(BH, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), qi_map),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), qi_map),
+            pl.BlockSpec((1, block_q, 1), qi_map),
+            pl.BlockSpec((1, block_q, 1), qi_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, j, i: (b, j, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+            jax.ShapeDtypeStruct((BH, n_kb, T, D), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dq = jnp.sum(dqp, axis=1).astype(q.dtype)
+    return dq, dk, dv
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
@@ -330,6 +422,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
                     axis=-1, keepdims=True)       # [BH, T, 1]
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
+    slab_bytes = BH * n_kb * T * D * 4
+    if _MERGED_BWD[0] and slab_bytes <= _MERGED_BWD_MAX_SLAB_BYTES:
+        return _flash_bwd_merged(q, k, v, do, lse, delta, causal,
+                                 block_q, block_k, interpret)
     kb_map = _kb_clamp(causal, block_q, block_k, n_kb)
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q,

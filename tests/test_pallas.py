@@ -318,3 +318,37 @@ def test_flash_attention_layer_scaling():
     ref = np.einsum('bts,bsd->btd', e / e.sum(-1, keepdims=True), v)
     np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4,
                                atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_merged_backward_matches_two_pass(causal):
+    """The merged dkv+dq-partials backward must produce the same grads
+    as the two-pass path (it is the default under the slab cap)."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 256, 2, 64), jnp.float32) * 0.1
+    k = jnp.asarray(rng.randn(1, 256, 2, 64), jnp.float32) * 0.1
+    v = jnp.asarray(rng.randn(1, 256, 2, 64), jnp.float32) * 0.1
+
+    def grads(merged):
+        old = pk._MERGED_BWD[0]
+        pk._MERGED_BWD[0] = merged
+        try:
+            jax.clear_caches()
+
+            def loss(q, k, v):
+                o = pk.flash_attention(q, k, v, causal=causal,
+                                       force=True, block_q=128,
+                                       block_k=128, interpret=True)
+                return jnp.sum(o * 1e-2)
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        finally:
+            pk._MERGED_BWD[0] = old
+
+    g_merged = grads(True)
+    g_two = grads(False)
+    for a, b in zip(g_merged, g_two):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
