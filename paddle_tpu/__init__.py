@@ -63,6 +63,9 @@ from .parallel.transpiler import (DistributeTranspiler,  # noqa
 from .clip import ErrorClipByValue  # noqa
 
 Tensor = SequenceTensor  # loose alias for scripts touching fluid.Tensor
+# reference __init__.py:46 re-exports core.LoDTensor; SequenceTensor
+# carries the imperative surface (set/set_lod/lod)
+LoDTensor = SequenceTensor
 
 __version__ = '0.1.0'
 
@@ -73,6 +76,7 @@ __all__ = [
     'scope_guard', 'fetch_var', 'layers', 'initializer', 'regularizer',
     'clip', 'optimizer', 'backward', 'append_backward', 'calc_gradient', 'gradients', 'ParamAttr',
     'WeightNormParamAttr', 'unique_name', 'DataFeeder', 'SequenceTensor',
+    'LoDTensor', 'Tensor',
     'create_lod_tensor', 'create_random_int_lodtensor', 'io', 'nets',
     'metrics', 'evaluator', 'profiler', 'reader', 'dataset', 'batch',
     'ParallelExecutor', 'DistributeTranspiler', 'InferenceTranspiler',

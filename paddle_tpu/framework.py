@@ -265,16 +265,51 @@ class Block(object):
     def create_var(self, **kwargs):
         name = kwargs.get('name')
         if name is not None and name in self.vars:
-            return self.vars[name]
+            existing = self.vars[name]
+            # reference framework.py Variable.__init__: re-declaring a
+            # var with conflicting shape/dtype is an error, not a
+            # silent aliasing
+            new_shape = kwargs.get('shape')
+            if new_shape is not None and tuple(existing.shape or ()) and \
+                    tuple(new_shape) != tuple(existing.shape):
+                raise ValueError(
+                    "Variable %r has been created before. The previous "
+                    "shape is %s, the new shape is %s. They are not "
+                    "matched." % (name, tuple(existing.shape),
+                                  tuple(new_shape)))
+            new_dtype = kwargs.get('dtype')
+            # only an EXPLICITLY declared dtype conflicts — a bare
+            # create_var(name=...) defaults to float32 without pinning it
+            if new_dtype is not None and \
+                    getattr(existing, '_dtype_explicit', False):
+                try:
+                    mismatch = np.dtype(new_dtype) != np.dtype(existing.dtype)
+                except TypeError:
+                    mismatch = str(new_dtype) != str(existing.dtype)
+                if mismatch:
+                    raise ValueError(
+                        "Variable %r has been created before. The "
+                        "previous data type is %s, the new dtype is %s. "
+                        "They are not matched." % (name, existing.dtype,
+                                                   new_dtype))
+            return existing
         var = Variable(self, **kwargs)
+        var._dtype_explicit = kwargs.get('dtype') is not None
         self.vars[var.name] = var
         self.program._bump_version()
         return var
 
     def create_parameter(self, **kwargs):
         global_block = self.program.global_block()
+        initializer = kwargs.pop('initializer', None)
         param = Parameter(global_block, **kwargs)
         global_block.vars[param.name] = param
+        if initializer is not None:
+            # direct block.create_parameter(initializer=...) appends the
+            # init op into this program's global block (the reference
+            # initializes in-place; LayerHelper routes through the
+            # startup program instead)
+            initializer(param, global_block)
         self.program._bump_version()
         return param
 

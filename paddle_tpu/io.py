@@ -34,6 +34,26 @@ def is_persistable(var):
     return var.persistable
 
 
+def get_parameter_value(para, executor):
+    """Fetch a parameter's current value (ref io.py:424-438: a one-var
+    fetch program; here the scope holds the device array directly)."""
+    assert is_parameter(para)
+    val = global_scope().raw(para.name)
+    if val is None:
+        raise RuntimeError(
+            "Parameter %r has no value in the current scope yet — run "
+            "the startup/init program first" % para.name)
+    return as_numpy(val)
+
+
+def get_parameter_value_by_name(name, executor, program=None):
+    """Parity: io.py:441-455."""
+    if program is None:
+        program = default_main_program()
+    var = program.global_block().var(name)
+    return get_parameter_value(var, executor)
+
+
 def _save_var_list(executor, dirname, var_names, scope=None, filename=None):
     scope = scope or global_scope()
     os.makedirs(dirname, exist_ok=True)

@@ -1,0 +1,145 @@
+"""Named mirror of tests/unittests/test_initializer.py (reference).
+
+The reference checks the init op each initializer appends (type + attrs,
+initializer.py formulas for Xavier/MSRA bounds). Mirrored here as the
+same op/attr contracts PLUS numeric distribution checks on the actually
+initialized values — structural attrs alone can't catch a kernel that
+ignores them.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import initializer
+from paddle_tpu.executor import Scope, scope_guard
+
+DELTA = 1e-5
+
+
+def _init_param(init, shape=(5, 10), name='p', seed=0):
+    main, start = fluid.Program(), fluid.Program()
+    start.random_seed = seed or 7
+    with fluid.program_guard(main, start):
+        fluid.layers.create_parameter(
+            shape=list(shape), dtype='float32', name=name,
+            default_initializer=init)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(start)
+        val = np.asarray(fluid.fetch_var(name))
+    ops = start.global_block().ops
+    return val, ops
+
+
+def test_constant_default_and_value():
+    """Ref :24-55 — fill_constant with value 0.0 / supplied value."""
+    v, ops = _init_param(initializer.ConstantInitializer())
+    assert ops[-1].type == 'fill_constant'
+    assert abs(ops[-1].attrs['value'] - 0.0) < DELTA
+    np.testing.assert_allclose(v, 0.0)
+    v, ops = _init_param(initializer.ConstantInitializer(2.3))
+    assert abs(ops[-1].attrs['value'] - 2.3) < DELTA
+    np.testing.assert_allclose(v, 2.3, rtol=1e-6)
+
+
+def test_uniform_default_bounds_and_seed_attr():
+    """Ref :58-100 — uniform_random in [-1, 1), seed attr honored."""
+    v, ops = _init_param(initializer.UniformInitializer(), shape=(40, 40))
+    op = ops[-1]
+    assert op.type == 'uniform_random'
+    assert abs(op.attrs['min'] + 1.0) < DELTA
+    assert abs(op.attrs['max'] - 1.0) < DELTA
+    assert op.attrs['seed'] == 0
+    assert v.min() >= -1.0 and v.max() < 1.0
+    assert abs(v.mean()) < 0.05 and v.std() > 0.4   # roughly uniform
+
+
+def test_uniform_custom_bounds():
+    v, ops = _init_param(
+        initializer.UniformInitializer(low=-4.2, high=3.1), shape=(40, 40))
+    assert v.min() >= -4.2 and v.max() < 3.1
+    assert v.min() < -3.0 and v.max() > 2.0          # spans the range
+
+
+def test_normal_mean_std():
+    """Ref normal case — gaussian_random with given mean/std."""
+    v, ops = _init_param(
+        initializer.NormalInitializer(loc=2.3, scale=1.9), shape=(60, 60))
+    op = ops[-1]
+    assert op.type == 'gaussian_random'
+    assert abs(op.attrs['mean'] - 2.3) < DELTA
+    assert abs(op.attrs['std'] - 1.9) < DELTA
+    assert abs(v.mean() - 2.3) < 0.1
+    assert abs(v.std() - 1.9) < 0.1
+
+
+def test_xavier_uniform_bound_formula():
+    """Ref Xavier cases — limit = sqrt(6 / (fan_in + fan_out)); 2-D
+    param fans are its two dims."""
+    shape = (30, 50)
+    v, ops = _init_param(initializer.XavierInitializer(), shape=shape)
+    limit = math.sqrt(6.0 / (shape[0] + shape[1]))
+    op = ops[-1]
+    assert abs(op.attrs['min'] + limit) < DELTA
+    assert abs(op.attrs['max'] - limit) < DELTA
+    assert v.min() >= -limit and v.max() < limit
+
+
+def test_xavier_conv_receptive_field_fans():
+    """Conv param [out, in, kh, kw]: fan_in = in*kh*kw (ref
+    initializer.py fan computation)."""
+    shape = (16, 8, 3, 3)
+    _, ops = _init_param(initializer.XavierInitializer(), shape=shape)
+    fan_in = 8 * 9
+    fan_out = 16 * 9
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    assert abs(ops[-1].attrs['max'] - limit) < DELTA
+
+
+def test_xavier_explicit_fans_override():
+    _, ops = _init_param(
+        initializer.XavierInitializer(fan_in=12, fan_out=23))
+    limit = math.sqrt(6.0 / 35)
+    assert abs(ops[-1].attrs['max'] - limit) < DELTA
+
+
+def test_msra_fan_in_formula():
+    """Ref MSRA cases — limit = sqrt(6 / fan_in)."""
+    shape = (30, 50)
+    _, ops = _init_param(initializer.MSRAInitializer(), shape=shape)
+    limit = math.sqrt(6.0 / 30)
+    assert abs(ops[-1].attrs['max'] - limit) < DELTA
+    _, ops = _init_param(initializer.MSRAInitializer(uniform=False),
+                         shape=shape)
+    assert abs(ops[-1].attrs['std'] - math.sqrt(2.0 / 30)) < DELTA
+
+
+def test_bilinear_kernel_values():
+    """Ref bilinear case — the 2x-upsampling 4x4 kernel: symmetric
+    taper, rows/cols the separable [0.25, 0.75, 0.75, 0.25] profile."""
+    v, _ = _init_param(initializer.BilinearInitializer(),
+                       shape=(2, 2, 4, 4))
+    k = v[0, 0]
+    profile = np.array([0.25, 0.75, 0.75, 0.25], 'float32')
+    np.testing.assert_allclose(k, np.outer(profile, profile), rtol=1e-6)
+    np.testing.assert_allclose(k, k[::-1, ::-1], rtol=1e-6)  # symmetric
+    v2 = v.reshape(4, 4, 4)
+    for i in range(1, 4):                                    # all filters equal
+        np.testing.assert_allclose(v2[i], v2[0])
+
+
+def test_bilinear_rejects_non_4d():
+    with pytest.raises(ValueError):
+        _init_param(initializer.BilinearInitializer(), shape=(3, 3))
+
+
+def test_seeded_init_is_deterministic():
+    """Ref seed cases — same program seed -> same values; different
+    explicit op seed -> different values."""
+    a, _ = _init_param(initializer.UniformInitializer(), seed=3)
+    b, _ = _init_param(initializer.UniformInitializer(), seed=3)
+    np.testing.assert_array_equal(a, b)
+    c, _ = _init_param(initializer.UniformInitializer(seed=11), seed=3)
+    assert not np.array_equal(a, c)

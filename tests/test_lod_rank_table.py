@@ -1,0 +1,77 @@
+"""Named mirror of tests/unittests/test_lod_rank_table.py (reference
+:14-60): the rank table sorts sequences by length DESCENDING with a
+stable original-index mapping. The reference test builds its table at
+lod level 1 of a 3-level tensor and expects items [(0,5),(1,1),(2,1)];
+here the table is built from the tensor's primary lengths — same
+contract (length-desc, stable index), checked via the kernel's
+lengths/index output."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _table_order(lens):
+    """Observe the table's (index, length) items through
+    reorder_lod_tensor_by_rank: row i of the reordered output is the
+    table's rank-i sequence, identified by a unique marker value."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        table = fluid.layers.lod_rank_table(x)
+        re = fluid.layers.reorder_lod_tensor_by_rank(y, table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(start)
+    total = int(sum(lens))
+    t = fluid.create_lod_tensor(
+        np.zeros((total, 1), np.float32), [list(lens)], fluid.CPUPlace())
+    marker = np.arange(len(lens), dtype=np.float32)[:, None]
+    r, = exe.run(main, feed={'x': t, 'y': marker}, fetch_list=[re])
+    order = [int(v) for v in np.asarray(r).ravel()]
+    return [(i, lens[i]) for i in order]
+
+
+def test_lod_rank_table_sorts_desc_stable():
+    """Ref :38-39: items() == [(0, 5), (1, 1), (2, 1)] — length-desc,
+    ties keep original order (stable)."""
+    assert _table_order([5, 1, 1]) == [(0, 5), (1, 1), (2, 1)]
+    assert _table_order([1, 3, 3]) == [(1, 3), (2, 3), (0, 1)]
+
+
+def test_max_sequence_len_from_table():
+    """The contract every consumer relies on: max over the lengths."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        mx = fluid.layers.max_sequence_len(table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(start)
+    t = fluid.create_lod_tensor(
+        np.zeros((9, 1), np.float32), [[3, 5, 1]], fluid.CPUPlace())
+    r, = exe.run(main, feed={'x': t}, fetch_list=[mx])
+    assert int(np.asarray(r)) == 5
+
+
+def test_reorder_by_rank_table_round_trip():
+    """reorder_lod_tensor_by_rank on the table's order is the
+    length-desc permutation (ref test_reorder_lod_tensor companion
+    semantics, already mirrored in tests/test_reorder_lod_tensor.py —
+    here just the table-driven ordering)."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        y = fluid.layers.data(name='y', shape=[2], dtype='float32')
+        table = fluid.layers.lod_rank_table(x)
+        re = fluid.layers.reorder_lod_tensor_by_rank(y, table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(start)
+    t = fluid.create_lod_tensor(
+        np.zeros((4, 1), np.float32), [[1, 3]], fluid.CPUPlace())
+    yv = np.asarray([[1., 1.], [2., 2.]], np.float32)
+    r, = exe.run(main, feed={'x': t, 'y': yv}, fetch_list=[re])
+    # seq 1 (len 3) ranks first
+    np.testing.assert_allclose(np.asarray(r), yv[[1, 0]])

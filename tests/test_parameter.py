@@ -1,0 +1,57 @@
+"""Named mirror of tests/unittests/test_parameter.py (reference :15-49):
+create_parameter attrs, constant init value, and the io parameter-value
+helpers."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.framework import Parameter
+
+
+def test_param():
+    """Ref test_parameter.py:27-45: block.create_parameter with an
+    initializer initializes IN this program (no startup split), the
+    value is fetchable, and io.get_parameter_value_by_name reads it."""
+    shape = [784, 100]
+    val = 1.0625
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        b = main.global_block()
+        param = b.create_parameter(
+            name='fc.w', shape=shape, dtype='float32',
+            initializer=fluid.initializer.ConstantInitializer(val))
+    assert param is not None
+    assert isinstance(param, Parameter)
+    assert param.name == 'fc.w'
+    assert tuple(param.shape) == (784, 100)
+    assert param.dtype in ('float32', np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        p, = exe.run(main, feed={}, fetch_list=[param])
+        np.testing.assert_allclose(np.asarray(p), np.full(shape, val),
+                                   rtol=1e-6)
+        p2 = fluid.io.get_parameter_value_by_name('fc.w', exe, main)
+        np.testing.assert_allclose(np.asarray(p2), np.full(shape, val),
+                                   rtol=1e-6)
+
+
+def test_param_default_attrs():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        p = fluid.layers.create_parameter(shape=[3, 4], dtype='float32',
+                                          name='dflt.w')
+    assert p.persistable
+    assert getattr(p, 'trainable', True)
+    assert p.optimize_attr.get('learning_rate') == 1.0
+
+
+def test_get_parameter_value_before_init_raises():
+    import pytest
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        main.global_block().create_parameter(
+            name='uninit.w', shape=[2, 2], dtype='float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        with pytest.raises(RuntimeError, match='no value'):
+            fluid.io.get_parameter_value_by_name('uninit.w', exe, main)

@@ -1,0 +1,94 @@
+"""Named mirror of tests/unittests/test_regularizer.py (reference).
+
+The reference checks that append_regularization_ops appends the decay
+ops (scale+add for L2; sign+scale+add for L1) to the grads of params
+carrying a regularizer. Mirrored as the same structural contract plus
+the NUMERIC decay effect: g' = g + k*w (L2) / g + k*sign(w) (L1).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import regularizer
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _grad_with(reg):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        attr = fluid.ParamAttr(
+            name='reg_w', regularizer=reg,
+            initializer=fluid.initializer.Constant(0.25))
+        y = fluid.layers.fc(x, size=3, param_attr=attr, bias_attr=False)
+        loss = fluid.layers.mean(y)
+        params_grads = fluid.backward.append_backward(loss)
+        n_ops = len(main.global_block().ops)
+        params_grads = regularizer.append_regularization_ops(params_grads)
+        added = len(main.global_block().ops) - n_ops
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(start)
+        xv = np.full((2, 4), 0.5, 'float32')
+        g, w = exe.run(main, feed={'x': xv},
+                       fetch_list=[params_grads[0][1], 'reg_w'])
+    return np.asarray(g), np.asarray(w), added, main
+
+
+def test_l2_decay_structure_and_math():
+    """Ref :24-58 — two appended ops; numeric: g' = g + 0.5 * w."""
+    g0, w, added0, _ = _grad_with(None)
+    assert added0 == 0
+    g, w, added, main = _grad_with(regularizer.L2DecayRegularizer(0.5))
+    assert added == 2
+    types = [op.type for op in main.global_block().ops][-2:]
+    # reference appends [scale, elementwise_add]; the add is spelled
+    # 'sum' here (same math, n-ary accumulate op)
+    assert types[0] == 'scale' and types[1] in ('sum', 'elementwise_add')
+    np.testing.assert_allclose(g, g0 + 0.5 * w, rtol=1e-5)
+
+
+def test_l1_decay_structure_and_math():
+    """Ref :61-96 — three appended ops; numeric: g' = g + 0.5*sign(w)."""
+    g0, w, _, _ = _grad_with(None)
+    g, w, added, main = _grad_with(regularizer.L1DecayRegularizer(0.5))
+    assert added == 3
+    types = [op.type for op in main.global_block().ops][-3:]
+    assert types[:2] == ['sign', 'scale'] and \
+        types[2] in ('sum', 'elementwise_add')
+    np.testing.assert_allclose(g, g0 + 0.5 * np.sign(w), rtol=1e-5)
+
+
+def test_param_attr_carries_regularizer_instance():
+    """Ref: the parameter itself holds the regularizer object."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        reg = regularizer.L2DecayRegularizer(0.1)
+        w = fluid.layers.create_parameter(
+            shape=[3, 3], dtype='float32', name='rw',
+            attr=fluid.ParamAttr(name='rw', regularizer=reg))
+    assert getattr(w, 'regularizer', None) is reg
+
+
+def test_global_regularization_fallback():
+    """append_regularization_ops(regularization=...) applies to params
+    WITHOUT their own regularizer (reference optimizer contract)."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(
+            x, size=3, bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                name='gw', initializer=fluid.initializer.Constant(0.5)))
+        loss = fluid.layers.mean(y)
+        pg = fluid.backward.append_backward(loss)
+        pg = regularizer.append_regularization_ops(
+            pg, regularization=regularizer.L2DecayRegularizer(0.3))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(start)
+        g, w = exe.run(main, feed={'x': np.ones((1, 4), 'float32')},
+                       fetch_list=[pg[0][1], 'gw'])
+    base = np.asarray(g) - 0.3 * np.asarray(w)
+    assert np.abs(0.3 * np.asarray(w)).max() > 0.01
+    np.testing.assert_allclose(np.asarray(g), base + 0.3 * np.asarray(w),
+                               rtol=1e-6)

@@ -1,0 +1,61 @@
+"""Named mirror of tests/unittests/test_scope.py (reference :14-52):
+create/destroy, parent lookup through new_scope, var/find_var, and
+value round trips (the reference's set_int/get_int become set_var/raw
+on the python Scope)."""
+import numpy as np
+
+from paddle_tpu.executor import Scope
+
+
+def test_create_destroy():
+    scope = Scope()
+    assert scope is not None
+    child = scope.new_scope()
+    assert child is not None
+
+
+def test_none_variable():
+    scope = Scope()
+    assert scope.find_var('test') is None
+
+
+def test_create_var_get_var():
+    """var() hands out a usable binding; once a value lands, find_var
+    sees it (incl. from child scopes — reference parent lookup). An
+    unset slot counts as not-found: the documented presence-test
+    contract (executor.py Scope.find_var)."""
+    scope = Scope()
+    var_a = scope.var('var_a')
+    assert var_a is not None
+    assert scope.find_var('var_a') is None          # declared, unset
+    var_a.get_tensor().set(np.zeros((2,), 'float32'), None)
+    assert scope.find_var('var_a') is not None
+    # child scopes see parent vars (reference parent lookup)
+    child = scope.new_scope()
+    assert child.find_var('var_a') is not None
+
+
+def test_var_value_round_trip():
+    scope = Scope()
+    scope.set_var('test_int', np.int64(10))
+    assert int(np.asarray(scope.raw('test_int'))) == 10
+    scope.set_var('test_arr', np.arange(6.0).reshape(2, 3))
+    np.testing.assert_array_equal(np.asarray(scope.raw('test_arr')),
+                                  np.arange(6.0).reshape(2, 3))
+
+
+def test_child_writes_do_not_leak_to_parent():
+    scope = Scope()
+    child = scope.new_scope()
+    child.set_var('only_child', np.float32(1.5))
+    assert child.find_var('only_child') is not None
+    assert scope.find_var('only_child') is None
+
+
+def test_drop_kids():
+    scope = Scope()
+    child = scope.new_scope()
+    child.set_var('x', np.float32(1.0))
+    scope.drop_kids()
+    # a fresh child no longer sees the dropped scope's var
+    assert scope.new_scope().find_var('x') is None

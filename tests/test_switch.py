@@ -1,0 +1,46 @@
+"""Named mirror of tests/unittests/test_switch.py (reference :14-64):
+first matching case wins, default fires when nothing matches."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _check_switch(value):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.fill_constant(shape=[1], dtype='float32', value=value)
+        zero_var = layers.fill_constant(shape=[1], dtype='float32',
+                                        value=0.0)
+        one_var = layers.fill_constant(shape=[1], dtype='float32',
+                                       value=1.0)
+        two_var = layers.fill_constant(shape=[1], dtype='float32',
+                                       value=2.0)
+        three_var = layers.fill_constant(shape=[1], dtype='float32',
+                                         value=3.0)
+        result = layers.create_global_var(shape=[1], value=-1.0,
+                                          dtype='float32',
+                                          persistable=True)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(x, zero_var)):
+                layers.assign(zero_var, result)
+            with switch.case(layers.less_than(x, one_var)):
+                layers.assign(one_var, result)
+            with switch.case(layers.less_than(x, two_var)):
+                layers.assign(two_var, result)
+            with switch.default():
+                layers.assign(three_var, result)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(start)
+        out, = exe.run(main, feed={}, fetch_list=[result])
+    return float(np.asarray(out).ravel()[0])
+
+
+@pytest.mark.parametrize('value,expected',
+                         [(-0.1, 0.0), (0.1, 1.0), (1.1, 2.0),
+                          (2.1, 3.0)])
+def test_switch(value, expected):
+    assert _check_switch(value) == expected

@@ -1,0 +1,90 @@
+"""Named mirror of tests/unittests/test_tensor.py (reference :21-160).
+
+The reference drives the C++ Tensor/LoDTensor bindings (set/set_lod/
+lod round trips for int and float). The analog here is SequenceTensor's
+imperative surface: fluid.LoDTensor() + set + set_lod (packed rows with
+offset LoD) and create_lod_tensor (lengths form), round-tripping values
+and LoD through the feed path.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import SequenceTensor
+
+
+def test_int_tensor_set_round_trip():
+    """Ref test_int_tensor: set values, read them back unchanged."""
+    t = fluid.LoDTensor()
+    arr = np.zeros((4, 4, 6), np.int32)
+    arr[0, 0, 0] = 3
+    arr[3, 3, 5] = 10
+    t.set(arr, fluid.CPUPlace())
+    back = np.asarray(t.data)
+    assert back.dtype in (np.int32, np.int64)
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_float_tensor_set_round_trip():
+    t = fluid.LoDTensor()
+    arr = np.zeros((5, 2, 3, 4), np.float32)
+    arr[0, 0, 0, 0] = 1.0
+    arr[0, 0, 0, 1] = 2.0
+    t.set(arr, fluid.CPUPlace())
+    back = np.asarray(t.data)
+    assert back[0, 0, 0, 0] == 1.0 and back[0, 0, 0, 1] == 2.0
+    # no LoD set: behaves as plain dense (ref: len(lod()) == 0)
+    assert t.lengths is None
+
+
+def test_lod_tensor_set_lod_offsets():
+    """Ref test_int_lod_tensor: offset-style set_lod round-trips."""
+    t = fluid.LoDTensor()
+    rows = np.arange(8, dtype=np.float32).reshape(4, 2)
+    t.set(rows, fluid.CPUPlace())
+    t.set_lod([[0, 2, 4]])
+    assert t.lod() == [[0, 2, 4]]
+    # two sequences of length 2 each
+    np.testing.assert_array_equal(np.asarray(t.lengths), [2, 2])
+
+
+def test_create_lod_tensor_lengths_form():
+    """fluid.create_lod_tensor pads per-sequence rows; values land in
+    the right (seq, step) slots and lengths are preserved."""
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    t = fluid.create_lod_tensor(data, [[2, 3]], fluid.CPUPlace())
+    assert isinstance(t, SequenceTensor)
+    np.testing.assert_array_equal(np.asarray(t.lengths), [2, 3])
+    padded = np.asarray(t.data)
+    np.testing.assert_array_equal(padded[0, :2], data[:2])
+    np.testing.assert_array_equal(padded[1, :3], data[2:])
+    assert padded[0, 2:].sum() == 0                   # padding is zero
+
+
+def test_level2_lod_tensor():
+    """Ref test_float_lod_tensor's 2-level case in lengths form: outer
+    lens group inner sequences; sub_lengths carry the inner lens."""
+    data = np.arange(5, dtype=np.float32).reshape(5, 1)
+    t = fluid.create_lod_tensor(data, [[2, 1], [2, 2, 1]],
+                                fluid.CPUPlace())
+    np.testing.assert_array_equal(np.asarray(t.lengths), [2, 1])
+    sub = np.asarray(t.sub_lengths)
+    assert sub.shape[0] == 2
+    np.testing.assert_array_equal(sub[0, :2], [2, 2])
+    assert sub[1, 0] == 1
+
+
+def test_lod_tensor_feeds_through_executor():
+    """The round trip the reference checks at the binding level, here
+    through a real program: feed a LoDTensor, sequence-pool it."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        s = fluid.layers.sequence_pool(x, pool_type='sum')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(start)
+    t = fluid.create_lod_tensor(
+        np.arange(5, dtype=np.float32).reshape(5, 1), [[2, 3]],
+        fluid.CPUPlace())
+    r, = exe.run(main, feed={'x': t}, fetch_list=[s])
+    np.testing.assert_allclose(np.asarray(r).ravel(), [1.0, 9.0])

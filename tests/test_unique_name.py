@@ -1,0 +1,26 @@
+"""Named mirror of tests/unittests/test_unique_name.py (reference
+:14-43): guard isolation/reset and generate numbering."""
+import paddle_tpu as fluid
+
+
+def test_guard():
+    with fluid.unique_name.guard():
+        name_1 = fluid.unique_name.generate('')
+    with fluid.unique_name.guard():
+        name_2 = fluid.unique_name.generate('')
+    assert name_1 == name_2          # guard resets the counters
+
+    with fluid.unique_name.guard('A'):
+        name_1 = fluid.unique_name.generate('')
+    with fluid.unique_name.guard('B'):
+        name_2 = fluid.unique_name.generate('')
+    assert name_1 != name_2          # prefixed guards namespace names
+
+
+def test_generate():
+    with fluid.unique_name.guard():
+        name1 = fluid.unique_name.generate('fc')
+        name2 = fluid.unique_name.generate('fc')
+        name3 = fluid.unique_name.generate('tmp')
+        assert name1 != name2        # same key increments
+        assert name1[-2:] == name3[-2:]   # distinct keys count separately

@@ -1,0 +1,65 @@
+"""Named mirror of tests/unittests/test_variable.py (reference :14-62):
+var attrs, re-lookup by name, and mismatch errors. The np-dtype
+conversion cases map onto this IR's string dtypes (no proto enum by
+design — framework.py keeps dtypes as canonical strings)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program
+
+
+def test_var():
+    prog = Program()
+    b = prog.current_block()
+    w = b.create_var(dtype='float64', shape=[784, 100], lod_level=0,
+                     name='fc.w')
+    assert str(w) != ''
+    assert tuple(w.shape) == (784, 100)
+    assert w.name == 'fc.w'
+    assert w.lod_level == 0
+
+    # re-declaring by name returns the SAME var with its attrs
+    w2 = b.create_var(name='fc.w')
+    assert tuple(w2.shape) == (784, 100)
+    assert w2.name == 'fc.w'
+
+    # conflicting re-declaration raises (reference ValueError)
+    with pytest.raises((ValueError, AssertionError)):
+        b.create_var(name='fc.w', shape=(24, 100))
+
+
+def test_np_dtype_round_trip():
+    """The reference converts np dtypes to proto enums; here dtypes stay
+    strings — every reference-supported dtype must be accepted and
+    preserved on the var."""
+    prog = Program()
+    b = prog.current_block()
+    for i, dt in enumerate(['float32', 'float16', 'float64', 'int32',
+                            'int16', 'int64', 'bool']):
+        v = b.create_var(name='v%d' % i, shape=[2], dtype=dt)
+        assert str(v.dtype) == dt, (v.dtype, dt)
+    v = b.create_var(name='vnp', shape=[2], dtype=np.float32)
+    assert str(np.dtype(v.dtype)) == 'float32'
+
+
+def test_var_to_string_mentions_identity():
+    prog = Program()
+    b = prog.current_block()
+    v = b.create_var(name='printed', shape=[3, 3], dtype='float32')
+    s = v.to_string(True) if hasattr(v, 'to_string') else str(v)
+    assert 'printed' in s
+
+
+def test_bare_redeclare_then_typed_is_legal():
+    """A var first declared WITHOUT a dtype (defaults float32 loosely)
+    may be re-declared with an explicit dtype — only explicit-vs-
+    explicit conflicts raise."""
+    prog = Program()
+    b = prog.current_block()
+    b.create_var(name='loose')
+    v = b.create_var(name='loose', dtype='int64')
+    assert v is b.vars['loose']
+    with pytest.raises(ValueError):
+        b.create_var(name='loose2', dtype='float32')
+        b.create_var(name='loose2', dtype='int64')
