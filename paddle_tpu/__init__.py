@@ -56,10 +56,14 @@ from . import debugger as debuger  # noqa  (reference spelling)
 from . import graphviz  # noqa
 from . import net_drawer  # noqa
 from . import concurrency  # noqa
-from .parallel.parallel_executor import ParallelExecutor  # noqa
+from .parallel.parallel_executor import (ParallelExecutor,  # noqa
+                                         ExecutionStrategy, BuildStrategy)
 from .parallel.transpiler import (DistributeTranspiler,  # noqa
                                   InferenceTranspiler,
+                                  SimpleDistributeTranspiler,
                                   memory_optimize, release_memory)
+from . import transpiler  # noqa
+from . import recordio_writer  # noqa
 from .clip import ErrorClipByValue  # noqa
 
 Tensor = SequenceTensor  # loose alias for scripts touching fluid.Tensor
@@ -79,6 +83,8 @@ __all__ = [
     'LoDTensor', 'Tensor',
     'create_lod_tensor', 'create_random_int_lodtensor', 'io', 'nets',
     'metrics', 'evaluator', 'profiler', 'reader', 'dataset', 'batch',
-    'ParallelExecutor', 'DistributeTranspiler', 'InferenceTranspiler',
+    'ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy',
+    'DistributeTranspiler', 'SimpleDistributeTranspiler',
+    'InferenceTranspiler', 'transpiler', 'recordio_writer',
     'memory_optimize', 'release_memory',
 ]

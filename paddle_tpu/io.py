@@ -38,12 +38,13 @@ def get_parameter_value(para, executor):
     """Fetch a parameter's current value (ref io.py:424-438: a one-var
     fetch program; here the scope holds the device array directly)."""
     assert is_parameter(para)
-    val = global_scope().raw(para.name)
+    from .executor import fetch_var
+    val = fetch_var(para.name)
     if val is None:
         raise RuntimeError(
             "Parameter %r has no value in the current scope yet — run "
             "the startup/init program first" % para.name)
-    return as_numpy(val)
+    return val
 
 
 def get_parameter_value_by_name(name, executor, program=None):

@@ -17,17 +17,56 @@ from ..core.lowering import lower_block, RNG_KEY
 from ..lod import SequenceTensor
 from .mesh import get_mesh
 
-__all__ = ['ParallelExecutor']
+__all__ = ['ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy']
+
+
+class ExecutionStrategy(object):
+    """Parity: core.ParallelExecutor.ExecutionStrategy. Scheduling
+    knobs for the reference's threaded SSA-graph executor
+    (num_threads, allow_op_delay, num_iteration_per_drop_scope). The
+    whole-block XLA design has no per-op scheduler to tune — the
+    compiler owns the schedule — so these are carried as attributes for
+    script compatibility and the executor reads none of them."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.allow_op_delay = False
+        self.num_iteration_per_drop_scope = 100
+        self.use_event = True
+
+
+class BuildStrategy(object):
+    """Parity: core.ParallelExecutor.BuildStrategy (reduce/broadcast
+    strategy, debug graphviz path). Gradient aggregation strategy is
+    XLA SPMD's choice on this path; debug_graphviz_path is honored by
+    paddle_tpu.graphviz.draw callers."""
+
+    class ReduceStrategy(object):
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy(object):
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
 
 
 class ParallelExecutor(object):
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, num_threads=None,
                  allow_op_delay=False, use_tpu=True, num_devices=None,
-                 mesh=None):
+                 mesh=None, exec_strategy=None, build_strategy=None):
         self._program = main_program or default_main_program()
         self._mesh = mesh or get_mesh(num_devices)
         self._loss_name = loss_name
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._build_strategy = build_strategy or BuildStrategy()
         self._exe = Executor()
         if share_vars_from is not None:
             # parity: share scope with the training ParallelExecutor

@@ -160,6 +160,10 @@ class DistributeTranspilerSimple(DistributeTranspiler):
     pass
 
 
+# the reference exports it under this name (transpiler/__init__.py)
+SimpleDistributeTranspiler = DistributeTranspilerSimple
+
+
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level=0):
     """Parity: memory_optimization_transpiler.memory_optimize.

@@ -1,8 +1,9 @@
 """Transpiler package facade. Parity: python/paddle/fluid/transpiler/
 (__init__ re-exports; implementations live in paddle_tpu.parallel)."""
 from ..parallel.transpiler import (DistributeTranspiler,  # noqa
-                                   InferenceTranspiler, memory_optimize,
-                                   release_memory)
+                                   InferenceTranspiler,
+                                   SimpleDistributeTranspiler,
+                                   memory_optimize, release_memory)
 
-__all__ = ['DistributeTranspiler', 'InferenceTranspiler',
-           'memory_optimize', 'release_memory']
+__all__ = ['DistributeTranspiler', 'SimpleDistributeTranspiler',
+           'InferenceTranspiler', 'memory_optimize', 'release_memory']

@@ -17,15 +17,34 @@ import paddle_tpu as fluid
 REF = '/root/reference/python/paddle/fluid'
 
 
-def _ref_all(relpath):
+def _ref_all(relpath, _seen=None):
+    """Names a reference module exports. Handles the COMPUTED __all__
+    in fluid/__init__.py (`framework.__all__ + ... + [literals]`) by
+    recursing into the referenced modules' __all__ lists."""
     path = os.path.join(REF, relpath)
     if not os.path.exists(path):
         pytest.skip("reference file %s missing" % relpath)
     src = open(path).read()
-    m = re.search(r"^__all__\s*=\s*\[(.*?)\]", src, re.S | re.M)
+    m = re.search(r"^__all__\s*=\s*(.+?)(?:\n\S|\Z)", src, re.S | re.M)
     if not m:
         return []
-    names = re.findall(r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]", m.group(1))
+    expr = m.group(1)
+    names = []
+    bracket = re.search(r"\[(.*)\]", expr, re.S)
+    if bracket:
+        names += re.findall(r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]",
+                            bracket.group(1))
+    _seen = _seen or set()
+    for mod in re.findall(r"(\w+)\.__all__", expr):
+        if mod in _seen:
+            continue
+        _seen.add(mod)
+        base = os.path.dirname(relpath)
+        for cand in (os.path.join(base, mod + '.py'),
+                     os.path.join(base, mod, '__init__.py')):
+            if os.path.exists(os.path.join(REF, cand)):
+                names += _ref_all(cand, _seen)
+                break
     return names
 
 
@@ -60,10 +79,21 @@ MODULES = [
 ]
 
 
+# names in the reference's own __all__ that the REFERENCE itself cannot
+# resolve (stale strings kept through its renames) — hasattr fails there
+# too, so they are excluded from the parity contract
+REF_STALE = {
+    # renamed to layers/learning_rate_scheduler.py; no such module
+    # exists in the reference tree (fluid/__init__.py:70)
+    'learning_rate_decay',
+}
+
+
 @pytest.mark.parametrize('relpath,mod',
                          MODULES, ids=[m[0] for m in MODULES])
 def test_reference_all_exported(relpath, mod):
-    missing = [s for s in _ref_all(relpath) if not hasattr(mod, s)]
+    missing = [s for s in _ref_all(relpath)
+               if s not in REF_STALE and not hasattr(mod, s)]
     assert not missing, (
         "reference %s exports missing from %s: %s"
         % (relpath, mod.__name__, missing))
