@@ -694,10 +694,13 @@ def bench_memory(on_tpu):
             o, = exe.run(main, feed=feed, fetch_list=[loss],
                          return_numpy=False)
             jax.block_until_ready(o.data if hasattr(o, 'data') else o)
-            key, jitted = list(exe._cache.items())[-1]
-            state = {n: scope.raw(n) for n in key[3]}
-            ma = jitted.lower(exe._prepare_feed(main, feed),
-                              state).compile().memory_analysis()
+            jitted = list(exe._cache.values())[-1]
+            # re-derive the jitted fn's (feeds, state) arguments through
+            # the shared preamble (never poke cache-key indices)
+            _, feed2, state_in, _, _ = exe._prep_lowering(
+                main, dict(feed), [loss], scope, consume_readers=False)
+            state = {n: scope.raw(n) for n in state_in}
+            ma = jitted.lower(feed2, state).compile().memory_analysis()
         out[mode + '_temp_mb'] = round(ma.temp_size_in_bytes / 1e6, 1)
     out['activation_memory_saved'] = round(
         1.0 - out['remat_temp_mb'] / max(out['baseline_temp_mb'], 1e-9),

@@ -14,6 +14,7 @@ so the forward is traced exactly once; gradients bind to the reference's
 update ops) consume them as ordinary environment values.
 """
 import contextlib
+import functools
 import time
 
 import jax
@@ -188,10 +189,22 @@ class BlockRunner(object):
             if self.grad_mode:
                 for name in op.output_arg_names:
                     var = self.block._find_var_recursive(name)
-                    if var is not None and var.stop_gradient and \
-                            name in env and _is_float(env[name]):
+                    if var is None or name not in env:
+                        continue
+                    if var.stop_gradient and _is_float(env[name]):
                         env[name] = jax.tree_util.tree_map(
                             jax.lax.stop_gradient, env[name])
+                    eclip = getattr(var, 'error_clip', None)
+                    if eclip is not None and _is_float(env[name]):
+                        # Variable.set_error_clip on an ACTIVATION: the
+                        # reference clips <var>@GRAD as the backward
+                        # passes through (clip_op appended by
+                        # error_clip_callback); the fused-autodiff
+                        # analog is a cotangent-clip identity barrier
+                        env[name] = jax.tree_util.tree_map(
+                            lambda v: _clip_cotangent(
+                                v, float(eclip.min), float(eclip.max)),
+                            env[name])
             mesh = _SHARDING_MESH[0]
             if mesh is not None:
                 for name in op.output_arg_names:
@@ -206,6 +219,25 @@ def _is_float(val):
     leaves = jax.tree_util.tree_leaves(val)
     return any(jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
                for l in leaves)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _clip_cotangent(x, lo, hi):
+    """Identity whose COTANGENT is clipped to [lo, hi] — the
+    fused-backward form of the reference's error-clip op on
+    <var>@GRAD (clip.py ErrorClipByValue.append_clip_op)."""
+    return x
+
+
+def _clip_cotangent_fwd(x, lo, hi):
+    return x, None
+
+
+def _clip_cotangent_bwd(lo, hi, _, g):
+    return (jnp.clip(g, lo, hi),)
+
+
+_clip_cotangent.defvjp(_clip_cotangent_fwd, _clip_cotangent_bwd)
 
 
 def _check_outputs(op, env):

@@ -127,6 +127,15 @@ class Variable(object):
         self.sharding = spec
         return self
 
+    def set_error_clip(self, error_clip):
+        """Parity: framework.py Variable.set_error_clip — clip THIS
+        var's gradient as the backward passes through (consumed at
+        lowering as a cotangent-clip barrier; program cache must see
+        the change)."""
+        self.error_clip = error_clip
+        if self.block is not None:
+            self.block.program._bump_version()
+
     def to_string(self, throw_on_error=False):
         return "Variable(name=%s, shape=%s, dtype=%s, lod=%d%s)" % (
             self.name, self.shape, self.dtype, self.lod_level,

@@ -221,10 +221,14 @@ def reorder_lod_tensor_by_rank(x, rank_table):
 
 def split_lod_tensor(input, mask, level=0):
     helper = LayerHelper('split_lod_tensor', **{})
+    # branch views keep the input's feature shape (rows are masked, not
+    # dropped, so downstream fc/conv can size their params)
     out_true = helper.create_tmp_variable(dtype=input.dtype,
-                                          lod_level=input.lod_level)
+                                          lod_level=input.lod_level,
+                                          shape=input.shape)
     out_false = helper.create_tmp_variable(dtype=input.dtype,
-                                           lod_level=input.lod_level)
+                                           lod_level=input.lod_level,
+                                           shape=input.shape)
     helper.append_op(type='split_lod_tensor',
                      inputs={'X': input, 'Mask': mask},
                      outputs={'OutTrue': out_true, 'OutFalse': out_false},

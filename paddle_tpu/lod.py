@@ -99,7 +99,13 @@ class SequenceTensor(object):
             return
         lens = [[off[i + 1] - off[i] for i in range(len(off) - 1)]
                 for off in self._offsets]
-        built = create_lod_tensor(self._packed, lens)
+        # offset-form LoD may legally UNDER-cover the rows (the
+        # reference's own op fixtures do, e.g.
+        # test_edit_distance_op.py x2_lod=[0,3,4] over 5 rows): rows
+        # past the last offset are unused — trim before the strict
+        # lengths-form constructor
+        covered = int(self._offsets[-1][-1])
+        built = create_lod_tensor(self._packed[:covered], lens)
         self.data = built.data
         self.lengths = built.lengths
         self.sub_lengths = built.sub_lengths
@@ -224,6 +230,19 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
         data = arr
     data = np.asarray(data)
     lens = list(recursive_seq_lens[-1])
+    # reference lod_tensor.py _validate_lod: the last level's lengths
+    # must tile the data rows exactly, and each outer level must group
+    # ALL of the next level's sequences
+    if int(np.sum(lens)) != int(data.shape[0]):
+        raise ValueError(
+            "recursive_seq_lens %r sums to %d but data has %d rows"
+            % (recursive_seq_lens, int(np.sum(lens)), int(data.shape[0])))
+    for outer_l, inner_l in zip(recursive_seq_lens, recursive_seq_lens[1:]):
+        if int(np.sum(outer_l)) != len(inner_l):
+            raise ValueError(
+                "lod level %r groups %d sequences but the next level "
+                "has %d" % (list(outer_l), int(np.sum(outer_l)),
+                            len(inner_l)))
     if len(recursive_seq_lens) > 1:
         # level-2: outer lens group the inner sequences
         outer = list(recursive_seq_lens[0])

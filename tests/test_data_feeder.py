@@ -1,0 +1,63 @@
+"""Named mirror of tests/test_data_feeder.py (reference :19-73): the
+DataFeeder row-tuple converters at lod levels 0/1/2. The reference
+checks packed shapes + offset LoD; the padded SequenceTensor analogs
+carry the same information as (padded shape, lengths)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import SequenceTensor
+
+
+def test_lod_level_0_converter():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        img = fluid.layers.data(name='image', shape=[1, 28, 28])
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        feeder = fluid.DataFeeder([img, label], fluid.CPUPlace())
+    result = feeder.feed([([0] * 784, [9]), ([1] * 784, [1])])
+    assert tuple(np.asarray(result['image']).shape) == (2, 1, 28, 28)
+    assert tuple(np.asarray(result['label']).shape) == (2, 1)
+    # level-0 feeds are plain dense arrays (no LoD)
+    assert not isinstance(result['image'], SequenceTensor) or \
+        result['image'].lengths is None
+    assert int(np.asarray(result['label'])[0, 0]) == 9
+
+
+def test_lod_level_1_converter():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        sentences = fluid.layers.data(name='sentences', shape=[1],
+                                      dtype='int64', lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        feeder = fluid.DataFeeder([sentences, label], fluid.CPUPlace())
+    result = feeder.feed(
+        [([1, 2, 3], [1]), ([4, 5], [1]), ([6, 7, 8, 9], [1])])
+    st = result['sentences']
+    assert isinstance(st, SequenceTensor)
+    np.testing.assert_array_equal(np.asarray(st.lengths), [3, 2, 4])
+    # total rows match the reference's packed [9, 1]
+    assert int(np.asarray(st.lengths).sum()) == 9
+    padded = np.asarray(st.data)
+    np.testing.assert_array_equal(padded[0, :3, 0], [1, 2, 3])
+    np.testing.assert_array_equal(padded[2, :4, 0], [6, 7, 8, 9])
+    assert tuple(np.asarray(result['label']).shape) == (3, 1)
+
+
+def test_lod_level_2_converter():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        paragraphs = fluid.layers.data(name='paragraphs', shape=[1],
+                                       dtype='int64', lod_level=2)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        feeder = fluid.DataFeeder([paragraphs, label], fluid.CPUPlace())
+    result = feeder.feed(
+        [([[1, 2, 3], [4, 5]], [1]), ([[6, 7, 8, 9]], [1])])
+    st = result['paragraphs']
+    assert isinstance(st, SequenceTensor)
+    # outer lens [2, 1] (ref lod level 0: [0, 2, 3])
+    np.testing.assert_array_equal(np.asarray(st.lengths), [2, 1])
+    sub = np.asarray(st.sub_lengths)
+    # inner lens [3, 2] and [4] (ref level 1: [0, 3, 5, 9])
+    np.testing.assert_array_equal(sub[0, :2], [3, 2])
+    assert sub[1, 0] == 4
+    assert tuple(np.asarray(result['label']).shape) == (2, 1)

@@ -1,0 +1,76 @@
+"""Named mirror of tests/test_error_clip.py (reference :14-81):
+set_error_clip on an ACTIVATION clips that var's gradient as the
+backward passes through, and the clipped cotangent propagates to
+upstream parameter grads; vars without a clip are untouched. The
+reference compares <var>@GRAD against numpy clip; here the observable
+contract is pinned numerically on a tiny net where the cotangent is
+computed by hand."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.executor import Scope, scope_guard
+
+CLIP_MAX = 2e-3
+CLIP_MIN = -1e-3
+
+
+def _run(with_clip):
+    """y = mean(square(h)), h = x @ w. dL/dh = 2h/size(h); with the
+    error clip on h, dL/dw = x^T @ clip(dL/dh)."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        h = layers.fc(input=x, size=3, bias_attr=False,
+                      param_attr=fluid.ParamAttr(
+                          name='ec_w',
+                          initializer=fluid.initializer.Constant(0.5)))
+        if with_clip:
+            main.global_block().var(h.name).set_error_clip(
+                fluid.clip.ErrorClipByValue(max=CLIP_MAX, min=CLIP_MIN))
+        loss = layers.mean(layers.square(h))
+        pg = fluid.backward.append_backward(
+            loss, callbacks=[fluid.clip.error_clip_callback])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(start)
+        xv = np.arange(8, dtype='float32').reshape(2, 4)
+        g, = exe.run(main, feed={'x': xv}, fetch_list=[pg[0][1]])
+        return np.asarray(g), xv
+
+
+def test_error_clip_clips_activation_cotangent():
+    g_plain, xv = _run(False)
+    g_clip, _ = _run(True)
+    # manual: h = x @ (0.5 ones), dL/dh = 2 h / 6
+    h = xv @ np.full((4, 3), 0.5, 'float32')
+    dh = 2.0 * h / h.size
+    expect_plain = xv.T @ dh
+    expect_clip = xv.T @ np.clip(dh, CLIP_MIN, CLIP_MAX)
+    np.testing.assert_allclose(g_plain, expect_plain, rtol=1e-5)
+    np.testing.assert_allclose(g_clip, expect_clip, rtol=1e-5)
+    assert not np.allclose(g_plain, g_clip)
+
+
+def test_error_clip_on_param_grad():
+    """The param-level path (reference clip.py append_clip_op through
+    error_clip_callback on (param, grad) pairs)."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[4], dtype='float32')
+        h = layers.fc(input=x, size=3, bias_attr=False,
+                      param_attr=fluid.ParamAttr(
+                          name='ecp_w',
+                          initializer=fluid.initializer.Constant(0.5)))
+        main.global_block().var('ecp_w').set_error_clip(
+            fluid.clip.ErrorClipByValue(max=CLIP_MAX, min=CLIP_MIN))
+        loss = layers.mean(layers.square(h))
+        pg = fluid.backward.append_backward(
+            loss, callbacks=[fluid.clip.error_clip_callback])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(start)
+        xv = np.arange(8, dtype='float32').reshape(2, 4)
+        g, = exe.run(main, feed={'x': xv}, fetch_list=[pg[0][1]])
+    g = np.asarray(g)
+    assert g.max() <= CLIP_MAX + 1e-9 and g.min() >= CLIP_MIN - 1e-9

@@ -1,0 +1,122 @@
+"""Named mirror of tests/demo/fc_gan.py (reference :80-160): the GAN
+training topology — one shared startup program, a discriminator
+program, a generator+discriminator program whose minimize() is
+restricted to the GENERATOR's parameters via parameter_list, and a
+mid-build clone that serves as the sampling program. Checks the
+contracts the demo relies on rather than image quality: selective
+updates (D frozen under the DG step), alternating training moves both
+losses, and the cloned g_program samples without touching state."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+NOISE = 16
+IMG = 36
+
+
+def _D(x):
+    hidden = fluid.layers.fc(input=x, size=32, act='relu',
+                             param_attr='D.w1', bias_attr='D.b1')
+    return fluid.layers.fc(input=hidden, size=1, act=None,
+                           param_attr='D.w2', bias_attr='D.b2')
+
+
+def _G(x):
+    hidden = fluid.layers.fc(input=x, size=32, act='relu',
+                             param_attr='G.w1', bias_attr='G.b1')
+    return fluid.layers.fc(input=hidden, size=IMG, act='tanh',
+                           param_attr='G.w2', bias_attr='G.b2')
+
+
+def _build():
+    startup = fluid.Program()
+    d_program = fluid.Program()
+    dg_program = fluid.Program()
+
+    with fluid.program_guard(d_program, startup):
+        img = fluid.layers.data(name='img', shape=[IMG], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='float32')
+        d_loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(
+                x=_D(img), label=label))
+
+    with fluid.program_guard(dg_program, startup):
+        noise = fluid.layers.data(name='noise', shape=[NOISE],
+                                  dtype='float32')
+        g_img = _G(noise)
+        g_program = dg_program.clone()
+        dg_loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(
+                x=_D(g_img),
+                label=fluid.layers.fill_constant_batch_size_like(
+                    input=noise, dtype='float32', shape=[-1, 1],
+                    value=1.0)))
+
+    opt = fluid.optimizer.Adam(learning_rate=1e-3)
+    with fluid.program_guard(d_program, startup):
+        opt.minimize(loss=d_loss, startup_program=startup)
+    g_params = [p.name for p in g_program.global_block().all_parameters()]
+    opt2 = fluid.optimizer.Adam(learning_rate=1e-3)
+    with fluid.program_guard(dg_program, startup):
+        opt2.minimize(loss=dg_loss, startup_program=startup,
+                      parameter_list=g_params)
+    return startup, d_program, dg_program, g_program, \
+        d_loss, dg_loss, g_img, g_params
+
+
+def test_fc_gan_training_topology():
+    startup, d_prog, dg_prog, g_prog, d_loss, dg_loss, g_img, g_params = \
+        _build()
+    assert sorted(g_params) == ['G.b1', 'G.b2', 'G.w1', 'G.w2']
+    rng = np.random.RandomState(0)
+    centers = rng.rand(IMG).astype('float32') * 0.5
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+
+        def d_weights():
+            return {n: np.asarray(fluid.fetch_var(n)).copy()
+                    for n in ['D.w1', 'D.w2']}
+
+        def g_weights():
+            return {n: np.asarray(fluid.fetch_var(n)).copy()
+                    for n in ['G.w1', 'G.w2']}
+
+        d_first = None
+        for step in range(30):
+            n = rng.uniform(-1, 1, (8, NOISE)).astype('float32')
+            gen, = exe.run(g_prog, feed={'noise': n}, fetch_list=[g_img])
+            real = centers + 0.1 * rng.randn(8, IMG).astype('float32')
+            total = np.concatenate([real, np.asarray(gen)])
+            lbl = np.concatenate([np.ones((8, 1), 'float32'),
+                                  np.zeros((8, 1), 'float32')])
+            dl, = exe.run(d_prog, feed={'img': total, 'label': lbl},
+                          fetch_list=[d_loss])
+            if d_first is None:
+                d_first = float(np.asarray(dl).ravel()[0])
+
+            # DG step must move ONLY generator weights
+            d_before = d_weights()
+            g_before = g_weights()
+            n2 = rng.uniform(-1, 1, (16, NOISE)).astype('float32')
+            dgl, = exe.run(dg_prog, feed={'noise': n2},
+                           fetch_list=[dg_loss])
+            d_after = d_weights()
+            g_after = g_weights()
+            for k in d_before:
+                np.testing.assert_array_equal(d_before[k], d_after[k])
+            assert any(not np.array_equal(g_before[k], g_after[k])
+                       for k in g_before)
+        assert np.isfinite(float(np.asarray(dl).ravel()[0]))
+        assert np.isfinite(float(np.asarray(dgl).ravel()[0]))
+        assert float(np.asarray(dl).ravel()[0]) < d_first   # D learned something
+
+        # the clone samples without mutating any weights
+        w0 = {**d_weights(), **g_weights()}
+        exe.run(g_prog, feed={'noise': rng.uniform(
+            -1, 1, (4, NOISE)).astype('float32')}, fetch_list=[g_img])
+        w1 = {**d_weights(), **g_weights()}
+        for k in w0:
+            np.testing.assert_array_equal(w0[k], w1[k])

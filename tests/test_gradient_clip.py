@@ -1,0 +1,96 @@
+"""Named mirror of tests/test_gradient_clip.py (reference :14-82): the
+clipped program's GLOBAL grad norm equals min(unclipped_norm,
+clip_norm) under GradientClipByGlobalNorm, via set_gradient_clip +
+append_gradient_clip_ops on a cloned program."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.executor import Scope, scope_guard
+
+CLIP = 1.0
+
+
+def _build():
+    prog, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, start):
+        image = layers.data(name='x', shape=[32], dtype='float32')
+        hidden1 = layers.fc(input=image, size=16, act='relu')
+        hidden2 = layers.fc(input=hidden1, size=8, act='relu')
+        predict = layers.fc(input=hidden2, size=4, act='softmax')
+        label = layers.data(name='y', shape=[1], dtype='int64')
+        avg_cost = layers.mean(
+            layers.cross_entropy(input=predict, label=label))
+    return prog, start, avg_cost
+
+
+def _global_norm(grads):
+    return float(np.sqrt(sum(np.sum(np.square(np.asarray(g)))
+                             for g in grads)))
+
+
+def test_global_norm_clip():
+    rng = np.random.RandomState(0)
+    feed = {'x': (10 * rng.randn(16, 32)).astype('float32'),
+            'y': rng.randint(0, 4, (16, 1)).astype('int64')}
+
+    prog, start, avg_cost = _build()
+    with fluid.program_guard(prog, start):
+        p_g = fluid.backward.append_backward(loss=avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(start)
+        out = exe.run(prog, feed=feed,
+                      fetch_list=[g for _, g in p_g])
+        norm_plain = _global_norm(out)
+
+    prog2, start2, avg_cost2 = _build()
+    with fluid.program_guard(prog2, start2):
+        p_g_clip = fluid.backward.append_backward(loss=avg_cost2)
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm=CLIP))
+        p_g_clip = fluid.clip.append_gradient_clip_ops(p_g_clip)
+    with scope_guard(Scope()):
+        exe.run(start2)
+        out_clip = exe.run(prog2, feed=feed,
+                           fetch_list=[g for _, g in p_g_clip])
+        norm_clip = _global_norm(out_clip)
+
+    # weights init identically (same seeds) -> same raw grads; the
+    # clipped run's global norm is min(raw, CLIP)
+    assert norm_plain > CLIP          # the case is non-trivial
+    np.testing.assert_allclose(norm_clip, min(norm_plain, CLIP),
+                               rtol=5e-3)
+
+
+def test_clip_by_value_and_norm_layers():
+    """GradientClipByValue / ByNorm per-grad contracts (reference
+    clip.py semantics), checked numerically."""
+    for mode, kw in [('value', dict(max=1e-4, min=-1e-4)),
+                     ('norm', dict(clip_norm=0.5))]:
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            x = layers.data(name='x', shape=[8], dtype='float32')
+            h = layers.fc(input=x, size=4, bias_attr=False,
+                          param_attr=fluid.ParamAttr(name='gc_w_' + mode))
+            loss = layers.mean(layers.square(h))
+            p_g = fluid.backward.append_backward(loss)
+            if mode == 'value':
+                clip = fluid.clip.GradientClipByValue(**kw)
+            else:
+                clip = fluid.clip.GradientClipByNorm(**kw)
+            fluid.clip.set_gradient_clip(clip)
+            p_g = fluid.clip.append_gradient_clip_ops(p_g)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(start)
+            rng = np.random.RandomState(1)
+            g, = exe.run(prog,
+                         feed={'x': (5 * rng.randn(4, 8)).astype(
+                             'float32')},
+                         fetch_list=[p_g[0][1]])
+        g = np.asarray(g)
+        if mode == 'value':
+            assert g.max() <= 1e-4 + 1e-9 and g.min() >= -1e-4 - 1e-9
+        else:
+            assert np.sqrt(np.sum(np.square(g))) <= 0.5 * (1 + 1e-5)

@@ -1,0 +1,52 @@
+"""Named mirror of tests/test_lod_tensor.py (reference :20-83):
+create_lod_tensor validation and construction,
+create_random_int_lodtensor shape/lod. The reference's offset-LoD
+(`lod()`) maps to lengths + sub_lengths on SequenceTensor; lod() still
+answers in offsets for compat."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import create_lod_tensor, create_random_int_lodtensor
+
+
+def test_validate_lod_rejects_inconsistent():
+    """Ref _validate_lod cases: the last level must tile the data rows;
+    each level must group all of the next level's sequences."""
+    data = np.random.random([6, 1]).astype('float32')
+    # sums to 4 != 6 rows
+    with pytest.raises(ValueError):
+        create_lod_tensor(data, [[1, 3]], fluid.CPUPlace())
+    # outer groups 4 inner seqs but only 3 given
+    with pytest.raises(ValueError):
+        create_lod_tensor(data, [[1, 3], [2, 1, 3]], fluid.CPUPlace())
+    # valid: [[2, 1], [3, 2, 1]] -> 6 rows
+    t = create_lod_tensor(data, [[2, 1], [3, 2, 1]], fluid.CPUPlace())
+    assert t is not None
+
+
+def test_create_lod_tensor_from_numpy():
+    """Ref :55-66 — lengths-form lod [[2,1],[3,3,4]] over 10 rows;
+    offsets come back as [[0,2,3],[0,3,6,10]]."""
+    data = np.random.random([10, 1]).astype('float32')
+    tensor = create_lod_tensor(data, [[2, 1], [3, 3, 4]],
+                               fluid.CPUPlace())
+    np.testing.assert_array_equal(np.asarray(tensor.lengths), [2, 1])
+    sub = np.asarray(tensor.sub_lengths)
+    np.testing.assert_array_equal(sub[0, :2], [3, 3])
+    assert sub[1, 0] == 4
+    # values land row-by-row
+    padded = np.asarray(tensor.data)
+    np.testing.assert_allclose(padded[0, 0, :3, 0], data[:3, 0])
+    np.testing.assert_allclose(padded[1, 0, :4, 0], data[6:, 0])
+
+
+def test_create_random_int_lodtensor():
+    """Ref :75-83 — shape [sum(lens), 1], values in [low, high]."""
+    tensor = create_random_int_lodtensor([[2, 3, 5]], [1],
+                                         fluid.CPUPlace(), 0, 9999)
+    np.testing.assert_array_equal(np.asarray(tensor.lengths), [2, 3, 5])
+    flat = np.asarray(tensor.data)
+    assert flat.reshape(-1).shape[0] >= 10       # padded >= total rows
+    vals = np.asarray(tensor.data)
+    assert vals.min() >= 0 and vals.max() <= 9999

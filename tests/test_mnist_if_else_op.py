@@ -1,0 +1,116 @@
+"""Named mirror of tests/test_mnist_if_else_op.py (reference :25-140):
+per-example conditional nets trained end to end — the raw
+split_lod_tensor + ConditionalBlock + merge_lod_tensor pipeline and the
+IfElse-sugar variant. Small synthetic digits keep it fast; the
+reference's pass criterion (loss < 1.0 within the budget) is kept.
+
+NB the reference file is DISABLED upstream (exit(0): "temp disable if
+else unittest since it could be buggy") — its shape=[1] limit yields a
+rank-1 vector that cannot compare elementwise against the [N, 1]
+label. The intended per-row condition needs shape=[1, 1]; this mirror
+uses that corrected formulation and actually passes.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _digit_stream(seed):
+    """Separable 784-dim 10-class toy batches (FIXED class means)."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(10, 784).astype('float32')
+
+    def batch(n):
+        y = rng.randint(0, 10, (n, 1)).astype('int64')
+        x = centers[y[:, 0]] + 0.3 * rng.randn(n, 784).astype('float32')
+        return x, y
+    return batch
+
+
+def test_raw_api():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        image = layers.data(name='x', shape=[784], dtype='float32')
+        label = layers.data(name='y', shape=[1], dtype='int64')
+        limit = layers.fill_constant_batch_size_like(
+            input=label, dtype='int64', shape=[1, 1], value=5.0)
+        cond = layers.less_than(x=label, y=limit)
+        true_image, false_image = layers.split_lod_tensor(
+            input=image, mask=cond)
+
+        true_out = layers.create_tensor(dtype='float32')
+        true_cond = layers.ConditionalBlock([true_image])
+        with true_cond.block():
+            hidden = layers.fc(input=true_image, size=100, act='tanh')
+            prob = layers.fc(input=hidden, size=10, act='softmax')
+            layers.assign(input=prob, output=true_out)
+
+        false_out = layers.create_tensor(dtype='float32')
+        false_cond = layers.ConditionalBlock([false_image])
+        with false_cond.block():
+            hidden = layers.fc(input=false_image, size=200, act='tanh')
+            prob = layers.fc(input=hidden, size=10, act='softmax')
+            layers.assign(input=prob, output=false_out)
+
+        prob = layers.merge_lod_tensor(
+            in_true=true_out, in_false=false_out, mask=cond, x=image)
+        loss = layers.cross_entropy(input=prob, label=label)
+        avg_loss = layers.mean(loss)
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(avg_loss)
+
+    batch = _digit_stream(0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        last = None
+        for step in range(150):
+            x, y = batch(64)
+            out, = exe.run(prog, feed={'x': x, 'y': y},
+                           fetch_list=[avg_loss])
+            last = float(np.asarray(out))
+            if last < 1.0:
+                break
+        assert last < 1.0, last
+
+
+def test_ifelse():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        image = layers.data(name='x', shape=[784], dtype='float32')
+        label = layers.data(name='y', shape=[1], dtype='int64')
+        limit = layers.fill_constant_batch_size_like(
+            input=label, dtype='int64', shape=[1, 1], value=5.0)
+        cond = layers.less_than(x=label, y=limit)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            true_image = ie.input(image)
+            hidden = layers.fc(input=true_image, size=100, act='tanh')
+            prob = layers.fc(input=hidden, size=10, act='softmax')
+            ie.output(prob)
+        with ie.false_block():
+            false_image = ie.input(image)
+            hidden = layers.fc(input=false_image, size=200, act='tanh')
+            prob = layers.fc(input=hidden, size=10, act='softmax')
+            ie.output(prob)
+        prob = ie()
+        loss = layers.cross_entropy(input=prob[0], label=label)
+        avg_loss = layers.mean(loss)
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(avg_loss)
+
+    batch = _digit_stream(1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        last = None
+        for step in range(150):
+            x, y = batch(64)
+            out, = exe.run(prog, feed={'x': x, 'y': y},
+                           fetch_list=[avg_loss])
+            last = float(np.asarray(out))
+            if last < 1.0:
+                break
+        assert last < 1.0, last

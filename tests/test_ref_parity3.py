@@ -1226,7 +1226,13 @@ def test_edit_distance_reference_fixture():
     hyp = np.array([0, 12, 3, 5, 8, 2], 'int32').reshape(6, 1)
     ref = np.array([0, 12, 4, 7, 8], 'int32').reshape(5, 1)
     h = create_lod_tensor(hyp, [[1, 5]])
-    rf = create_lod_tensor(ref, [[3, 1]])
+    # the reference fixture's offset LoD [0, 3, 4] UNDER-covers the 5
+    # rows (row 4 unused) — build through the imperative offset surface
+    # like the fixture does
+    from paddle_tpu.lod import SequenceTensor
+    rf = SequenceTensor()
+    rf.set(ref)
+    rf.set_lod([[0, 3, 4]])
     got, = run_op('edit_distance', {'Hyps': h, 'Refs': rf},
                   {'normalized': False},
                   lod_levels={'Hyps': 1, 'Refs': 1},
