@@ -377,7 +377,7 @@ class Executor(object):
         return pruned
 
     def _pull_program_readers(self, program, feed, scope=None,
-                              consume=True):
+                              consume=True, fetch_names=None):
         """Program readers (open_recordio_file / random_data_generator
         + decorator chain): when the program binds a host-side reader
         and its slot vars are not explicitly fed, pull the next batch
@@ -396,6 +396,29 @@ class Executor(object):
         readers = [v for v in program.global_block().vars.values()
                    if isinstance(v, ReaderVar)
                    and getattr(v, 'source', None) is not None]
+        if not readers:
+            return feed
+        # only readers whose slot vars this RUN actually consumes get a
+        # batch pulled — the reference's reader produces data only when
+        # its read op executes (read_op.cc). Consumption = input of an
+        # op that survives fetch-pruning, or a direct fetch (read_file
+        # outputs fetched with no downstream op). An unconsumed reader
+        # bound in the same program (the demo's test reader built
+        # alongside the train one) or one feeding a pruned-away branch
+        # must not be drained.
+        consumed = program.__dict__.setdefault('_consumed_memo', {})
+        key = (program.fingerprint(),
+               tuple(sorted(fetch_names)) if fetch_names else None)
+        used = consumed.get(key)
+        if used is None:
+            src_prog = self._maybe_prune(program, list(fetch_names or []))
+            used = set(fetch_names or [])
+            for blk in src_prog.blocks:
+                for op in blk.ops:
+                    used.update(op.input_arg_names)
+            consumed[key] = used
+        readers = [rv for rv in readers
+                   if any(fv.name in used for fv in rv.feed_vars)]
         if not readers:
             return feed
         scope = scope or global_scope()
@@ -459,10 +482,11 @@ class Executor(object):
         dropped). Returns a 5-tuple ending with ``static_env`` — feeds
         consumed only through shape-defining slots, bound statically at
         trace time (their values must join any jit cache key)."""
-        feed = self._pull_program_readers(program, feed, scope,
-                                          consume=consume_readers)
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
+        feed = self._pull_program_readers(program, feed, scope,
+                                          consume=consume_readers,
+                                          fetch_names=fetch_names)
         feed = self._prepare_feed(program, feed, dynamic=dynamic)
         static_env = self._extract_static_feeds(program, feed)
         state_in, state_out = self._state_names(program, scope)

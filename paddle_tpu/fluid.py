@@ -42,6 +42,7 @@ from .debugging import check_nan_inf, nan_guard, nan_checks_enabled  # noqa
 from . import graphviz  # noqa
 from . import net_drawer  # noqa
 from . import concurrency  # noqa
+from . import recordio_writer  # noqa
 from .recordio_writer import (convert_reader_to_recordio_file,  # noqa
                               convert_reader_to_recordio_files)
 LoDTensor = SequenceTensor

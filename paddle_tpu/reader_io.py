@@ -62,6 +62,21 @@ def read_records(path):
             yield payload
 
 
+def _rebuild_slots(slots):
+    """PTRC records tag LoD-carrying slots as
+    ('__seq__', data, lengths, sub_lengths) — rebuild SequenceTensor
+    so sequence ops downstream of read_file see the lengths (plain
+    arrays pass through; old files with untagged slots still read)."""
+    from .lod import SequenceTensor
+    out = []
+    for s in slots:
+        if isinstance(s, tuple) and len(s) == 4 and s[0] == '__seq__':
+            out.append(SequenceTensor(s[1], s[2], s[3]))
+        else:
+            out.append(s)
+    return type(slots)(out) if isinstance(slots, tuple) else out
+
+
 class RecordIOSource(object):
     """Host-side source bound to open_recordio_file/open_files outputs."""
 
@@ -108,7 +123,7 @@ class RecordIOSource(object):
                 it = native_loader.read_records(fn) \
                     if native_loader.available() else read_records(fn)
                 for payload in it:
-                    yield pickle.loads(payload)
+                    yield _rebuild_slots(pickle.loads(payload))
 
 
 class RandomDataSource(object):

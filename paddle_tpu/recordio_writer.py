@@ -48,14 +48,21 @@ def convert_reader_to_recordio_file(filename, reader_creator, feeder,
     with RecordIOWriter(filename, compressor, max_num_records) as writer:
         for batch in reader_creator():
             res = feeder.feed(batch)
-            slots = []
-            for name in feed_order:
-                v = res[name]
-                slots.append(np.asarray(v.data) if hasattr(v, 'data')
-                             else np.asarray(v))
+            slots = [_serialize_slot(res[name]) for name in feed_order]
             writer.write(pickle.dumps(slots, protocol=4))
             counter += 1
     return counter
+
+
+def _serialize_slot(v):
+    """One feed value -> picklable PTRC slot. SequenceTensors are
+    tagged so the LoD survives the round trip (padded data alone loses
+    it — sequence ops on the read side need the lengths; the reader's
+    _rebuild_slots inverts this)."""
+    if getattr(v, 'lengths', None) is not None:
+        return ('__seq__', np.asarray(v.data), np.asarray(v.lengths),
+                None if v.sub_lengths is None else np.asarray(v.sub_lengths))
+    return np.asarray(v.data) if hasattr(v, 'data') else np.asarray(v)
 
 
 def convert_reader_to_recordio_files(filename, batch_per_file,
@@ -77,9 +84,7 @@ def convert_reader_to_recordio_files(filename, batch_per_file,
                                 max_num_records) as writer:
                 for l in lines:
                     res = feeder.feed(l)
-                    slots = [np.asarray(res[n].data)
-                             if hasattr(res[n], 'data')
-                             else np.asarray(res[n]) for n in feed_order]
+                    slots = [_serialize_slot(res[n]) for n in feed_order]
                     writer.write(pickle.dumps(slots, protocol=4))
                     counter += 1
                 lines = []

@@ -1,0 +1,109 @@
+"""Acceptance: the reference demo/text_classification/train.py network
+runs UNCHANGED — recordio files through open_files -> shuffle ->
+double_buffer -> read_file -> embedding/sequence_conv_pool, trained via
+ParallelExecutor with share_vars_from eval and reader reset, exactly as
+the demo's main() does (its own loop is unbounded `for i in
+xrange(sys.maxint)`, so the test drives the same calls with a bound).
+
+Ref: python/paddle/fluid/tests/demo/text_classification/train.py.
+"""
+import os
+import types
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle  # noqa: F401
+import paddle.fluid as fluid
+
+DEMO = ('/root/reference/python/paddle/fluid/tests/demo/'
+        'text_classification/train.py')
+
+
+def _load_demo():
+    if not os.path.exists(DEMO):
+        pytest.skip('reference checkout not available')
+    with open(DEMO) as f:
+        src = f.read()
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        from lib2to3 import refactor
+        tool = refactor.RefactoringTool(
+            refactor.get_fixers_from_package('lib2to3.fixes'))
+        src = str(tool.refactor_string(src + '\n', DEMO))
+    mod = types.ModuleType('refscript_demo_text_classification')
+    mod.__file__ = DEMO
+    exec(compile(src, DEMO, 'exec'), mod.__dict__)
+    return mod
+
+
+def _write_recordio(filename, n_batches, batch_size, rng):
+    """Tiny imdb-shaped batches [(words lod int64, label int64)] through
+    the repo's own writer (the demo's converter does the same calls)."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        data = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                                 lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        feeder = fluid.DataFeeder(feed_list=[data, label],
+                                  place=fluid.CPUPlace())
+
+    def reader():
+        for _ in range(n_batches):
+            batch = []
+            for _ in range(batch_size):
+                n = rng.randint(4, 12)
+                words = rng.randint(0, 5000, n).astype('int64')
+                batch.append((words, [int(words[0] % 2)]))
+            yield batch
+
+    fluid.recordio_writer.convert_reader_to_recordio_file(
+        filename, reader_creator=reader, feeder=feeder)
+
+
+def test_demo_network_trains_from_recordio(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.RandomState(0)
+    _write_recordio('train.recordio', 6, 16, rng)
+    _write_recordio('test.recordio', 2, 16, rng)
+
+    mod = _load_demo()
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        train = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(train, startup):
+            train_args = mod.network_cfg(is_train=True, pass_num=20)
+        test = fluid.Program()
+        with fluid.program_guard(test, fluid.Program()):
+            test_args = mod.network_cfg(is_train=False)
+
+        exe = fluid.Executor(place=fluid.CPUPlace())
+        exe.run(startup)
+        train_exe = fluid.ParallelExecutor(
+            use_cuda=False, loss_name=train_args['loss'].name,
+            main_program=train)
+        fetch_var_list = [var.name for var in train_args['log']]
+        losses = []
+        for i in range(8):
+            result = list(map(np.array,
+                              train_exe.run(fetch_list=fetch_var_list)))
+            losses.append(float(np.asarray(result[0]).ravel()[0]))
+        assert all(np.isfinite(losses))
+
+        # eval exactly like the demo: share_vars_from + drain-to-EOF +
+        # reader reset
+        test_exe = fluid.ParallelExecutor(
+            use_cuda=False, main_program=test, share_vars_from=train_exe)
+        loss, acc = [], []
+        try:
+            while True:
+                loss_np, acc_np = list(map(
+                    np.array, test_exe.run(fetch_list=fetch_var_list)))
+                loss.append(loss_np.ravel()[0])
+                acc.append(acc_np.ravel()[0])
+        except fluid.core.EOFException:
+            test_args['file'].reset()
+        assert loss and np.isfinite(np.mean(loss))
+        assert 0.0 <= np.mean(acc) <= 1.0

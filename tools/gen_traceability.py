@@ -259,10 +259,11 @@ TOPLEVEL = [
      'tests/test_memory_optimization_transpiler.py + '
      'tests/test_books.py NMT'),
     ('demo/fc_gan.py', 'mirror', 'tests/test_fc_gan.py'),
-    ('demo/text_classification/train.py', 'covered',
-     'tests/test_reference_scripts.py understand_sentiment variants '
-     '(same conv text-classification topology) + '
-     'tests/test_recordio_compat.py (its recordio data path)'),
+    ('demo/text_classification/train.py', 'mirror',
+     'tests/test_demo_text_classification.py — the script\'s OWN '
+     'network_cfg runs unchanged: recordio -> open_files -> shuffle -> '
+     'double_buffer -> read_file -> ParallelExecutor train + '
+     'share_vars_from eval + reader reset'),
 ]
 
 
@@ -325,7 +326,8 @@ def main():
         counts[kind if kind != 'N/A' else 'na'] = \
             counts.get(kind if kind != 'N/A' else 'na', 0) + 1
         if kind == 'mirror':
-            target = detail.split()[0].replace('tests/', '')
+            target = detail.split()[0].replace('tests/', '').rstrip(',')
+            target = target.split('\u2014')[0].strip()
             assert os.path.exists(os.path.join(REPO, 'tests', target)), \
                 'TOPLEVEL mirror target missing: %s' % detail
 
