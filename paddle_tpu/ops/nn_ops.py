@@ -390,10 +390,18 @@ def _dice_loss(ctx):
 
 @register_kernel('nce')
 def _nce(ctx):
-    """Sampled NCE loss. TPU-first: fixed sample count per step (static
-    shape), uniform negative sampling. Parity: operators/nce_op.cc."""
+    """Sampled NCE loss, REFERENCE-EXACT math (operators/nce_op.h
+    forward, oracled by tests/unittests/test_nce.py): per sample s the
+    op takes o = sigmoid(logit(s)) and scores true samples with
+    -log(o / (o + b)) and sampled negatives with -log(b / (o + b)),
+    b = num_neg / num_classes — NOT the classic raw-score NCE ratio.
+    Multi-column labels supported; SampleLogits are the post-sigmoid
+    values [B, num_true + k]; SampleLabels = [labels..., sampled...].
+    TPU-first: fixed sample count (static shape), uniform sampling."""
     x = unwrap(ctx.input('Input'))
-    label = unwrap(ctx.input('Label')).astype('int32').reshape((-1,))
+    labels = unwrap(ctx.input('Label')).astype('int32')
+    if labels.ndim == 1:
+        labels = labels[:, None]
     w = unwrap(ctx.input('Weight'))
     num_neg = ctx.attr('num_neg_samples', 10)
     num_classes = ctx.attr('num_total_classes', w.shape[0])
@@ -406,35 +414,38 @@ def _nce(ctx):
     else:
         key = ctx.next_rng()
         neg = jax.random.randint(key, (num_neg,), 0, num_classes)
-    b = unwrap(ctx.input('Bias')) if ctx.has_input('Bias') else None
+    b_in = unwrap(ctx.input('Bias')) if ctx.has_input('Bias') else None
 
-    def logit(ids):
-        lw = jnp.take(w, ids, axis=0)
-        out = jnp.einsum('bd,kd->bk', x, lw) if ids.ndim == 1 else \
-            jnp.sum(x * lw, -1, keepdims=True)
-        if b is not None:
-            out = out + jnp.take(b, ids).reshape((1, -1) if ids.ndim == 1
-                                                 else (-1, 1))
-        return out
-
-    pos_logit = jnp.sum(x * jnp.take(w, label, axis=0), -1, keepdims=True)
-    if b is not None:
-        pos_logit = pos_logit + jnp.take(b, label)[:, None]
-    neg_logit = logit(neg)
-    p_noise = 1.0 / num_classes
-    pos_loss = -jax.nn.log_sigmoid(pos_logit - jnp.log(num_neg * p_noise))
-    neg_loss = -jnp.sum(jax.nn.log_sigmoid(
-        -(neg_logit - jnp.log(num_neg * p_noise))), -1, keepdims=True)
-    cost = pos_loss + neg_loss
+    B = x.shape[0]
+    # logits for the true columns [B, T] and the shared negatives [B, k]
+    true_logit = jnp.einsum('bd,btd->bt', x, jnp.take(w, labels, axis=0))
+    neg_logit = jnp.einsum('bd,kd->bk', x, jnp.take(w, neg, axis=0))
+    if b_in is not None:
+        true_logit = true_logit + jnp.take(b_in, labels)
+        neg_logit = neg_logit + jnp.take(b_in, neg)[None, :]
+    o_neg = jax.nn.sigmoid(neg_logit)
+    bnoise = float(num_neg) / float(num_classes)
+    # true-sample term in the numerically stable identity
+    # -log(sig(s)/(sig(s)+b)) = logaddexp(log1p(b), log(b) - s)
+    # (exact same value; the naive sigmoid-then-log form overflows to
+    # inf for strongly negative logits)
+    cost = jnp.logaddexp(jnp.log1p(bnoise),
+                         jnp.log(bnoise) - true_logit) \
+        .sum(-1, keepdims=True) \
+        + (-jnp.log(bnoise / (o_neg + bnoise))).sum(-1, keepdims=True)
     if ctx.has_input('SampleWeight'):
         # nce_op.h: sample_weight[i] scales example i's whole cost row
         sw = unwrap(ctx.input('SampleWeight')).reshape((-1, 1))
         cost = cost * sw.astype(cost.dtype)
     ctx.set_output('Cost', cost)
     if ctx.output_names('SampleLogits'):
-        ctx.set_output('SampleLogits', neg_logit)
+        ctx.set_output('SampleLogits',
+                       jnp.concatenate([jax.nn.sigmoid(true_logit),
+                                        o_neg], axis=1))
     if ctx.output_names('SampleLabels'):
-        ctx.set_output('SampleLabels', neg)
+        ctx.set_output('SampleLabels', jnp.concatenate(
+            [labels, jnp.broadcast_to(neg[None, :], (B, num_neg))],
+            axis=1))
 
 
 @register_kernel('im2sequence')

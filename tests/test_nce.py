@@ -1,0 +1,155 @@
+"""Named mirror of tests/unittests/test_nce.py (reference :20-105): the
+numpy NCE oracle (sigmoid-then-ratio scoring, sample weights, multi-
+column labels, pinned custom negatives) against the nce kernel, both
+test cases, outputs Cost/SampleLogits/SampleLabels, plus a central-
+difference grad check on Input/Weight/Bias."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _nce_oracle(x, weight, bias, sample_weight, labels, num_classes,
+                negs):
+    """Re-derivation of nce_op.h forward (independent of the kernel)."""
+    B, T = labels.shape
+    k = len(negs)
+    bn = float(k) / num_classes
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    cost = np.zeros((B, 1), np.float64)
+    slog = np.zeros((B, T + k), np.float64)
+    slab = np.zeros((B, T + k), np.int64)
+    for i in range(B):
+        w = 1.0 if sample_weight is None else sample_weight[i]
+        for t in range(T):
+            c = labels[i, t]
+            o = sig(x[i] @ weight[c] + (bias[c] if bias is not None
+                                        else 0.0))
+            cost[i, 0] += w * -np.log(o / (o + bn))
+            slog[i, t] = o
+            slab[i, t] = c
+        for j, c in enumerate(negs):
+            o = sig(x[i] @ weight[c] + (bias[c] if bias is not None
+                                        else 0.0))
+            cost[i, 0] += w * -np.log(bn / (o + bn))
+            slog[i, T + j] = o
+            slab[i, T + j] = c
+    return cost, slog, slab
+
+
+def _run_nce(x, weight, bias, sample_weight, labels, num_classes, negs,
+             fetch_grads=False):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        b = main.global_block()
+        xv = b.create_var(name='X', shape=list(x.shape), dtype='float32')
+        lv = b.create_var(name='L', shape=list(labels.shape),
+                          dtype='int64')
+        wv = b.create_parameter(
+            name='W', shape=list(weight.shape), dtype='float32')
+        bv = b.create_parameter(
+            name='Bz', shape=list(bias.shape), dtype='float32')
+        cost = b.create_var(name='Cost', shape=[x.shape[0], 1],
+                            dtype='float32')
+        slog = b.create_var(name='SLog', dtype='float32')
+        slab = b.create_var(name='SLab', dtype='int64',
+                            stop_gradient=True)
+        inputs = {'Input': xv, 'Label': lv, 'Weight': wv, 'Bias': bv}
+        feed = {'X': x, 'L': labels}
+        if sample_weight is not None:
+            sw = b.create_var(name='SW', shape=[x.shape[0]],
+                              dtype='float32')
+            inputs['SampleWeight'] = sw
+            feed['SW'] = sample_weight
+        b.append_op(type='nce', inputs=inputs,
+                    outputs={'Cost': cost, 'SampleLogits': slog,
+                             'SampleLabels': slab},
+                    attrs={'num_total_classes': num_classes,
+                           'num_neg_samples': len(negs),
+                           'custom_neg_classes': list(negs)})
+        fetches = [cost, slog, slab]
+        if fetch_grads:
+            loss = fluid.layers.mean(cost)
+            fluid.backward.append_backward(loss)
+            fetches += ['W@GRAD', 'Bz@GRAD']
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        fluid.global_scope().set_var('W', weight)
+        fluid.global_scope().set_var('Bz', bias)
+        outs = exe.run(main, feed=feed, fetch_list=fetches)
+    return [np.asarray(o) for o in outs]
+
+
+@pytest.mark.parametrize('dim,bs,C,T,k', [(5, 5, 4, 1, 2),
+                                          (10, 20, 10, 2, 5)])
+def test_nce_matches_reference_oracle(dim, bs, C, T, k):
+    rng = np.random.RandomState(0)
+    x = rng.randn(bs, dim).astype('float32')
+    weight = rng.randn(C, dim).astype('float32')
+    bias = rng.randn(C).astype('float32')
+    sw = np.abs(rng.randn(bs)).astype('float32')
+    labels = rng.randint(0, C, (bs, T)).astype('int64')
+    negs = list(range(k))
+    cost, slog, slab = _run_nce(x, weight, bias, sw, labels, C, negs)
+    ecost, eslog, eslab = _nce_oracle(x, weight, bias, sw, labels, C,
+                                      negs)
+    np.testing.assert_allclose(cost, ecost, rtol=2e-4)
+    np.testing.assert_allclose(slog, eslog, rtol=2e-4)
+    np.testing.assert_array_equal(slab, eslab)
+
+
+def test_nce_grad_central_difference():
+    """check_grad analog: d(mean cost)/dW and /dBias vs central
+    differences on the oracle (max_relative_error 0.02, like the
+    reference)."""
+    rng = np.random.RandomState(1)
+    dim, bs, C, T, k = 4, 3, 5, 1, 2
+    x = rng.randn(bs, dim).astype('float32') * 0.5
+    weight = rng.randn(C, dim).astype('float32') * 0.5
+    bias = rng.randn(C).astype('float32') * 0.2
+    labels = rng.randint(0, C, (bs, T)).astype('int64')
+    negs = [0, 2]
+    outs = _run_nce(x, weight, bias, None, labels, C, negs,
+                    fetch_grads=True)
+    gw, gb = outs[-2], outs[-1]
+
+    def loss_of(wv, bv):
+        c, _, _ = _nce_oracle(x, wv, bv, None, labels, C, negs)
+        return float(c.mean())
+
+    eps = 1e-3
+    for idx in [(0, 0), (2, 1), (4, 3)]:
+        wp = weight.copy(); wp[idx] += eps
+        wm = weight.copy(); wm[idx] -= eps
+        num = (loss_of(wp, bias) - loss_of(wm, bias)) / (2 * eps)
+        np.testing.assert_allclose(gw[idx], num, rtol=0.02, atol=1e-4)
+    for i in [0, 2]:
+        bp = bias.copy(); bp[i] += eps
+        bm = bias.copy(); bm[i] -= eps
+        num = (loss_of(weight, bp) - loss_of(weight, bm)) / (2 * eps)
+        np.testing.assert_allclose(gb[i], num, rtol=0.02, atol=1e-4)
+
+
+def test_nce_stable_at_extreme_logits():
+    """The true-sample term must stay finite (and differentiable) for
+    strongly negative logits where sigmoid underflows to 0 — the
+    stable logaddexp identity, not naive sigmoid-then-log."""
+    rng = np.random.RandomState(2)
+    dim, bs, C = 4, 2, 6
+    x = np.full((bs, dim), 10.0, 'float32')
+    weight = np.zeros((C, dim), 'float32')
+    weight[0] = -5.0          # true-class logit = -200 -> sigmoid == 0
+    weight[1] = 5.0
+    bias = np.zeros(C, 'float32')
+    labels = np.zeros((bs, 1), np.int64)
+    outs = _run_nce(x, weight, bias, None, labels, C, [1, 2],
+                    fetch_grads=True)
+    cost, gw = outs[0], outs[-2]
+    assert np.isfinite(cost).all(), cost
+    assert np.isfinite(gw).all(), gw
+    # value matches the identity directly
+    bn = 2.0 / C
+    expect_true = np.logaddexp(np.log1p(bn), np.log(bn) - (-200.0))
+    assert abs(cost[0, 0] - expect_true -
+               (-np.log(bn / (1.0 + bn)) - np.log(bn / (bn + 0.5)))) < 1e-3

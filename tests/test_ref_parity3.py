@@ -1566,9 +1566,10 @@ def test_mine_hard_examples_reference_fixture():
 
 def test_nce_loss_formula():
     """Mirrors test_nce_op.py: with custom_neg_classes pinned (the
-    reference's own unit-test hook, nce_op.cc), the logistic NCE loss
-    is exactly -log sig(s_pos - log(k*p)) - sum log sig(-(s_neg -
-    log(k*p))) with uniform p = 1/C."""
+    reference's own unit-test hook, nce_op.cc), the cost is the
+    reference op's EXACT math — o = sigmoid(logit), true samples score
+    -log(o/(o+b)), sampled negatives -log(b/(o+b)), b = k/C
+    (nce_op.h; NOT the classic raw-score NCE ratio)."""
     r = _rng(101)
     B, D, C = 4, 8, 10
     x = r.random_sample((B, D)).astype('float32')
@@ -1583,14 +1584,14 @@ def test_nce_loss_formula():
                   out_slots=('Cost',))
     g = np.asarray(got)
     sig = lambda v: 1 / (1 + np.exp(-v))
-    k_p = 3 * (1.0 / C)
+    bn = 3.0 / C
     ref = np.zeros((B, 1), 'float32')
     for i in range(B):
-        s_pos = x[i] @ w[lab[i, 0]] + b[lab[i, 0]]
-        ref[i, 0] = -np.log(sig(s_pos - np.log(k_p)))
+        o = sig(x[i] @ w[lab[i, 0]] + b[lab[i, 0]])
+        ref[i, 0] = -np.log(o / (o + bn))
         for n in negs:
-            s_neg = x[i] @ w[n] + b[n]
-            ref[i, 0] += -np.log(sig(-(s_neg - np.log(k_p))))
+            on = sig(x[i] @ w[n] + b[n])
+            ref[i, 0] += -np.log(bn / (on + bn))
     np.testing.assert_allclose(g, ref, rtol=1e-4)
 
 
