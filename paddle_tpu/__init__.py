@@ -64,6 +64,7 @@ from .parallel.transpiler import (DistributeTranspiler,  # noqa
                                   memory_optimize, release_memory)
 from . import transpiler  # noqa
 from . import recordio_writer  # noqa
+from . import contrib  # noqa
 from .clip import ErrorClipByValue  # noqa
 
 Tensor = SequenceTensor  # loose alias for scripts touching fluid.Tensor
@@ -85,6 +86,6 @@ __all__ = [
     'metrics', 'evaluator', 'profiler', 'reader', 'dataset', 'batch',
     'ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy',
     'DistributeTranspiler', 'SimpleDistributeTranspiler',
-    'InferenceTranspiler', 'transpiler', 'recordio_writer',
+    'InferenceTranspiler', 'transpiler', 'recordio_writer', 'contrib',
     'memory_optimize', 'release_memory',
 ]

@@ -1,0 +1,8 @@
+"""fluid.contrib — reference paddle/contrib counterparts.
+
+Currently: float16_transpiler (half-precision inference).
+"""
+from . import float16_transpiler  # noqa
+from .float16_transpiler import Float16Transpiler  # noqa
+
+__all__ = ['float16_transpiler', 'Float16Transpiler']

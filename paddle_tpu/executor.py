@@ -173,6 +173,27 @@ def as_numpy(value):
     return np.asarray(value)
 
 
+def _to_f32_fetch(f):
+    """Half-inference boundary: float fetches back to f32, preserving
+    SequenceTensor structure (incl. packed mode)."""
+    if isinstance(f, SequenceTensor):
+        if f._packed is not None and f._offsets:
+            p = jnp.asarray(f._packed)
+            if jnp.issubdtype(p.dtype, jnp.floating):
+                return SequenceTensor.from_packed(
+                    p.astype(jnp.float32), f._offsets)
+            return f
+        d = jnp.asarray(f.data)
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            return SequenceTensor(d.astype(jnp.float32), f.lengths,
+                                  f.sub_lengths)
+        return f
+    if hasattr(f, 'dtype') and jnp.issubdtype(jnp.asarray(f).dtype,
+                                              jnp.floating):
+        return jnp.asarray(f).astype(jnp.float32)
+    return f
+
+
 def fetch_var(name, scope=None, return_numpy=True):
     scope = scope or global_scope()
     val = scope.raw(name)
@@ -303,6 +324,32 @@ class Executor(object):
                 arr = np.asarray(val)
                 dt = runtime_dtype(var.dtype if var else arr.dtype)
                 out[name] = arr.astype(dt)
+        half = getattr(program, '_half_inference', None)
+        if half:
+            # Float16Transpiler contract: the USER keeps feeding f32;
+            # the boundary cast lives here (the reference appends cast
+            # ops instead, contrib/float16/float16_transpiler.py).
+            # numpy casting (ml_dtypes) keeps host feeds host-side so
+            # device placement still happens under the run's
+            # default_device, like the dt casts above.
+            hdt = np.dtype(half)
+            for name, val in out.items():
+                if isinstance(val, SequenceTensor):
+                    if val._packed is not None:
+                        # packed-mode (eager decode) feeds keep their
+                        # offset-LoD representation; the eager kernels
+                        # consume f32 fine
+                        continue
+                    if str(val.data.dtype) == 'float32':
+                        data = (val.data.astype(hdt)
+                                if isinstance(val.data, jax.Array)
+                                else np.asarray(val.data).astype(hdt))
+                        out[name] = SequenceTensor(data, val.lengths,
+                                                   val.sub_lengths)
+                elif str(getattr(val, 'dtype', '')) == 'float32':
+                    out[name] = (val.astype(hdt)
+                                 if isinstance(val, jax.Array)
+                                 else np.asarray(val).astype(hdt))
         return out
 
     def _state_names(self, program, scope):
@@ -592,6 +639,10 @@ class Executor(object):
                 fetches, new_state = jitted(feed, state)
         for n, v in new_state.items():
             scope.set_var(n, v)
+        if getattr(program, '_half_inference', None):
+            # boundary contract: fetches come back float32 even though
+            # the net ran in half (Float16Transpiler)
+            fetches = [_to_f32_fetch(f) for f in fetches]
         if return_numpy:
             fetches = [as_numpy(f) for f in fetches]
         else:

@@ -43,6 +43,7 @@ from . import graphviz  # noqa
 from . import net_drawer  # noqa
 from . import concurrency  # noqa
 from . import recordio_writer  # noqa
+from . import contrib  # noqa
 from .recordio_writer import (convert_reader_to_recordio_file,  # noqa
                               convert_reader_to_recordio_files)
 LoDTensor = SequenceTensor

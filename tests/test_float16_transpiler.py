@@ -1,0 +1,83 @@
+"""Named mirror of paddle/contrib/float16/float16_transpiler.py: a
+trained f32 inference program transpiles to half precision — weights
+cast in the scope, the user still feeds/fetches float32, outputs match
+the f32 run closely. TPU ruling: bfloat16 is the native half dtype
+(reference float16 accepted for parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _build_infer():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        img = layers.data(name='img', shape=[3, 16, 16], dtype='float32')
+        c = layers.conv2d(img, num_filters=8, filter_size=3, act='relu')
+        bn = layers.batch_norm(c, is_test=True)
+        p = layers.pool2d(bn, pool_size=2, pool_stride=2)
+        out = layers.fc(p, size=10, act='softmax')
+    return main, start, out
+
+
+@pytest.mark.parametrize('dtype', ['bfloat16', 'float16'])
+def test_float16_transpile_matches_f32(dtype):
+    main, start, out = _build_infer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 3, 16, 16).astype('float32')
+    with scope_guard(Scope()):
+        exe.run(start)
+        ref, = exe.run(main, feed={'img': xv}, fetch_list=[out])
+        ref = np.asarray(ref)
+
+        t = fluid.contrib.Float16Transpiler()
+        n = t.transpile(main, fluid.CPUPlace(), dtype=dtype)
+        assert n >= 4          # conv w/b, bn scale/shift/stats, fc w/b
+
+        # weights really stored half in the scope
+        import jax.numpy as jnp
+        w = fluid.global_scope().raw(
+            main.global_block().all_parameters()[0].name)
+        assert str(jnp.asarray(w).dtype) == dtype
+
+        # user still feeds f32 and gets f32 back
+        half, = exe.run(main, feed={'img': xv}, fetch_list=[out])
+        half = np.asarray(half)
+        assert half.dtype == np.float32
+        np.testing.assert_allclose(half, ref, rtol=5e-2, atol=5e-3)
+        # it's not secretly the f32 path: probabilities differ slightly
+        assert not np.array_equal(half, ref)
+
+
+def test_float16_transpiler_rejects_unknown_dtype():
+    main, start, out = _build_infer()
+    with pytest.raises(ValueError):
+        fluid.contrib.Float16Transpiler().transpile(main, None,
+                                                    dtype='int8')
+
+
+def test_float16_transpile_sequence_fetch():
+    """A transpiled program with an LoD fetch returns a float32
+    SequenceTensor (the fetch cast preserves sequence structure)."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data(name='x', shape=[1], dtype='float32',
+                        lod_level=1)
+        s = layers.sequence_softmax(layers.scale(x, scale=2.0))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(start)
+        t = fluid.create_lod_tensor(
+            np.random.RandomState(0).rand(5, 1).astype('float32'),
+            [[2, 3]], fluid.CPUPlace())
+        fluid.contrib.Float16Transpiler().transpile(main,
+                                                    fluid.CPUPlace())
+        r, = exe.run(main, feed={'x': t}, fetch_list=[s],
+                     return_numpy=False)
+    from paddle_tpu.lod import SequenceTensor
+    assert isinstance(r, SequenceTensor)
+    assert str(np.asarray(r.data).dtype) == 'float32'
+    assert np.isfinite(np.asarray(r.data)).all()
