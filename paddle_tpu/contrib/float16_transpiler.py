@@ -13,8 +13,6 @@ benchmarking.
 """
 import numpy as np
 
-from ..framework import Parameter
-
 __all__ = ['Float16Transpiler']
 
 _HALF = ('float16', 'bfloat16')

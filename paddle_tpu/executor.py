@@ -277,6 +277,18 @@ class Executor(object):
     # -------------------------------------------------------------------------
     def _prepare_feed(self, program, feed, dynamic=False):
         block = program.global_block()
+        # Float16Transpiler contract: the USER keeps feeding f32; the
+        # boundary cast folds into the dtype selection below (the
+        # reference appends cast ops instead,
+        # contrib/float16/float16_transpiler.py). numpy casting
+        # (ml_dtypes) keeps host feeds host-side so device placement
+        # still happens under the run's default_device.
+        half = getattr(program, '_half_inference', None)
+
+        def _dt(d):
+            d = runtime_dtype(d)
+            return half if half and d == 'float32' else d
+
         out = {}
         for name, val in feed.items():
             var = block._find_var_recursive(name)
@@ -304,52 +316,26 @@ class Executor(object):
             if isinstance(val, SequenceTensor):
                 if isinstance(val.data, jax.Array):
                     # Device-resident sequence feed: no host round-trip.
-                    dt = runtime_dtype(var.dtype if var else val.data.dtype)
+                    dt = _dt(var.dtype if var else val.data.dtype)
                     data = val.data if str(val.data.dtype) == dt \
                         else val.data.astype(dt)
                     out[name] = SequenceTensor(data, val.lengths,
                                                val.sub_lengths)
                     continue
                 data = np.asarray(val.data)
-                dt = runtime_dtype(var.dtype if var else data.dtype)
+                dt = _dt(var.dtype if var else data.dtype)
                 out[name] = SequenceTensor(
                     data.astype(dt), np.asarray(val.lengths, np.int32),
                     None if val.sub_lengths is None
                     else np.asarray(val.sub_lengths, np.int32))
             elif isinstance(val, jax.Array):
                 # Device-resident feed: never round-trip through the host.
-                dt = runtime_dtype(var.dtype if var else val.dtype)
+                dt = _dt(var.dtype if var else val.dtype)
                 out[name] = val if str(val.dtype) == dt else val.astype(dt)
             else:
                 arr = np.asarray(val)
-                dt = runtime_dtype(var.dtype if var else arr.dtype)
-                out[name] = arr.astype(dt)
-        half = getattr(program, '_half_inference', None)
-        if half:
-            # Float16Transpiler contract: the USER keeps feeding f32;
-            # the boundary cast lives here (the reference appends cast
-            # ops instead, contrib/float16/float16_transpiler.py).
-            # numpy casting (ml_dtypes) keeps host feeds host-side so
-            # device placement still happens under the run's
-            # default_device, like the dt casts above.
-            hdt = np.dtype(half)
-            for name, val in out.items():
-                if isinstance(val, SequenceTensor):
-                    if val._packed is not None:
-                        # packed-mode (eager decode) feeds keep their
-                        # offset-LoD representation; the eager kernels
-                        # consume f32 fine
-                        continue
-                    if str(val.data.dtype) == 'float32':
-                        data = (val.data.astype(hdt)
-                                if isinstance(val.data, jax.Array)
-                                else np.asarray(val.data).astype(hdt))
-                        out[name] = SequenceTensor(data, val.lengths,
-                                                   val.sub_lengths)
-                elif str(getattr(val, 'dtype', '')) == 'float32':
-                    out[name] = (val.astype(hdt)
-                                 if isinstance(val, jax.Array)
-                                 else np.asarray(val).astype(hdt))
+                dt = _dt(var.dtype if var else arr.dtype)
+                out[name] = arr.astype(np.dtype(dt))
         return out
 
     def _state_names(self, program, scope):

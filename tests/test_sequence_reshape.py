@@ -1,0 +1,45 @@
+"""Named mirror of tests/unittests/test_sequence_reshape.py (reference
+:20-60): per-sequence row-major reshape to new_dim — widening and
+narrowing fixtures, values preserved in order, lengths scaled by
+width/new_dim."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import create_lod_tensor
+
+
+def _run(x, lens, new_dim):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        xv = fluid.layers.data(name='x', shape=[x.shape[1]],
+                               dtype='float32', lod_level=1)
+        out = fluid.layers.sequence_reshape(input=xv, new_dim=new_dim)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(start)
+    t = create_lod_tensor(x, [list(lens)], fluid.CPUPlace())
+    r, = exe.run(main, feed={'x': t}, fetch_list=[out],
+                 return_numpy=False)
+    return r
+
+
+@pytest.mark.parametrize('lens,width,new_dim', [
+    ([4, 1, 3, 3], 24, 12),      # reference base: widen rows
+    ([4, 2, 2, 4], 12, 24),      # reference _reduce: narrow rows
+])
+def test_sequence_reshape_reference_fixtures(lens, width, new_dim):
+    rng = np.random.RandomState(0)
+    total = int(sum(lens))
+    x = rng.uniform(0.1, 1, [total, width]).astype('float32')
+    r = _run(x, lens, new_dim)
+    out_lens = np.asarray(r.lengths)
+    data = np.asarray(r.data)
+    pos = 0
+    for i, L in enumerate(lens):
+        n_out = L * width // new_dim
+        assert L * width == n_out * new_dim
+        assert int(out_lens[i]) == n_out
+        flat = x[pos:pos + L].ravel()
+        np.testing.assert_allclose(
+            data[i, :n_out].reshape(-1), flat, rtol=1e-6)
+        pos += L
