@@ -215,6 +215,20 @@ def _spec(val):
     return (tuple(arr.shape), str(arr.dtype))
 
 
+def program_cache_key(program, feed, static_env, fetch_names, state_in,
+                      state_out, guard, *extra):
+    """The jit-cache key shared by Executor.run and ParallelExecutor.run
+    — ONE builder so a new invalidation dimension can never be added to
+    one executor and missed in the other (static shape-feed VALUES are
+    part of the key: a new shape value must retrace)."""
+    return (program.fingerprint(),
+            tuple(sorted((n, _spec(v)) for n, v in feed.items())),
+            tuple(sorted((n, v.dtype.str, v.shape, v.tobytes())
+                         for n, v in static_env.items())),
+            tuple(fetch_names), tuple(state_in), tuple(state_out),
+            guard, lowering.MERGE_SHARED_MULS[0]) + tuple(extra)
+
+
 def _block_has(block, types):
     for op in block.ops:
         if op.type in types:
@@ -583,13 +597,9 @@ class Executor(object):
         from . import profiler as _prof
         guard = nan_checks_enabled()
         profiling = _prof.op_profiling_enabled()
-        key = (program.fingerprint(),
-               tuple(sorted((n, _spec(v)) for n, v in feed.items())),
-               tuple(sorted((n, v.dtype.str, v.shape, v.tobytes())
-                            for n, v in static_env.items())),
-               tuple(fetch_names), tuple(state_in_names),
-               tuple(state_out_names), guard, profiling,
-               lowering.MERGE_SHARED_MULS[0])
+        key = program_cache_key(program, feed, static_env, fetch_names,
+                                state_in_names, state_out_names, guard,
+                                profiling)
         entry = self._cache.get(key)
         if entry is None:
             lower_prog = self._maybe_prune(program, fetch_names)

@@ -17,19 +17,30 @@ from .common import unwrap
 _NEG = -1e9
 
 
-def priors_per_cell(min_sizes, max_sizes, aspect_ratios, flip):
-    """Per-cell prior-box count. The ONE place that mirrors
-    _prior_box's whs enumeration (implicit leading 1.0 ratio, non-1
-    ratios once each plus flipped, one sqrt(min*max) box per min/max
-    pair) — the layer shapes (prior_box, multi_box_head conv widths)
-    derive from here, and the kernel asserts against it."""
-    per_ar = 1
+def expand_aspect_ratios(aspect_ratios, flip):
+    """prior_box_op.h ExpandAspectRatios, exactly: implicit leading
+    1.0; each input ratio dedups (eps 1e-6) against the GROWING output
+    (so a flip-duplicate like [2.0, 0.5] with flip collapses); a new
+    ratio pushes 1/ar unconditionally when flip is set."""
+    out = [1.0]
     for ar in (aspect_ratios or [1.0]):
-        if abs(float(ar) - 1.0) < 1e-6:
+        ar = float(ar)
+        if any(abs(ar - e) < 1e-6 for e in out):
             continue
-        per_ar += 2 if flip else 1
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+def priors_per_cell(min_sizes, max_sizes, aspect_ratios, flip):
+    """Per-cell prior-box count: the expanded-ratio boxes per min_size
+    plus one sqrt(min*max) box per min/max pair — the layer shapes
+    (prior_box, multi_box_head conv widths) derive from here, and the
+    kernel asserts against it."""
     n_min = len(list(min_sizes))
-    return n_min * per_ar + min(len(list(max_sizes or [])), n_min)
+    return n_min * len(expand_aspect_ratios(aspect_ratios, flip)) + \
+        min(len(list(max_sizes or [])), n_min)
 
 
 # ---- prior box ------------------------------------------------------------------
@@ -56,13 +67,7 @@ def _prior_box(ctx):
     step_w = float(steps[0]) or float(IW) / W
     step_h = float(steps[1]) or float(IH) / H
 
-    expanded = [1.0]
-    for ar in ars:
-        if abs(ar - 1.0) < 1e-6:
-            continue
-        expanded.append(ar)
-        if flip:
-            expanded.append(1.0 / ar)
+    expanded = expand_aspect_ratios(ars, flip)
 
     # per-cell (w, h) list, reference order: each min_size's aspect-ratio
     # boxes immediately followed by its sqrt(min*max) box

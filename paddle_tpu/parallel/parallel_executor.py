@@ -132,16 +132,11 @@ class ParallelExecutor(object):
         fetch_names, feed, state_in, state_out, static_env = \
             self._exe._prep_lowering(program, feed, fetch_list, scope)
 
-        from ..executor import _spec
+        from ..executor import program_cache_key
         from ..debugging import nan_checks_enabled
         guard = nan_checks_enabled()
-        from ..core import lowering as _lowering_mod
-        key = (program.fingerprint(),
-               tuple(sorted((n, _spec(v)) for n, v in feed.items())),
-               tuple(sorted((n, v.dtype.str, v.shape, v.tobytes())
-                            for n, v in static_env.items())),
-               tuple(fetch_names), tuple(state_in), tuple(state_out),
-               guard, _lowering_mod.MERGE_SHARED_MULS[0])
+        key = program_cache_key(program, feed, static_env, fetch_names,
+                                state_in, state_out, guard)
         multiproc = jax.process_count() > 1
         jitted = self._cache.get(key)
         if jitted is None or multiproc:
@@ -213,6 +208,10 @@ class ParallelExecutor(object):
                 fetches, new_state = jitted(feed, state)
         for n, v in new_state.items():
             scope.set_var(n, v)
+        if getattr(program, '_half_inference', None):
+            # Float16Transpiler boundary contract, same as Executor.run
+            from ..executor import _to_f32_fetch
+            fetches = [_to_f32_fetch(f) for f in fetches]
         if return_numpy:
             fetches = [as_numpy(f) for f in fetches]
         return fetches

@@ -324,3 +324,18 @@ def test_detection_map_evaluator_gt_difficult_positional():
         }, fetch_list=[cur_map])
     np.testing.assert_allclose(float(np.asarray(got)), expected,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_expand_aspect_ratios_dedup_matches_reference():
+    """prior_box_op.h ExpandAspectRatios: flip-duplicates collapse
+    ([2.0, 0.5] + flip -> [1, 2, 0.5], not 5 entries), duplicates
+    dedup, 1/ar pushes unconditionally for new ratios."""
+    from paddle_tpu.ops.detection_ops import (expand_aspect_ratios,
+                                              priors_per_cell)
+    assert expand_aspect_ratios([2.0, 0.5], True) == [1.0, 2.0, 0.5]
+    assert expand_aspect_ratios([2.0, 2.0], False) == [1.0, 2.0]
+    assert expand_aspect_ratios([1.0], True) == [1.0]
+    assert expand_aspect_ratios([2.0, 3.0], True) == \
+        [1.0, 2.0, 0.5, 3.0, 1.0 / 3.0]
+    # conv widths follow the deduped count
+    assert priors_per_cell([32.0], [64.0], [2.0, 0.5], True) == 4

@@ -81,3 +81,21 @@ def test_float16_transpile_sequence_fetch():
     assert isinstance(r, SequenceTensor)
     assert str(np.asarray(r.data).dtype) == 'float32'
     assert np.isfinite(np.asarray(r.data)).all()
+
+
+def test_float16_parallel_executor_fetch_is_f32():
+    """ParallelExecutor honors the same f32 fetch boundary as Executor
+    for transpiled programs."""
+    main, start, out = _build_infer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    import jax
+    n = jax.device_count()
+    with scope_guard(Scope()):
+        exe.run(start)
+        fluid.contrib.Float16Transpiler().transpile(main,
+                                                    fluid.CPUPlace())
+        pexe = fluid.ParallelExecutor(use_cuda=False, main_program=main)
+        xv = np.random.RandomState(0).rand(2 * n, 3, 16,
+                                           16).astype('float32')
+        r, = pexe.run(fetch_list=[out.name], feed={'img': xv})
+    assert np.asarray(r).dtype == np.float32
