@@ -313,8 +313,9 @@ def _flash_dkvdq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     this k block, written to a per-(kb) partial slab that XLA sums
     afterwards. Saves the dq pass's full score/prob recomputation — one
     of the two exp sweeps and two of the seven backward T^2 dots — at
-    the cost of an f32 [n_kb, T, D] partial buffer, so the caller only
-    routes here for small n_kb. Race-free by construction: every grid
+    the cost of a [n_kb, T, D] partial slab (bf16 for bf16 inputs, f32
+    otherwise — see _slab_dtype), so the caller only routes here while
+    the slab is affordable. Race-free by construction: every grid
     step owns its dqp block exclusively (no output revisiting, which
     Pallas leaves undefined across non-consecutive steps)."""
     kb = pl.program_id(1)
@@ -328,7 +329,7 @@ def _flash_dkvdq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     live = ((qi + 1) * block_q - 1 >= kb * block_k) if causal else (qi >= 0)
 
     # dead diagonal blocks still own a dqp slab slot — zero it so the
-    # XLA sum sees defined content
+    # XLA sum sees defined content (writes cast to the slab dtype)
     dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
 
     # NB: a diagonal-only masking variant (skip iota/where on blocks
@@ -352,9 +353,10 @@ def _flash_dkvdq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32) * scale
         # this k block's dq contribution (the dq pass's third dot,
         # without re-deriving s/p)
-        dqp_ref[0, 0] = jax.lax.dot_general(
+        dqp_ref[0, 0] = (jax.lax.dot_general(
             ds_lp, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * scale) \
+            .astype(dqp_ref.dtype)
 
     @pl.when(qi == n_qb - 1)
     def _finalize():
@@ -362,21 +364,35 @@ def _flash_dkvdq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-# merged-backward routing: ON, but only while the f32 dq-partials slab
-# stays affordable (it scales with n_kb; the two-pass path has no such
-# cost). Measured on v5e: 1.11x at n_kb=2 (flagship), 1.07x at n_kb=8;
-# the win shrinks as partial traffic grows, and very long T would need
-# gigabytes of slab — cap the slab, not n_kb.
+# merged-backward routing: ON, but only while the dq-partials slab
+# (dtype per _slab_dtype) stays affordable (it scales with n_kb; the
+# two-pass path has no such cost). Measured on v5e: 1.11x at n_kb=2
+# (flagship), 1.07x at n_kb=8; the win shrinks as partial traffic
+# grows, and very long T would need gigabytes of slab — cap the slab
+# bytes, not n_kb.
 _MERGED_BWD = [True]
 _MERGED_BWD_MAX_SLAB_BYTES = 512 * 1024 * 1024
 
 
+def _slab_dtype(q_dtype):
+    """dq-partial slab dtype — THE one policy site (allocation and the
+    routing byte-cap both derive from it): bf16 inputs write bf16
+    partials (half the traffic; the n_kb-way sum upcasts to f32 and dq
+    is cast to q.dtype at the end regardless, measured rel grad diff
+    ~5e-4); anything else keeps exact f32."""
+    return jnp.bfloat16 if q_dtype == jnp.bfloat16 else jnp.float32
+
+
 def _flash_bwd_merged(q, k, v, do, lse, delta, causal, block_q, block_k,
                       interpret):
-    """One-sweep dk/dv/dq-partials call; returns (dq, dk, dv)."""
+    """One-sweep dk/dv/dq-partials call; returns (dq, dk, dv).
+
+    Slab dtype from _slab_dtype (bf16 inputs -> bf16 slab, 1.05x
+    measured; otherwise exact f32)."""
     BH, T, D = q.shape
     n_qb = T // block_q
     n_kb = T // block_k
+    slab_dtype = _slab_dtype(q.dtype)
     qi_map = _qi_clamp(causal, block_q, block_k)
     dk, dv, dqp = pl.pallas_call(
         functools.partial(_flash_dkvdq_kernel, block_q=block_q,
@@ -400,13 +416,13 @@ def _flash_bwd_merged(q, k, v, do, lse, delta, causal, block_q, block_k,
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), k.dtype),
             jax.ShapeDtypeStruct((BH, T, D), v.dtype),
-            jax.ShapeDtypeStruct((BH, n_kb, T, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, n_kb, T, D), slab_dtype),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    dq = jnp.sum(dqp, axis=1).astype(q.dtype)
+    dq = jnp.sum(dqp.astype(jnp.float32), axis=1).astype(q.dtype)
     return dq, dk, dv
 
 
@@ -426,7 +442,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
                     axis=-1, keepdims=True)       # [BH, T, 1]
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
-    slab_bytes = BH * n_kb * T * D * 4
+    slab_bytes = BH * n_kb * T * D * jnp.dtype(_slab_dtype(q.dtype)).itemsize
     if _MERGED_BWD[0] and slab_bytes <= _MERGED_BWD_MAX_SLAB_BYTES:
         return _flash_bwd_merged(q, k, v, do, lse, delta, causal,
                                  block_q, block_k, interpret)
