@@ -252,8 +252,10 @@ def bench_transformer(on_tpu):
         # D=64 heads leave the 128-lane MXU half-occupied inside the
         # flash kernel's qk/pv dots (r4 PERF diagnosis); measured r5:
         # H8 160k tok/s (0.47 MFU) vs H16 123k (0.36) at identical
-        # quality (loss 8.01 vs 8.03)
-        B, S, layers_n = 8, 2048, 6
+        # quality (loss 8.01 vs 8.03). r5b: batch 16 is the measured
+        # knee with the merged flash backward (+3% over B=8; B=24
+        # regresses) — B=8 stays as a continuity comparison row.
+        B, S, layers_n = 16, 2048, 6
         dims = {'n_heads': 8}
         warmup, steps = 2, 10
     else:
@@ -262,7 +264,8 @@ def bench_transformer(on_tpu):
                 'seq': S}
         warmup, steps = 1, 2
 
-    def _one(dims_over):
+    def _one(dims_over, b_over=None):
+        b = b_over or B
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup):
             loss, feed_fn, _ = MODELS['transformer'](
@@ -274,9 +277,9 @@ def bench_transformer(on_tpu):
             exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu
                                  else fluid.CPUPlace())
             exe.run(startup)
-            feed = {k: jax.device_put(v) for k, v in feed_fn(B).items()}
+            feed = {k: jax.device_put(v) for k, v in feed_fn(b).items()}
             dt, last = _timed_loop(exe, main, loss, feed, warmup, steps)
-        return steps * B * S / dt, last
+        return steps * b * S / dt, last
 
     tps, last = _one(dims)
     log('transformer(fluid): %.0f tok/s (B %d, S %d, %d layers, '
@@ -300,6 +303,16 @@ def bench_transformer(on_tpu):
         res['mfu_bf16_peak'] = round(tps * flops_tok / 197e12, 4)
         log('transformer mfu: %.3f (%.0f MFLOP/token)' % (
             res['mfu_bf16_peak'], flops_tok / 1e6))
+        try:
+            tps8, last8 = _one(dims, b_over=8)
+            res['b8_continuity'] = {
+                'tokens_per_sec': round(tps8, 2),
+                'mfu_bf16_peak': round(tps8 * flops_tok / 197e12, 4),
+                'last_loss': round(last8, 4)}
+            log('transformer B=8 continuity: %.0f tok/s (mfu %.3f)'
+                % (tps8, tps8 * flops_tok / 197e12))
+        except Exception as e:
+            res['b8_continuity'] = {'error': str(e)[:300]}
         try:
             tps16, last16 = _one({'n_heads': 16})
             res['h16_d64_comparison'] = {
