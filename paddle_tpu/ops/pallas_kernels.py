@@ -630,10 +630,12 @@ def _lstm_cell_reference(xg, r_prev, c_prev, w):
 
 def _lstm_cell_kernel(xg_ref, r_ref, c_ref, w_ref, h_out, c_out):
     xg = xg_ref[:].astype(jnp.float32)
-    r = r_ref[:].astype(jnp.float32)
     c_prev = c_ref[:].astype(jnp.float32)
-    w = w_ref[:].astype(jnp.float32)
-    g = xg + jax.lax.dot_general(r, w, (((1,), (0,)), ((), ())),
+    # recurrent dot at INPUT precision (bf16 operands under AMP hit the
+    # MXU at full rate, f32 accumulation — same contract as the flash
+    # kernel's dots and every AMP matmul); gate math stays f32
+    g = xg + jax.lax.dot_general(r_ref[:], w_ref[:],
+                                 (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
     hdim = c_prev.shape[-1]
     # static slices (Mosaic has no dynamic_slice lowering)
