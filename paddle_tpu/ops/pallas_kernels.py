@@ -633,9 +633,15 @@ def _lstm_cell_kernel(xg_ref, r_ref, c_ref, w_ref, h_out, c_out):
     c_prev = c_ref[:].astype(jnp.float32)
     # recurrent dot at INPUT precision (bf16 operands under AMP hit the
     # MXU at full rate, f32 accumulation — same contract as the flash
-    # kernel's dots and every AMP matmul); gate math stays f32
-    g = xg + jax.lax.dot_general(r_ref[:], w_ref[:],
-                                 (((1,), (0,)), ((), ())),
+    # kernel's dots and every AMP matmul); gate math stays f32. The MXU
+    # has no fp16 path, so Float16Transpiler-fp16 operands upcast.
+    r = r_ref[:]
+    w = w_ref[:]
+    if r.dtype == jnp.float16:
+        r = r.astype(jnp.float32)
+    if w.dtype == jnp.float16:
+        w = w.astype(jnp.float32)
+    g = xg + jax.lax.dot_general(r, w, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
     hdim = c_prev.shape[-1]
     # static slices (Mosaic has no dynamic_slice lowering)
