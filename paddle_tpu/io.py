@@ -267,13 +267,32 @@ def _orbax_checkpointer():
 def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
                     save_interval_secs=600, main_program=None,
                     backend='auto'):
-    """backend: 'auto' (orbax when importable), 'orbax', or 'npz'."""
+    """backend: 'auto' (orbax when importable), 'orbax', or 'npz'.
+
+    A save within ``save_interval_secs`` of the newest checkpoint is
+    SKIPPED (reference io.py:569 _interval_secs_exceed — the rate limit
+    for trainer loops saving every step); the skipped call returns the
+    newest existing checkpoint directory. ``save_interval_secs=0``
+    disables the limit."""
     if backend not in ('auto', 'orbax', 'npz'):
         raise ValueError("backend must be 'auto', 'orbax' or 'npz', "
                          "got %r" % (backend,))
     if checkpoint_dir is None:
         checkpoint_dir = os.getcwd()
     serials = _get_checkpoint_serials(checkpoint_dir)
+    if serials and save_interval_secs:
+        # reference io.py:569 _interval_secs_exceed: a save within
+        # save_interval_secs of the newest checkpoint is SKIPPED (the
+        # rate limit for trainer loops calling save every step)
+        import time as _time
+        last_dir = os.path.join(
+            checkpoint_dir, "%s_%d" % (CHECKPOINT_PREFIX, max(serials)))
+        try:
+            if _time.time() - os.path.getmtime(last_dir) < \
+                    save_interval_secs:
+                return last_dir
+        except OSError:
+            pass
     serial = (max(serials) + 1) if serials else 0
     cur_dir = os.path.join(checkpoint_dir,
                            "%s_%d" % (CHECKPOINT_PREFIX, serial))

@@ -75,6 +75,7 @@ def test_checkpoint_save_load_and_rotation(tmp_path):
     with fluid.scope_guard(scope):
         for _ in range(4):  # rotation keeps max_num_checkpoints
             fluid.io.save_checkpoint(exe, checkpoint_dir=ckdir,
+                                     save_interval_secs=0,
                                      max_num_checkpoints=2,
                                      main_program=main)
         w = fluid.fetch_var('w_io', scope).copy()
@@ -183,7 +184,8 @@ def test_orbax_checkpoint_roundtrip_and_rotation(tmp_path):
         # also covers momentum accumulator state
         for i in range(5):   # rotation: 5 saves, keep 3
             d = pio.save_checkpoint(exe, ckdir, max_num_checkpoints=3,
-                                    main_program=main)
+                                    main_program=main,
+                                    save_interval_secs=0)
         assert os.path.isdir(os.path.join(d, '__orbax__'))
         import glob
         assert len(glob.glob(os.path.join(ckdir, 'checkpoint_*'))) == 3
@@ -403,3 +405,34 @@ def test_parallel_reader_propagates_source_errors():
     import pytest as _pytest
     with _pytest.raises(IOError):
         next(it)
+
+
+def test_save_checkpoint_interval_rate_limit(tmp_path):
+    """Ref io.py:569 _interval_secs_exceed: a save inside
+    save_interval_secs of the newest checkpoint is skipped; interval 0
+    always saves."""
+    import os
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        fluid.layers.create_parameter(shape=[2, 2], dtype='float32',
+                                      name='ckpt_w')
+    exe = fluid.Executor(fluid.CPUPlace())
+    from paddle_tpu.executor import Scope, scope_guard
+    with scope_guard(Scope()):
+        exe.run(start)
+        d = str(tmp_path)
+        d1 = fluid.io.save_checkpoint(exe, checkpoint_dir=d,
+                                      main_program=main)
+        n1 = len([x for x in os.listdir(d) if x.startswith('checkpoint')])
+        # immediate re-save inside the default 600s interval: skipped,
+        # returning the newest existing checkpoint dir
+        d2 = fluid.io.save_checkpoint(exe, checkpoint_dir=d,
+                                      main_program=main)
+        assert d2 == d1
+        n2 = len([x for x in os.listdir(d) if x.startswith('checkpoint')])
+        assert n2 == n1
+        # interval 0 disables the rate limit
+        fluid.io.save_checkpoint(exe, checkpoint_dir=d, main_program=main,
+                                 save_interval_secs=0)
+        n3 = len([x for x in os.listdir(d) if x.startswith('checkpoint')])
+        assert n3 == n1 + 1
