@@ -65,6 +65,7 @@ from .parallel.transpiler import (DistributeTranspiler,  # noqa
 from . import transpiler  # noqa
 from . import recordio_writer  # noqa
 from . import contrib  # noqa
+from . import resilience  # noqa
 from .clip import ErrorClipByValue  # noqa
 
 Tensor = SequenceTensor  # loose alias for scripts touching fluid.Tensor
@@ -87,5 +88,5 @@ __all__ = [
     'ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy',
     'DistributeTranspiler', 'SimpleDistributeTranspiler',
     'InferenceTranspiler', 'transpiler', 'recordio_writer', 'contrib',
-    'memory_optimize', 'release_memory',
+    'memory_optimize', 'release_memory', 'resilience',
 ]

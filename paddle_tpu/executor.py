@@ -23,6 +23,7 @@ from .core import lowering
 from .core.lowering import (lower_block, runtime_dtype, RNG_KEY,
                             _op_reads)
 from .lod import SequenceTensor
+from .resilience import anomaly as _anomaly
 
 __all__ = ['Executor', 'global_scope', 'scope_guard', 'switch_scope',
            'fetch_var', 'as_numpy']
@@ -639,6 +640,11 @@ class Executor(object):
             # boundary contract: fetches come back float32 even though
             # the net ran in half (Float16Transpiler)
             fetches = [_to_f32_fetch(f) for f in fetches]
+        if _anomaly.any_active():
+            # resilience hook: an installed AnomalyGuard inspects every
+            # fetch (NaN/Inf policy for raw exe.run loops); no-op by
+            # default
+            _anomaly.observe_fetches(fetch_names, fetches)
         if return_numpy:
             fetches = [as_numpy(f) for f in fetches]
         else:

@@ -31,7 +31,9 @@ from .clip import (ErrorClipByValue, GradientClipByValue,  # noqa
                    GradientClipByNorm, GradientClipByGlobalNorm)
 from .initializer import init_on_cpu  # noqa
 from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,  # noqa
-                      BeginStepEvent, EndStepEvent)
+                      BeginStepEvent, EndStepEvent, CheckpointConfig)
+from . import resilience  # noqa
+from .resilience import AnomalyGuard, AnomalyError  # noqa
 from .inferencer import Inferencer  # noqa
 from . import debugger  # noqa
 from . import debugger as debuger  # noqa

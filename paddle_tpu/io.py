@@ -6,20 +6,26 @@ a JSON manifest for the inference program (the reference pickles ProgramDesc
 protobufs; we serialize the IR to JSON).
 """
 import json
+import logging
 import os
+import re
 import shutil
+import time as _time
 
 import numpy as np
 
 from . import framework
+from . import resilience
 from .framework import Program, Parameter, Variable, default_main_program
 from .executor import global_scope, as_numpy
+from .resilience import faultinject
 
 __all__ = [
     'save_vars', 'save_params', 'save_persistables', 'load_vars',
     'load_params', 'load_persistables', 'save_inference_model',
     'load_inference_model', 'get_inference_program', 'save_checkpoint',
     'load_checkpoint', 'clean_checkpoint',
+    'load_checkpoint_trainer_state',
 ]
 
 PARAMS_FILE = '__params__.npz'
@@ -249,8 +255,14 @@ def load_inference_model(dirname, executor, model_filename=None,
 SUCCESS_MARK_FILENAME = "_SUCCESS"
 CHECKPOINT_PREFIX = "checkpoint"
 
+# strict serial-dir pattern: `checkpoint_backup`, `checkpoints_old` or
+# `checkpoint_3.bak` must never parse as a serial (they used to: the old
+# prefix+int(split) scan would claim or DELETE them)
+_SERIAL_DIR_RE = re.compile(r'^%s_(\d+)$' % CHECKPOINT_PREFIX)
 
 _ORBAX_SUBDIR = '__orbax__'
+
+_logger = logging.getLogger('paddle_tpu.resilience')
 
 
 def _orbax_checkpointer():
@@ -264,16 +276,84 @@ def _orbax_checkpointer():
         return None
 
 
+def _serial_dir(checkpoint_dir, serial):
+    return os.path.join(checkpoint_dir,
+                        "%s_%d" % (CHECKPOINT_PREFIX, serial))
+
+
+def _manifest_mtime(serial_dir):
+    """Save-time of a checkpoint = its manifest's mtime. The directory
+    mtime is NOT usable: pruning/marker churn refreshes it, which made
+    the save_interval_secs rate limit silently skip real saves."""
+    for name in (resilience.MANIFEST_FILENAME, SUCCESS_MARK_FILENAME):
+        try:
+            return os.path.getmtime(os.path.join(serial_dir, name))
+        except OSError:
+            continue
+    return os.path.getmtime(serial_dir)
+
+
+def _collect_persistable_state(main_program):
+    """name -> host/device array for every persistable var with a live
+    value in the current scope."""
+    import jax
+    program = main_program or default_main_program()
+    scope = global_scope()
+    state = {}
+    for var in filter(is_persistable, program.list_vars()):
+        val = scope.raw(var.name)
+        if val is None:
+            continue
+        # jax.Arrays stay as-is so sharded orbax saves stay sharded
+        # (no host gather); everything else via numpy
+        state[var.name] = val if isinstance(val, jax.Array) \
+            else np.asarray(as_numpy(val))
+    return state
+
+
+@resilience.retry(max_attempts=3, backoff=0.05, jitter=0.1,
+                  retry_on=(OSError,))
+def _write_checkpoint_payload(tmp_dir, executor, main_program, ckptr):
+    """Serialize persistables into ``tmp_dir`` (retry-wrapped: a
+    transient filesystem error re-runs the whole payload write into a
+    wiped tmp dir — nothing is ever partially reused)."""
+    if os.path.isdir(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    os.makedirs(tmp_dir)
+    faultinject.maybe_fault(faultinject.SITE_CKPT_WRITE)
+    if ckptr is not None:
+        state = _collect_persistable_state(main_program)
+        ckptr.save(os.path.join(tmp_dir, _ORBAX_SUBDIR), state)
+        # metadata only: no host gather of (possibly sharded) device
+        # arrays just for a CRC — the manifest's file CRCs cover orbax
+        # payload integrity
+        return {n: {'shape': getattr(v, 'shape', ()),
+                    'dtype': getattr(v, 'dtype', 'float32')}
+                for n, v in state.items()}, 'orbax'
+    save_persistables(executor, tmp_dir, main_program)
+    with _load_npz(tmp_dir) as data:
+        return {n: data[n] for n in data.files}, 'npz'
+
+
 def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
                     save_interval_secs=600, main_program=None,
-                    backend='auto'):
-    """backend: 'auto' (orbax when importable), 'orbax', or 'npz'.
+                    backend='auto', trainer_state=None):
+    """Atomic checkpoint save. backend: 'auto' (orbax when importable),
+    'orbax', or 'npz'.
 
-    A save within ``save_interval_secs`` of the newest checkpoint is
-    SKIPPED (reference io.py:569 _interval_secs_exceed — the rate limit
-    for trainer loops saving every step); the skipped call returns the
-    newest existing checkpoint directory. ``save_interval_secs=0``
-    disables the limit."""
+    Commit protocol (resilience/checkpoint.py): payload into a hidden
+    ``.tmp_*`` dir -> fsync everything -> JSON manifest with per-tensor
+    shape/dtype + CRC32 checksums (and optional ``trainer_state`` for
+    auto-resume) -> ``os.rename`` into ``checkpoint_<serial>``. A kill
+    at ANY point leaves no partially-visible checkpoint.
+
+    A save within ``save_interval_secs`` of the newest checkpoint's
+    MANIFEST mtime is SKIPPED (reference io.py:569 _interval_secs_exceed
+    — the rate limit for trainer loops saving every step); the skipped
+    call returns the newest existing checkpoint directory.
+    ``save_interval_secs=0`` disables the limit. Pruning keeps the
+    newest ``max_num_checkpoints`` serials and can never touch the
+    serial just written."""
     if backend not in ('auto', 'orbax', 'npz'):
         raise ValueError("backend must be 'auto', 'orbax' or 'npz', "
                          "got %r" % (backend,))
@@ -281,63 +361,62 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
         checkpoint_dir = os.getcwd()
     serials = _get_checkpoint_serials(checkpoint_dir)
     if serials and save_interval_secs:
-        # reference io.py:569 _interval_secs_exceed: a save within
-        # save_interval_secs of the newest checkpoint is SKIPPED (the
-        # rate limit for trainer loops calling save every step)
-        import time as _time
-        last_dir = os.path.join(
-            checkpoint_dir, "%s_%d" % (CHECKPOINT_PREFIX, max(serials)))
+        last_dir = _serial_dir(checkpoint_dir, max(serials))
         try:
-            if _time.time() - os.path.getmtime(last_dir) < \
+            if _time.time() - _manifest_mtime(last_dir) < \
                     save_interval_secs:
                 return last_dir
         except OSError:
             pass
     serial = (max(serials) + 1) if serials else 0
-    cur_dir = os.path.join(checkpoint_dir,
-                           "%s_%d" % (CHECKPOINT_PREFIX, serial))
+    cur_dir = _serial_dir(checkpoint_dir, serial)
     if os.path.isdir(cur_dir):
-        # leftover of an interrupted save (no _SUCCESS mark): clear it,
-        # orbax refuses to overwrite an existing directory
+        # leftover of an interrupted legacy save (no completeness mark):
+        # clear it so the rename below lands on a free name
         shutil.rmtree(cur_dir)
     ckptr = _orbax_checkpointer() if backend in ('auto', 'orbax') else None
     if backend == 'orbax' and ckptr is None:
         raise RuntimeError("orbax backend requested but not importable")
-    if ckptr is not None:
-        import jax
-        program = main_program or default_main_program()
-        scope = global_scope()
-        state = {}
-        for var in filter(is_persistable, program.list_vars()):
-            val = scope.raw(var.name)
-            if val is None:
-                continue
-            # jax.Arrays go to orbax directly so sharded saves stay
-            # sharded (no host gather); everything else via numpy
-            state[var.name] = val if isinstance(val, jax.Array) \
-                else np.asarray(as_numpy(val))
-        os.makedirs(cur_dir, exist_ok=True)
-        ckptr.save(os.path.join(cur_dir, _ORBAX_SUBDIR), state)
-    else:
-        save_persistables(executor, cur_dir, main_program)
-    open(os.path.join(cur_dir, SUCCESS_MARK_FILENAME), 'w').close()
-    serials = _get_checkpoint_serials(checkpoint_dir)
-    for s in sorted(serials)[:-max_num_checkpoints]:
-        shutil.rmtree(os.path.join(checkpoint_dir,
-                                   "%s_%d" % (CHECKPOINT_PREFIX, s)))
+
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    tmp_dir = os.path.join(
+        checkpoint_dir, '%s%s_%d.%d' % (resilience.checkpoint.TMP_PREFIX,
+                                        CHECKPOINT_PREFIX, serial,
+                                        os.getpid()))
+    try:
+        tensors, used_backend = _write_checkpoint_payload(
+            tmp_dir, executor, main_program, ckptr)
+        resilience.write_manifest(tmp_dir, tensors=tensors,
+                                  trainer_state=trainer_state,
+                                  backend=used_backend, serial=serial)
+        # legacy completeness mark, still honored by older readers
+        open(os.path.join(tmp_dir, SUCCESS_MARK_FILENAME), 'w').close()
+        resilience.fsync_tree(tmp_dir)
+        faultinject.maybe_fault(faultinject.SITE_CKPT_COMMIT)
+        os.rename(tmp_dir, cur_dir)
+        resilience.checkpoint._fsync_path(checkpoint_dir)
+    finally:
+        if os.path.isdir(tmp_dir):
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+    # prune: keep the newest max_num_checkpoints serials, NEVER the one
+    # just written (max_num_checkpoints=0 used to wipe it via [:-0])
+    survivors = sorted(_get_checkpoint_serials(checkpoint_dir),
+                       reverse=True)[:max(max_num_checkpoints, 1)]
+    for s in _get_checkpoint_serials(checkpoint_dir):
+        if s not in survivors and s != serial:
+            shutil.rmtree(_serial_dir(checkpoint_dir, s),
+                          ignore_errors=True)
     return cur_dir
 
 
-def load_checkpoint(executor, checkpoint_dir=None, serial=None,
-                    main_program=None):
-    if checkpoint_dir is None:
-        checkpoint_dir = os.getcwd()
-    serials = _get_checkpoint_serials(checkpoint_dir)
-    if not serials:
-        raise IOError("no checkpoints under %s" % checkpoint_dir)
-    serial = serial if serial is not None else max(serials)
-    cur_dir = os.path.join(checkpoint_dir,
-                           "%s_%d" % (CHECKPOINT_PREFIX, serial))
+@resilience.retry(max_attempts=3, backoff=0.05, jitter=0.1,
+                  retry_on=(OSError,),
+                  )
+def _load_checkpoint_payload(cur_dir, executor, main_program):
+    """Deserialize one serial into the current scope (retry-wrapped for
+    transient read errors; CheckpointCorruption is NOT retried — it is
+    deterministic and handled by the serial-fallback loop above)."""
+    faultinject.maybe_fault(faultinject.SITE_CKPT_READ)
     orbax_dir = os.path.join(cur_dir, _ORBAX_SUBDIR)
     if os.path.isdir(orbax_dir):
         ckptr = _orbax_checkpointer()
@@ -362,30 +441,109 @@ def load_checkpoint(executor, checkpoint_dir=None, serial=None,
             scope.set_var(name, jnp.asarray(arr.astype(dt)))
     else:
         load_persistables(executor, cur_dir, main_program)
-    return cur_dir
+
+
+def load_checkpoint(executor, checkpoint_dir=None, serial=None,
+                    main_program=None, verify=True):
+    """Restore the newest HEALTHY checkpoint.
+
+    Each candidate serial is CRC-verified against its manifest before
+    restore; a corrupted/truncated serial is logged and skipped,
+    falling back to the next-newest one — a flipped bit in the latest
+    checkpoint must cost one save interval, not the whole run. An
+    explicitly requested ``serial`` is an exception: corruption there
+    raises CheckpointCorruption (the caller asked for those bytes
+    specifically). ``verify=False`` skips CRC validation."""
+    if checkpoint_dir is None:
+        checkpoint_dir = os.getcwd()
+    serials = _get_checkpoint_serials(checkpoint_dir)
+    if not serials:
+        raise IOError("no checkpoints under %s" % checkpoint_dir)
+    if serial is not None:
+        candidates = [serial]
+    else:
+        candidates = sorted(serials, reverse=True)
+    last_err = None
+    for s in candidates:
+        cur_dir = _serial_dir(checkpoint_dir, s)
+        if verify:
+            errors = resilience.verify_checkpoint(cur_dir)
+            if errors:
+                err = resilience.CheckpointCorruption(cur_dir, errors)
+                if serial is not None:
+                    raise err
+                _logger.warning(
+                    'checkpoint serial %d is corrupt (%s); falling back '
+                    'to previous serial', s, '; '.join(errors))
+                last_err = err
+                continue
+        _load_checkpoint_payload(cur_dir, executor, main_program)
+        return cur_dir
+    raise IOError(
+        'all %d checkpoint serial(s) under %s failed verification; '
+        'newest error: %s' % (len(candidates), checkpoint_dir, last_err))
+
+
+def load_checkpoint_trainer_state(checkpoint_dir, serial=None):
+    """The ``trainer_state`` dict recorded at save time (auto-resume),
+    or None for legacy/stateless checkpoints."""
+    if serial is None:
+        serials = _get_checkpoint_serials(checkpoint_dir)
+        if not serials:
+            return None
+        # newest HEALTHY serial, mirroring load_checkpoint's fallback
+        for s in sorted(serials, reverse=True):
+            d = _serial_dir(checkpoint_dir, s)
+            if not resilience.verify_checkpoint(d):
+                serial = s
+                break
+        else:
+            return None
+    manifest = resilience.read_manifest(
+        _serial_dir(checkpoint_dir, serial))
+    if manifest is None:
+        return None
+    return manifest.get('trainer_state')
 
 
 def clean_checkpoint(checkpoint_dir, delete_dir=False):
+    """Remove every checkpoint serial (and stale ``.tmp_*`` commit
+    leftovers). Directories that merely share the ``checkpoint`` prefix
+    (checkpoint_backup, checkpoints_old, ...) are NOT touched."""
     if checkpoint_dir is None:
         checkpoint_dir = os.getcwd()
-    for s in _get_checkpoint_serials(checkpoint_dir):
-        shutil.rmtree(os.path.join(checkpoint_dir,
-                                   "%s_%d" % (CHECKPOINT_PREFIX, s)))
+    if not os.path.isdir(checkpoint_dir):
+        return
+    for s in _get_checkpoint_serials(checkpoint_dir,
+                                     require_complete=False):
+        shutil.rmtree(_serial_dir(checkpoint_dir, s))
+    for d in os.listdir(checkpoint_dir):
+        if d.startswith(resilience.checkpoint.TMP_PREFIX +
+                        CHECKPOINT_PREFIX + '_'):
+            shutil.rmtree(os.path.join(checkpoint_dir, d),
+                          ignore_errors=True)
     if delete_dir and not os.listdir(checkpoint_dir):
         os.rmdir(checkpoint_dir)
 
 
-def _get_checkpoint_serials(checkpoint_dir):
+def _get_checkpoint_serials(checkpoint_dir, require_complete=True):
+    """Serials of complete checkpoints (manifest or legacy _SUCCESS
+    mark present). ``require_complete=False`` also lists wrecks so
+    clean_checkpoint can remove them."""
     if not os.path.isdir(checkpoint_dir):
         return []
     serials = []
     for d in os.listdir(checkpoint_dir):
-        if d.startswith(CHECKPOINT_PREFIX + "_"):
-            try:
-                s = int(d.split('_')[-1])
-            except ValueError:
-                continue
-            if os.path.exists(os.path.join(checkpoint_dir, d,
-                                           SUCCESS_MARK_FILENAME)):
-                serials.append(s)
+        m = _SERIAL_DIR_RE.match(d)
+        if not m:
+            continue
+        path = os.path.join(checkpoint_dir, d)
+        if not os.path.isdir(path):
+            continue
+        complete = (
+            os.path.exists(os.path.join(path,
+                                        resilience.MANIFEST_FILENAME)) or
+            os.path.exists(os.path.join(path, SUCCESS_MARK_FILENAME)))
+        if complete or not require_complete:
+            serials.append(int(m.group(1)))
     return serials

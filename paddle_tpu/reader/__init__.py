@@ -9,7 +9,67 @@ import threading
 import queue as Queue
 
 __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
-           'ComposeNotAligned', 'firstn', 'xmap_readers', 'batch']
+           'ComposeNotAligned', 'firstn', 'xmap_readers', 'batch',
+           'retry_reader']
+
+
+def retry_reader(reader, max_attempts=3, backoff=0.05, jitter=0.1,
+                 retry_on=(IOError, OSError), sleep=None):
+    """Absorb transient source errors: when the underlying reader
+    raises a ``retry_on`` error mid-iteration, re-open it and fast
+    forward past the items already delivered, so the consumer sees an
+    uninterrupted stream (no duplicates, no holes). The attempt budget
+    resets whenever progress is made since the last failure; a source
+    that fails ``max_attempts`` times without yielding anything new
+    propagates the error wrapped in
+    :class:`~paddle_tpu.resilience.RetryError`.
+
+    The trade-off is that of any re-openable stream: the source must be
+    restartable and deterministic up to the failure point (recordio
+    files, dataset generators are; an already-shuffled stream should be
+    wrapped BEFORE ``shuffle``).
+    """
+    import time as _time
+    from ..resilience.retry import RetryError, _jitter_rng, logger
+    sleep = sleep or _time.sleep
+
+    def robust_reader():
+        delivered = 0
+        failures_since_progress = 0
+        while True:
+            it = reader()
+            to_skip = delivered  # fast-forward past items already out
+            skipped = 0
+            progressed = False
+            try:
+                for item in it:
+                    if skipped < to_skip:
+                        skipped += 1
+                        continue
+                    yield item
+                    delivered += 1
+                    progressed = True
+                return
+            except retry_on as e:  # noqa: B902 — tuple from caller
+                if progressed:
+                    failures_since_progress = 1
+                else:
+                    failures_since_progress += 1
+                if failures_since_progress >= max_attempts:
+                    raise RetryError('retry_reader',
+                                     failures_since_progress, e) from e
+                delay = backoff * (2 ** (failures_since_progress - 1))
+                if jitter:
+                    delay *= 1.0 + _jitter_rng.uniform(0.0, jitter)
+                logger.warning(
+                    'retry_reader: source failed at item %d (%r); '
+                    'reopening (attempt %d/%d, sleeping %.3fs)',
+                    delivered, e, failures_since_progress, max_attempts,
+                    delay)
+                if delay > 0:
+                    sleep(delay)
+
+    return robust_reader
 
 
 def batch(reader, batch_size, drop_last=False):

@@ -1,0 +1,49 @@
+"""Fault-tolerant training runtime.
+
+Production TPU training is preemption-driven by design: workers are
+killed mid-step, filesystems flake, datasets hand back garbage batches.
+This package makes the runtime survive all of that:
+
+- :mod:`~paddle_tpu.resilience.retry` — transient-error retry with
+  exponential backoff + jitter, used by checkpoint I/O and
+  ``reader.retry_reader``.
+- :mod:`~paddle_tpu.resilience.checkpoint` — the atomic checkpoint
+  commit protocol (tmp dir -> fsync -> manifest with per-tensor CRC32s
+  -> rename) and manifest verification, shared by ``io.save_checkpoint``
+  and ``tools/check_checkpoint.py``.
+- :mod:`~paddle_tpu.resilience.anomaly` — NaN/Inf and loss/grad-norm
+  spike detection with a configurable policy (``raise`` /
+  ``skip_batch`` / ``rollback_to_checkpoint``), wired through
+  ``Executor.run`` and ``Trainer.train``.
+- :mod:`~paddle_tpu.resilience.faultinject` — a deterministic
+  fault-injection harness (I/O errors, corrupted/truncated checkpoint
+  payloads, NaN batches, simulated kills) so every recovery path above
+  is testable in tier-1.
+
+See RESILIENCE.md for the full design.
+"""
+from .retry import retry, retry_call, RetryError  # noqa
+from .checkpoint import (MANIFEST_FILENAME, write_manifest,  # noqa
+                         read_manifest, verify_checkpoint,
+                         tensor_crc32, file_crc32, fsync_tree,
+                         CheckpointCorruption)
+from .anomaly import (AnomalyError, AnomalyGuard, global_norm,  # noqa
+                      executor_guard, observe_fetches,
+                      any_active as anomaly_guard_active)
+from .faultinject import (FaultPlan, fault_plan, maybe_fault,  # noqa
+                          FaultInjected, corrupt_checkpoint,
+                          truncate_checkpoint, nan_reader, flaky_reader,
+                          SimulatedKill, KillSwitch)
+from .autoresume import CheckpointConfig  # noqa
+
+__all__ = [
+    'retry', 'retry_call', 'RetryError',
+    'write_manifest', 'read_manifest', 'verify_checkpoint',
+    'tensor_crc32', 'file_crc32', 'fsync_tree', 'CheckpointCorruption',
+    'MANIFEST_FILENAME',
+    'AnomalyError', 'AnomalyGuard', 'global_norm', 'executor_guard',
+    'FaultPlan', 'fault_plan', 'maybe_fault', 'FaultInjected',
+    'corrupt_checkpoint', 'truncate_checkpoint', 'nan_reader',
+    'flaky_reader', 'SimulatedKill', 'KillSwitch',
+    'CheckpointConfig',
+]

@@ -1,0 +1,187 @@
+"""NaN/Inf and spike anomaly detection with a configurable policy.
+
+Complements the compiled-in ``debugging.nan_guard`` (checkify inside
+the XLA program, per-op provenance, always ``raise``): this guard lives
+at the HOST boundary — it inspects feed batches before a step runs and
+losses/grad-norms after — so it can react with policies the compiled
+guard cannot: skip the poisoned batch, or roll the params back to the
+last good checkpoint. The spike detector flags a loss/grad-norm that
+jumps ``spike_factor``x above the rolling median — the classic
+precursor of divergence that NaN checks alone miss.
+
+The Executor calls :func:`observe_fetches` on every run (no-op unless a
+guard is installed via :func:`executor_guard`), giving raw
+``exe.run``-driven loops the same detection as ``Trainer.train``.
+"""
+import collections
+import contextlib
+import logging
+
+import numpy as np
+
+__all__ = ['AnomalyError', 'AnomalyGuard', 'global_norm',
+           'executor_guard', 'observe_fetches', 'any_active']
+
+logger = logging.getLogger('paddle_tpu.resilience')
+
+POLICIES = ('raise', 'skip_batch', 'rollback_to_checkpoint')
+
+
+class AnomalyError(FloatingPointError):
+    """A non-finite or spiking value was detected under policy 'raise'.
+    ``kind`` is 'nan_inf' or 'spike'; ``where`` names the tensor/stage."""
+
+    def __init__(self, kind, where, value=None):
+        super(AnomalyError, self).__init__(
+            '%s anomaly at %s (value=%r)' % (kind, where, value))
+        self.kind = kind
+        self.where = where
+        self.value = value
+
+
+def global_norm(arrays):
+    """sqrt(sum ||a||^2) over host/device arrays; NaN-propagating, so a
+    poisoned gradient shows up as a non-finite norm."""
+    total = 0.0
+    for a in arrays:
+        a = np.asarray(a, dtype=np.float64)
+        total += float(np.sum(np.square(a)))
+    return float(np.sqrt(total))
+
+
+def _has_nonfinite(value):
+    arr = np.asarray(value)
+    if arr.dtype.kind not in 'fc':
+        return False
+    return not bool(np.isfinite(arr).all())
+
+
+class AnomalyGuard(object):
+    """Detection + policy. One instance per training run.
+
+    policy: 'raise' | 'skip_batch' | 'rollback_to_checkpoint'
+    check_feeds: inspect feed batches pre-step (catches poisoned input
+        BEFORE it contaminates parameters — the only point where
+        'skip_batch' can skip with zero side effects).
+    check_metrics: inspect fetched losses/metrics post-step.
+    spike_window / spike_factor: rolling-median spike detection over
+        observed losses (and grad norms when the trainer monitors
+        them); ``spike_window=0`` disables it. The window must hold at
+        least ``min_history`` finite values before spikes fire, so
+        early-training volatility doesn't trip it.
+    monitor_gradients: ask the Trainer to fetch parameter gradients
+        each step and feed their global norm through the same
+        detection.
+    """
+
+    def __init__(self, policy='raise', check_feeds=True,
+                 check_metrics=True, spike_window=25, spike_factor=25.0,
+                 min_history=5, monitor_gradients=False):
+        if policy not in POLICIES:
+            raise ValueError('policy must be one of %s, got %r'
+                             % (POLICIES, policy))
+        self.policy = policy
+        self.check_feeds = check_feeds
+        self.check_metrics = check_metrics
+        self.spike_factor = float(spike_factor)
+        self.min_history = int(min_history)
+        self.monitor_gradients = monitor_gradients
+        self._loss_window = collections.deque(maxlen=spike_window or 1)
+        self._norm_window = collections.deque(maxlen=spike_window or 1)
+        self._spike_enabled = bool(spike_window)
+        # counters exposed for logging/tests
+        self.anomalies = collections.Counter()
+
+    # ---- detection -------------------------------------------------------
+    def inspect_feed(self, feed):
+        """'nan_inf' if any float feed slot holds a non-finite value,
+        else None. ``feed`` maps name -> host array / SequenceTensor."""
+        for name, val in (feed or {}).items():
+            data = getattr(val, 'data', val)  # SequenceTensor -> payload
+            try:
+                bad = _has_nonfinite(data)
+            except (TypeError, ValueError):
+                continue
+            if bad:
+                self.anomalies['feed_nan'] += 1
+                logger.warning('anomaly: non-finite feed %r', name)
+                return AnomalyError('nan_inf', 'feed:%s' % name)
+        return None
+
+    def inspect_loss(self, value, where='loss'):
+        """Non-finite check + rolling-median spike check on a scalar."""
+        try:
+            scalar = float(np.asarray(value).ravel()[0])
+        except (TypeError, ValueError, IndexError):
+            return None
+        if not np.isfinite(scalar):
+            self.anomalies['loss_nan'] += 1
+            logger.warning('anomaly: non-finite %s (%r)', where, scalar)
+            return AnomalyError('nan_inf', where, scalar)
+        err = self._inspect_spike(self._loss_window, scalar, where)
+        self._loss_window.append(abs(scalar))
+        return err
+
+    def inspect_grad_norm(self, norm):
+        if not np.isfinite(norm):
+            self.anomalies['grad_nan'] += 1
+            logger.warning('anomaly: non-finite gradient norm')
+            return AnomalyError('nan_inf', 'grad_norm', norm)
+        err = self._inspect_spike(self._norm_window, norm, 'grad_norm')
+        self._norm_window.append(abs(norm))
+        return err
+
+    def _inspect_spike(self, window, scalar, where):
+        if not self._spike_enabled or len(window) < self.min_history:
+            return None
+        baseline = float(np.median(window))
+        if baseline > 0 and abs(scalar) > self.spike_factor * baseline:
+            self.anomalies['spike'] += 1
+            logger.warning('anomaly: %s spike %.4g (median %.4g x%.1f)',
+                           where, scalar, baseline, self.spike_factor)
+            return AnomalyError('spike', where, scalar)
+        return None
+
+    # ---- executor hook ---------------------------------------------------
+    def observe(self, fetch_names, fetches):
+        """Executor-level check of every float fetch. Policy 'raise'
+        raises; the softer policies only count/log here — skipping or
+        rolling back is a trainer-loop decision (the update already ran
+        by the time fetches exist)."""
+        if not self.check_metrics:
+            return
+        for name, val in zip(fetch_names, fetches):
+            data = getattr(val, 'data', val)
+            try:
+                bad = _has_nonfinite(data)
+            except (TypeError, ValueError):
+                continue
+            if bad:
+                self.anomalies['fetch_nan'] += 1
+                logger.warning('anomaly: non-finite fetch %r', name)
+                if self.policy == 'raise':
+                    raise AnomalyError('nan_inf', 'fetch:%s' % name)
+
+
+# ---- executor integration ------------------------------------------------
+_ACTIVE_GUARDS = []
+
+
+def any_active():
+    return bool(_ACTIVE_GUARDS)
+
+
+@contextlib.contextmanager
+def executor_guard(guard):
+    """Install ``guard`` so Executor.run checks every fetch inside the
+    block (the executor-level wiring for raw exe.run loops)."""
+    _ACTIVE_GUARDS.append(guard)
+    try:
+        yield guard
+    finally:
+        _ACTIVE_GUARDS.remove(guard)
+
+
+def observe_fetches(fetch_names, fetches):
+    for g in tuple(_ACTIVE_GUARDS):
+        g.observe(fetch_names, fetches)

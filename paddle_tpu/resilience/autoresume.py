@@ -1,0 +1,40 @@
+"""CheckpointConfig: the auto-resume contract between Trainer and io.
+
+Parity: the reference trainer.py's CheckpointConfig (checkpoint_dir,
+max_num_checkpoints, epoch_interval, step_interval). Extended with the
+resilience knobs: backend selection, the secs-based rate limit, and
+``resume`` to opt out of auto-resume while keeping periodic saves.
+
+The Trainer saves parameters + optimizer accumulators (persistables) +
+its own progress (epoch, step, global step, RNG key) every
+``step_interval`` steps and at every ``epoch_interval``-th epoch end;
+on construction-with-existing-checkpoints it transparently restores the
+newest uncorrupted serial and skips the already-completed steps.
+"""
+
+__all__ = ['CheckpointConfig']
+
+
+class CheckpointConfig(object):
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10,
+                 save_interval_secs=0, backend='auto', resume=True):
+        if checkpoint_dir is None:
+            raise ValueError('CheckpointConfig needs a checkpoint_dir')
+        if epoch_interval < 1 or step_interval < 1:
+            raise ValueError('epoch_interval and step_interval must be '
+                             '>= 1')
+        self.checkpoint_dir = checkpoint_dir
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+        self.save_interval_secs = save_interval_secs
+        self.backend = backend
+        self.resume = resume
+
+    def __repr__(self):
+        return ('CheckpointConfig(dir=%r, max=%d, epoch_interval=%d, '
+                'step_interval=%d)' % (self.checkpoint_dir,
+                                       self.max_num_checkpoints,
+                                       self.epoch_interval,
+                                       self.step_interval))

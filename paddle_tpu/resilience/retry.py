@@ -1,0 +1,82 @@
+"""Transient-error retry: exponential backoff + deterministic jitter.
+
+Checkpoint I/O and dataset readers fail transiently in production
+(NFS/GCS hiccups, preempted sidecars); a bounded retry with backoff
+turns those into latency instead of a dead trainer. The jitter is drawn
+from a module-local PRNG so retry timing never perturbs ``random``'s
+global stream (reader shuffles must stay reproducible).
+"""
+import functools
+import logging
+import random
+import time
+
+__all__ = ['retry', 'retry_call', 'RetryError']
+
+logger = logging.getLogger('paddle_tpu.resilience')
+
+_jitter_rng = random.Random(0x5EED)
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted. ``last_error`` holds the final cause and
+    ``attempts`` how many times the callable ran."""
+
+    def __init__(self, fn_name, attempts, last_error):
+        super(RetryError, self).__init__(
+            '%s failed after %d attempt(s): %r' % (fn_name, attempts,
+                                                   last_error))
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+def retry(max_attempts=3, backoff=0.1, jitter=0.1, retry_on=(OSError,),
+          sleep=time.sleep, on_retry=None):
+    """Decorator: re-run the callable on ``retry_on`` errors.
+
+    Attempt ``k`` (1-based) sleeps ``backoff * 2**(k-1) * (1 + U[0,
+    jitter])`` before re-running. Non-matching exceptions propagate
+    immediately; exhausting ``max_attempts`` raises :class:`RetryError`
+    chaining the last cause. ``on_retry(attempt, error)`` is invoked
+    before each sleep — the hook the tests use to count attempts.
+    """
+    if max_attempts < 1:
+        raise ValueError('max_attempts must be >= 1, got %r'
+                         % (max_attempts,))
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(fn, args, kwargs,
+                              max_attempts=max_attempts, backoff=backoff,
+                              jitter=jitter, retry_on=retry_on,
+                              sleep=sleep, on_retry=on_retry)
+        return wrapper
+    return deco
+
+
+def retry_call(fn, args=(), kwargs=None, max_attempts=3, backoff=0.1,
+               jitter=0.1, retry_on=(OSError,), sleep=time.sleep,
+               on_retry=None):
+    """Functional form of :func:`retry` for one-off call sites."""
+    kwargs = kwargs or {}
+    last = None
+    for attempt in range(1, max_attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:  # noqa: B902 — tuple comes from caller
+            last = e
+            name = getattr(fn, '__name__', repr(fn))
+            if attempt == max_attempts:
+                raise RetryError(name, attempt, e) from e
+            delay = backoff * (2 ** (attempt - 1))
+            if jitter:
+                delay *= 1.0 + _jitter_rng.uniform(0.0, jitter)
+            logger.warning('retry %d/%d of %s after %r (sleeping %.3fs)',
+                           attempt, max_attempts, name, e, delay)
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if delay > 0:
+                sleep(delay)
+    raise RetryError(getattr(fn, '__name__', repr(fn)), max_attempts,
+                     last)  # pragma: no cover — loop always returns/raises

@@ -5,9 +5,22 @@ events, build_feed_var_list). TPU design notes: `parallel=True` maps to
 the pjit-SPMD ParallelExecutor (mesh data parallelism) instead of the
 reference's per-GPU program clones; the pserver/NCCL2 env-var transpile
 path maps onto DistributeTranspiler's collective lowering.
+
+Resilience (RESILIENCE.md): ``train(..., checkpoint_config=
+CheckpointConfig(dir))`` periodically saves params + optimizer
+accumulators + trainer progress (epoch/step/RNG key) through the atomic
+checkpoint protocol and TRANSPARENTLY resumes after a kill — a fresh
+``Trainer().train()`` with the same config restores the newest healthy
+serial and skips the already-completed steps. ``anomaly_guard=
+AnomalyGuard(policy=...)`` screens feed batches and fetched losses (and
+optionally gradient global norms) for NaN/Inf/spikes, reacting per
+policy: ``raise`` / ``skip_batch`` / ``rollback_to_checkpoint``.
 """
 import contextlib
+import logging
 import os
+
+import numpy as np
 
 from . import framework
 from . import executor
@@ -15,11 +28,17 @@ from . import io
 from . import optimizer as opt_module
 from . import data_feeder
 from . import unique_name
+from .core.lowering import RNG_KEY
 from .core.places import TPUPlace, CPUPlace
 from .parallel import parallel_executor
+from .resilience import CheckpointConfig, AnomalyGuard  # noqa: F401 (API)
+from .resilience import anomaly as _anomaly
 
 __all__ = ['Trainer', 'BeginEpochEvent', 'EndEpochEvent',
-           'BeginStepEvent', 'EndStepEvent', 'check_and_get_place']
+           'BeginStepEvent', 'EndStepEvent', 'check_and_get_place',
+           'CheckpointConfig']
+
+_logger = logging.getLogger('paddle_tpu.resilience')
 
 
 class BeginEpochEvent(object):
@@ -118,7 +137,24 @@ class Trainer(object):
         self.__stop = True
 
     def train(self, num_epochs, event_handler, reader=None,
-              feed_order=None):
+              feed_order=None, checkpoint_config=None,
+              anomaly_guard=None):
+        """``checkpoint_config``: a resilience.CheckpointConfig — save
+        progress every ``step_interval`` steps / ``epoch_interval``
+        epochs through the atomic checkpoint protocol and auto-resume
+        from the newest healthy serial when one exists.
+        ``anomaly_guard``: a resilience.AnomalyGuard screening feeds,
+        losses and (optionally) gradient norms each step."""
+        if checkpoint_config is not None and not isinstance(
+                checkpoint_config, CheckpointConfig):
+            raise TypeError('checkpoint_config must be a '
+                            'resilience.CheckpointConfig')
+        if anomaly_guard is not None and not isinstance(
+                anomaly_guard, AnomalyGuard):
+            raise TypeError('anomaly_guard must be a '
+                            'resilience.AnomalyGuard')
+        self._checkpoint_config = checkpoint_config
+        self._anomaly_guard = anomaly_guard
         if self.parallel:
             self._train_by_parallel_executor(num_epochs, event_handler,
                                              reader, feed_order)
@@ -163,26 +199,163 @@ class Trainer(object):
             self._train_loop(event_handler, pe, num_epochs, reader,
                              feeder)
 
+    # ---- resilience helpers ---------------------------------------------
+    def _grad_fetch_names(self):
+        """``<param>@GRAD`` names that exist in the train program, for
+        AnomalyGuard(monitor_gradients=True)."""
+        block = self.train_program.global_block()
+        names = []
+        for p in block.all_parameters():
+            g = p.name + '@GRAD'
+            if block._find_var_recursive(g) is not None:
+                names.append(g)
+        return names
+
+    def _rng_state(self):
+        rng = self.scope.raw(RNG_KEY)
+        if rng is None:
+            return None
+        arr = np.asarray(rng)
+        return {'dtype': str(arr.dtype), 'shape': list(arr.shape),
+                'data': arr.ravel().tolist()}
+
+    def _restore_rng(self, state):
+        if not state:
+            return
+        import jax.numpy as jnp
+        arr = np.asarray(state['data'], dtype=state['dtype']).reshape(
+            state['shape'])
+        self.scope.set_var(RNG_KEY, jnp.asarray(arr))
+
+    def _save_progress_checkpoint(self, cfg, epoch_id, step_id,
+                                  global_step):
+        """One atomic checkpoint carrying params + optimizer
+        accumulators (persistables) and the trainer's own progress, so
+        a restart replays NOTHING and repeats NOTHING."""
+        state = {'epoch': epoch_id, 'step': step_id,
+                 'global_step': global_step, 'rng': self._rng_state()}
+        io.save_checkpoint(
+            executor.Executor(self.place), cfg.checkpoint_dir,
+            max_num_checkpoints=cfg.max_num_checkpoints,
+            save_interval_secs=cfg.save_interval_secs,
+            main_program=self.train_program, backend=cfg.backend,
+            trainer_state=state)
+
+    def _maybe_resume(self, cfg):
+        """Restore the newest healthy checkpoint (params into the
+        scope, RNG key, progress counters). Returns (start_epoch,
+        resume_step, global_step); resume_step is the LAST COMPLETED
+        step index inside start_epoch (-1 = none)."""
+        if cfg is None or not cfg.resume:
+            return 0, -1, 0
+        if not io._get_checkpoint_serials(cfg.checkpoint_dir):
+            return 0, -1, 0
+        exe = executor.Executor(self.place)
+        cur_dir = io.load_checkpoint(exe, cfg.checkpoint_dir,
+                                     main_program=self.train_program)
+        from .resilience import read_manifest
+        manifest = read_manifest(cur_dir) or {}
+        state = manifest.get('trainer_state')
+        if not state:
+            _logger.warning('auto-resume: %s has no trainer_state; '
+                            'restored params only', cur_dir)
+            return 0, -1, 0
+        self._restore_rng(state.get('rng'))
+        _logger.info('auto-resume: restored %s (epoch %d, step %d)',
+                     cur_dir, state['epoch'], state['step'])
+        return state['epoch'], state['step'], state['global_step']
+
+    def _handle_anomaly(self, err, exe_for_reload):
+        """Apply the guard's policy to a detected anomaly. Returns
+        'skip' when the current batch should be dropped."""
+        guard = self._anomaly_guard
+        if guard.policy == 'raise':
+            raise err
+        if guard.policy == 'rollback_to_checkpoint':
+            cfg = self._checkpoint_config
+            if cfg is not None and io._get_checkpoint_serials(
+                    cfg.checkpoint_dir):
+                cur_dir = io.load_checkpoint(
+                    exe_for_reload, cfg.checkpoint_dir,
+                    main_program=self.train_program)
+                from .resilience import read_manifest
+                state = (read_manifest(cur_dir) or {}).get(
+                    'trainer_state') or {}
+                self._restore_rng(state.get('rng'))
+                _logger.warning('anomaly: rolled parameters back to %s '
+                                'after %s', cur_dir, err)
+            else:
+                _logger.warning('anomaly: rollback requested but no '
+                                'checkpoint available; skipping batch '
+                                '(%s)', err)
+        return 'skip'
+
     def _train_loop(self, event_handler, exe, num_epochs, reader, feeder):
         fetch_names = [v.name for v in self.train_func_outputs]
-        for epoch_id in range(num_epochs):
+        guard = self._anomaly_guard = getattr(self, '_anomaly_guard',
+                                              None)
+        cfg = self._checkpoint_config = getattr(self, '_checkpoint_config',
+                                                None)
+        grad_names = []
+        if guard is not None and guard.monitor_gradients:
+            grad_names = self._grad_fetch_names()
+        reload_exe = executor.Executor(self.place)
+        start_epoch, resume_step, global_step = self._maybe_resume(cfg)
+        for epoch_id in range(start_epoch, num_epochs):
             event_handler(BeginEpochEvent(epoch_id))
             for step_id, data in enumerate(reader()):
                 if self.__stop:
                     return
+                if epoch_id == start_epoch and step_id <= resume_step:
+                    continue  # completed before the restart
                 begin = BeginStepEvent(epoch_id, step_id)
                 event_handler(begin)
                 feed = feeder.feed(data)
+                if guard is not None and guard.check_feeds:
+                    err = guard.inspect_feed(feed)
+                    if err is not None and self._handle_anomaly(
+                            err, reload_exe) == 'skip':
+                        # batch never reaches the device: params stay
+                        # clean; the event stream still advances so
+                        # step counts match an un-poisoned run
+                        global_step += 1
+                        event_handler(EndStepEvent(epoch_id, step_id,
+                                                   None))
+                        continue
+                want_fetch = begin.fetch_metrics or bool(grad_names)
+                run_fetches = (fetch_names + grad_names) if want_fetch \
+                    else []
                 if isinstance(exe, parallel_executor.ParallelExecutor):
-                    metrics = exe.run(fetch_names, feed=feed) \
-                        if begin.fetch_metrics else exe.run([], feed=feed)
+                    outs = exe.run(run_fetches, feed=feed)
                 else:
-                    metrics = exe.run(
-                        feed=feed,
-                        fetch_list=fetch_names if begin.fetch_metrics
-                        else [])
+                    outs = exe.run(feed=feed, fetch_list=run_fetches)
+                metrics = outs[:len(fetch_names)] if want_fetch else outs
+                if guard is not None and want_fetch:
+                    err = None
+                    if guard.check_metrics and metrics:
+                        err = guard.inspect_loss(metrics[0])
+                    if err is None and grad_names:
+                        norm = _anomaly.global_norm(
+                            outs[len(fetch_names):])
+                        err = guard.inspect_grad_norm(norm)
+                    if err is not None:
+                        # post-step detection: the update already ran,
+                        # so 'skip_batch' can only log; 'rollback'
+                        # restores the last good params; 'raise' stops
+                        self._handle_anomaly(err, reload_exe)
+                global_step += 1
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                if cfg is not None and \
+                        global_step % cfg.step_interval == 0:
+                    self._save_progress_checkpoint(cfg, epoch_id,
+                                                   step_id, global_step)
             event_handler(EndEpochEvent(epoch_id))
+            if cfg is not None and \
+                    (epoch_id + 1) % cfg.epoch_interval == 0:
+                # recorded as "epoch_id+1, nothing done yet": a resume
+                # lands at the top of the NEXT epoch, not a replay
+                self._save_progress_checkpoint(cfg, epoch_id + 1, -1,
+                                               global_step)
 
     def _test_by_executor(self, reader, feed_order, fetch_list):
         with executor.scope_guard(self.scope):

@@ -18,7 +18,26 @@ _m = re.search(r'xla_force_host_platform_device_count=(\d+)',
 _n = int(_m.group(1)) if _m else int(
     os.environ.get('PADDLE_TPU_TEST_DEVICES', 8))
 
+# jax < 0.5 has no 'jax_num_cpu_devices' config option; the XLA flag is
+# the portable spelling and must land in the env BEFORE jax initialises.
+if _m is None:
+    os.environ['XLA_FLAGS'] = (
+        os.environ.get('XLA_FLAGS', '') +
+        ' --xla_force_host_platform_device_count=%d' % _n).strip()
+
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', _n)
+try:
+    jax.config.update('jax_num_cpu_devices', _n)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS setting above already applies
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'slow: long-running tests excluded from tier-1')
+    config.addinivalue_line(
+        'markers',
+        'faultinject: tests that drive the resilience fault-injection '
+        'harness (tier-1; filter with -m "not faultinject")')

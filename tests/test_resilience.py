@@ -1,0 +1,557 @@
+"""Fault-tolerant training runtime (RESILIENCE.md): atomic checkpoint
+commit + CRC fallback, retry/backoff, NaN-policy matrix, auto-resume
+after a simulated kill, and the fault-injection harness itself.
+
+All CPU, all fast, all tier-1. Tests that drive the fault-injection
+harness carry the ``faultinject`` marker (filter: -m 'not faultinject').
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+import paddle_tpu.io as pio
+from paddle_tpu import resilience
+from paddle_tpu.resilience import (AnomalyError, AnomalyGuard,
+                                   CheckpointConfig, FaultInjected,
+                                   KillSwitch, RetryError, SimulatedKill,
+                                   fault_plan, faultinject, retry)
+
+
+# ---- shared fixtures ------------------------------------------------------
+def _linear_program(w_name='w_res'):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        t = fluid.layers.data(name='t', shape=[1], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1,
+                            param_attr=fluid.ParamAttr(name=w_name))
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(input=y, label=t))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    return main, startup, loss
+
+
+def _feed(seed=0):
+    rng = np.random.RandomState(seed)
+    return {'x': rng.randn(8, 4).astype('float32'),
+            't': rng.randn(8, 1).astype('float32')}
+
+
+def _saved_scope(tmp_path, nsaves=2, w_name='w_res'):
+    """Train a step per save; returns (ckdir, [w after each save])."""
+    main, startup, loss = _linear_program(w_name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ckdir = str(tmp_path / 'ck')
+    ws = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(nsaves):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+            pio.save_checkpoint(exe, ckdir, main_program=main,
+                                save_interval_secs=0, backend='npz')
+            ws.append(fluid.fetch_var(w_name, scope).copy())
+    return main, exe, ckdir, ws
+
+
+# ---- retry/backoff --------------------------------------------------------
+def test_retry_decorator_counts_attempts_and_backs_off():
+    sleeps, attempts = [], []
+
+    calls = [0]
+
+    @retry(max_attempts=4, backoff=0.1, jitter=0.0,
+           sleep=sleeps.append, on_retry=lambda a, e: attempts.append(a))
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise IOError('transient %d' % calls[0])
+        return 'ok'
+
+    assert flaky() == 'ok'
+    assert calls[0] == 3
+    assert attempts == [1, 2]
+    # exponential: 0.1, 0.2 (jitter disabled)
+    assert sleeps == pytest.approx([0.1, 0.2])
+
+
+def test_retry_exhaustion_raises_retry_error_with_cause():
+    @retry(max_attempts=2, backoff=0.0, jitter=0.0, sleep=lambda s: None)
+    def always_fails():
+        raise IOError('permanent')
+
+    with pytest.raises(RetryError) as ei:
+        always_fails()
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last_error, IOError)
+
+
+def test_retry_does_not_catch_unlisted_errors():
+    @retry(max_attempts=5, retry_on=(IOError,), sleep=lambda s: None)
+    def typo():
+        raise ValueError('not transient')
+
+    with pytest.raises(ValueError):
+        typo()
+
+
+@pytest.mark.faultinject
+def test_retry_reader_absorbs_transient_failures():
+    def source():
+        for i in range(6):
+            yield (i,)
+
+    flaky = faultinject.flaky_reader(source, fail_at=[2, 4])
+    robust = paddle_tpu.reader.retry_reader(flaky, max_attempts=3,
+                                            backoff=0.0, jitter=0.0,
+                                            sleep=lambda s: None)
+    # uninterrupted stream: no duplicates, no holes
+    assert [v[0] for v in robust()] == list(range(6))
+
+
+def test_retry_reader_gives_up_after_max_attempts():
+    def dead():
+        raise IOError('disk gone')
+        yield  # pragma: no cover
+
+    robust = paddle_tpu.reader.retry_reader(dead, max_attempts=3,
+                                            backoff=0.0, jitter=0.0,
+                                            sleep=lambda s: None)
+    with pytest.raises(RetryError):
+        list(robust())
+
+
+# ---- atomic checkpoints + corruption fallback -----------------------------
+def test_checkpoint_manifest_records_tensors_and_crcs(tmp_path):
+    _main, _exe, ckdir, _ws = _saved_scope(tmp_path, nsaves=1)
+    d = os.path.join(ckdir, 'checkpoint_0')
+    manifest = resilience.read_manifest(d)
+    assert manifest['backend'] == 'npz'
+    assert manifest['serial'] == 0
+    assert 'w_res' in manifest['tensors']
+    meta = manifest['tensors']['w_res']
+    assert meta['shape'] == [4, 1] and meta['dtype'] == 'float32'
+    assert isinstance(meta['crc32'], int)
+    assert manifest['files']  # file-level CRCs too
+    assert resilience.verify_checkpoint(d) == []
+
+
+@pytest.mark.faultinject
+def test_corrupted_newest_serial_falls_back_to_previous(tmp_path, caplog):
+    main, exe, ckdir, ws = _saved_scope(tmp_path, nsaves=2)
+    faultinject.corrupt_checkpoint(ckdir)  # newest = serial 1
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        import logging
+        with caplog.at_level(logging.WARNING,
+                             logger='paddle_tpu.resilience'):
+            got = pio.load_checkpoint(exe, ckdir, main_program=main)
+    assert got.endswith('checkpoint_0')
+    assert any('corrupt' in r.message for r in caplog.records)
+    np.testing.assert_allclose(
+        np.asarray(scope.raw('w_res')), ws[0], rtol=1e-6)
+
+
+@pytest.mark.faultinject
+def test_truncated_newest_serial_falls_back(tmp_path):
+    main, exe, ckdir, ws = _saved_scope(tmp_path, nsaves=2)
+    faultinject.truncate_checkpoint(ckdir)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        got = pio.load_checkpoint(exe, ckdir, main_program=main)
+    assert got.endswith('checkpoint_0')
+
+
+@pytest.mark.faultinject
+def test_all_serials_corrupt_raises(tmp_path):
+    main, exe, ckdir, _ws = _saved_scope(tmp_path, nsaves=2)
+    faultinject.corrupt_checkpoint(ckdir, serial=0)
+    faultinject.corrupt_checkpoint(ckdir, serial=1)
+    with pytest.raises(IOError):
+        with fluid.scope_guard(fluid.Scope()):
+            pio.load_checkpoint(exe, ckdir, main_program=main)
+
+
+@pytest.mark.faultinject
+def test_explicit_serial_corruption_raises_not_falls_back(tmp_path):
+    main, exe, ckdir, _ws = _saved_scope(tmp_path, nsaves=2)
+    faultinject.corrupt_checkpoint(ckdir, serial=1)
+    with pytest.raises(resilience.CheckpointCorruption):
+        with fluid.scope_guard(fluid.Scope()):
+            pio.load_checkpoint(exe, ckdir, serial=1, main_program=main)
+
+
+@pytest.mark.faultinject
+def test_kill_mid_save_leaves_no_partial_checkpoint(tmp_path):
+    """An error between payload fsync and rename (≈ SIGKILL mid-commit)
+    must leave zero partially-visible serials; the next save succeeds."""
+    _main, _exe, ckdir, _ws = _saved_scope(tmp_path, nsaves=1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main2, startup2, _loss2 = _linear_program('w_res')
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        with fault_plan() as plan:
+            plan.inject(faultinject.SITE_CKPT_COMMIT, times=1)
+            with pytest.raises(FaultInjected):
+                pio.save_checkpoint(exe2, ckdir, main_program=main2,
+                                    save_interval_secs=0, backend='npz')
+        listing = sorted(os.listdir(ckdir))
+        assert listing == ['checkpoint_0']  # no serial 1, no tmp wreck
+        assert resilience.verify_checkpoint(
+            os.path.join(ckdir, 'checkpoint_0')) == []
+        # next save lands normally
+        d = pio.save_checkpoint(exe2, ckdir, main_program=main2,
+                                save_interval_secs=0, backend='npz')
+        assert d.endswith('checkpoint_1')
+        assert resilience.verify_checkpoint(d) == []
+
+
+@pytest.mark.faultinject
+def test_transient_write_error_is_retried(tmp_path):
+    main, startup, loss = _linear_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with fault_plan() as plan:
+            plan.inject(faultinject.SITE_CKPT_WRITE, times=1)
+            d = pio.save_checkpoint(exe, str(tmp_path / 'ck'),
+                                    main_program=main,
+                                    save_interval_secs=0, backend='npz')
+        assert plan.faults[faultinject.SITE_CKPT_WRITE] == 1
+        assert plan.hits[faultinject.SITE_CKPT_WRITE] == 2  # 1 retry
+        assert resilience.verify_checkpoint(d) == []
+
+
+@pytest.mark.faultinject
+def test_transient_read_error_is_retried(tmp_path):
+    main, exe, ckdir, ws = _saved_scope(tmp_path, nsaves=1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fault_plan() as plan:
+            plan.inject(faultinject.SITE_CKPT_READ, times=1)
+            pio.load_checkpoint(exe, ckdir, main_program=main)
+        assert plan.hits[faultinject.SITE_CKPT_READ] == 2
+    np.testing.assert_allclose(np.asarray(scope.raw('w_res')), ws[0],
+                               rtol=1e-6)
+
+
+# ---- satellite: pruning / prefix hygiene / rate limit ---------------------
+def test_prune_never_deletes_serial_being_written(tmp_path):
+    """max_num_checkpoints=0 used to delete EVERY serial including the
+    one just written (sorted(serials)[:-0] == all)."""
+    main, startup, _loss = _linear_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        d = pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                save_interval_secs=0,
+                                max_num_checkpoints=0, backend='npz')
+        assert os.path.isdir(d)
+        assert resilience.verify_checkpoint(d) == []
+
+
+def test_clean_checkpoint_ignores_prefix_sharing_dirs(tmp_path):
+    main, startup, _loss = _linear_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    # innocent bystanders that merely share the prefix
+    (tmp_path / 'checkpoint_backup').mkdir()
+    (tmp_path / 'checkpoint_backup' / 'keep.txt').write_text('precious')
+    (tmp_path / 'checkpoint_3.bak').mkdir()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                            save_interval_secs=0, backend='npz')
+    pio.clean_checkpoint(str(tmp_path))
+    left = sorted(os.listdir(str(tmp_path)))
+    assert left == ['checkpoint_3.bak', 'checkpoint_backup']
+    assert (tmp_path / 'checkpoint_backup' / 'keep.txt').exists()
+
+
+def test_prefix_sharing_dirs_never_parse_as_serials(tmp_path):
+    (tmp_path / 'checkpoint_backup_7').mkdir()
+    (tmp_path / 'checkpoint_backup_7' / '_SUCCESS').write_text('')
+    assert pio._get_checkpoint_serials(str(tmp_path)) == []
+
+
+def test_rate_limit_uses_manifest_mtime_not_dir_mtime(tmp_path):
+    """Directory mtime churns (pruning, marker rewrites); an old save
+    whose DIR mtime got refreshed must not suppress new saves."""
+    main, startup, _loss = _linear_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        d0 = pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                 save_interval_secs=0, backend='npz')
+        # the save is genuinely old (manifest mtime in the past)...
+        old = os.path.getmtime(d0) - 3600
+        os.utime(os.path.join(d0, resilience.MANIFEST_FILENAME),
+                 (old, old))
+        # ...but something refreshed the dir mtime (e.g. pruning)
+        os.utime(d0, None)
+        d1 = pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                 save_interval_secs=600, backend='npz')
+        assert d1 != d0  # saved, not skipped
+        # and a genuinely fresh manifest still rate-limits
+        d2 = pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                 save_interval_secs=600, backend='npz')
+        assert d2 == d1
+
+
+# ---- check_checkpoint CLI -------------------------------------------------
+@pytest.mark.faultinject
+def test_check_checkpoint_cli(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..',
+                                    'tools'))
+    try:
+        import check_checkpoint
+    finally:
+        sys.path.pop(0)
+    _main, _exe, ckdir, _ws = _saved_scope(tmp_path, nsaves=2)
+    assert check_checkpoint.main([ckdir]) == 0
+    faultinject.corrupt_checkpoint(ckdir)
+    assert check_checkpoint.main([ckdir]) == 1
+    out = capsys.readouterr().out
+    assert 'CORRUPT' in out and 'crc32' in out
+    # single healthy serial dir as target; and --serial filter
+    assert check_checkpoint.main(
+        [os.path.join(ckdir, 'checkpoint_0')]) == 0
+    assert check_checkpoint.main([ckdir, '--serial', '1']) == 1
+    assert check_checkpoint.main([str(tmp_path / 'nothing_here')]) == 2
+
+
+# ---- anomaly guards -------------------------------------------------------
+def _make_trainer():
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        t = fluid.layers.data(name='t', shape=[1], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1,
+                            param_attr=fluid.ParamAttr(name='w_tr'))
+        return fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(input=y, label=t))
+
+    return fluid.Trainer(train_func,
+                         fluid.optimizer.SGD(learning_rate=0.05),
+                         place=fluid.CPUPlace())
+
+
+_RNG = np.random.RandomState(7)
+_SAMPLES = [(_RNG.randn(4).astype('float32'),
+             _RNG.randn(1).astype('float32')) for _ in range(12)]
+
+
+def _sample_reader():
+    for s in _SAMPLES:
+        yield s
+
+
+def _batched():
+    return paddle_tpu.batch(_sample_reader, 4)  # 3 steps/epoch
+
+
+@pytest.mark.faultinject
+def test_nan_policy_skip_batch_keeps_step_count():
+    poisoned = faultinject.nan_reader(_batched(), at_steps=[1])
+    seen = []
+    tr = _make_trainer()
+    tr.train(1, lambda e: seen.append(e.metrics) if isinstance(
+        e, fluid.EndStepEvent) else None,
+        reader=poisoned, feed_order=['x', 't'],
+        anomaly_guard=AnomalyGuard(policy='skip_batch'))
+    # same final step count as a clean run; poisoned step has metrics
+    # None; parameters never saw the NaNs
+    assert len(seen) == 3
+    assert sum(1 for m in seen if m is None) == 1
+    assert np.isfinite(np.asarray(tr.scope.raw('w_tr'))).all()
+
+
+@pytest.mark.faultinject
+def test_nan_policy_raise():
+    poisoned = faultinject.nan_reader(_batched(), at_steps=[1])
+    tr = _make_trainer()
+    with pytest.raises(AnomalyError):
+        tr.train(1, lambda e: None, reader=poisoned,
+                 feed_order=['x', 't'],
+                 anomaly_guard=AnomalyGuard(policy='raise'))
+
+
+@pytest.mark.faultinject
+def test_nan_policy_rollback_restores_params(tmp_path):
+    cfg = CheckpointConfig(checkpoint_dir=str(tmp_path / 'ck'),
+                           step_interval=1, backend='npz')
+    poisoned = faultinject.nan_reader(_batched(), at_steps=[2])
+    tr = _make_trainer()
+    tr.train(1, lambda e: None, reader=poisoned, feed_order=['x', 't'],
+             checkpoint_config=cfg,
+             anomaly_guard=AnomalyGuard(policy='rollback_to_checkpoint'))
+    w = np.asarray(tr.scope.raw('w_tr'))
+    assert np.isfinite(w).all()
+
+
+def test_anomaly_guard_spike_detection():
+    g = AnomalyGuard(policy='raise', spike_window=10, spike_factor=25.0,
+                     min_history=5)
+    for _ in range(6):
+        assert g.inspect_loss(1.0) is None
+    err = g.inspect_loss(100.0)
+    assert err is not None and err.kind == 'spike'
+    assert g.anomalies['spike'] == 1
+    # spikes disabled
+    g2 = AnomalyGuard(policy='raise', spike_window=0)
+    for _ in range(6):
+        assert g2.inspect_loss(1.0) is None
+    assert g2.inspect_loss(1e9) is None
+
+
+def test_anomaly_guard_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        AnomalyGuard(policy='ignore')
+
+
+@pytest.mark.faultinject
+def test_gradient_norm_monitoring_detects_poisoned_grads():
+    poisoned = faultinject.nan_reader(_batched(), at_steps=[1])
+    guard = AnomalyGuard(policy='skip_batch', check_feeds=False,
+                         check_metrics=False, monitor_gradients=True)
+    tr = _make_trainer()
+    tr.train(1, lambda e: None, reader=poisoned, feed_order=['x', 't'],
+             anomaly_guard=guard)
+    assert guard.anomalies['grad_nan'] >= 1
+
+
+def test_executor_level_guard_checks_raw_run_loops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        out = fluid.layers.reduce_mean(fluid.layers.scale(x, scale=2.0))
+    exe = fluid.Executor(fluid.CPUPlace())
+    bad = np.full((2, 4), np.nan, 'float32')
+    good = np.ones((2, 4), 'float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        guard = AnomalyGuard(policy='raise')
+        with resilience.executor_guard(guard):
+            exe.run(main, feed={'x': good}, fetch_list=[out])
+            with pytest.raises(AnomalyError):
+                exe.run(main, feed={'x': bad}, fetch_list=[out])
+        # guard uninstalled: NaN fetch passes through again
+        exe.run(main, feed={'x': bad}, fetch_list=[out])
+
+
+# ---- auto-resume ----------------------------------------------------------
+@pytest.mark.faultinject
+def test_kill_and_resume_roundtrip(tmp_path):
+    """Kill mid-training; a FRESH trainer with the same config resumes
+    from the newest checkpoint and ends bit-identical to an
+    uninterrupted run."""
+    clean = _make_trainer()
+    clean_steps = []
+    clean.train(2, lambda e: clean_steps.append(e) if isinstance(
+        e, fluid.EndStepEvent) else None,
+        reader=_batched(), feed_order=['x', 't'])
+    w_clean = np.asarray(clean.scope.raw('w_tr')).copy()
+    assert len(clean_steps) == 6  # 2 epochs x 3 steps
+
+    cfg = CheckpointConfig(checkpoint_dir=str(tmp_path / 'ck'),
+                           step_interval=2, max_num_checkpoints=2,
+                           backend='npz')
+    tr = _make_trainer()
+    with pytest.raises(SimulatedKill):
+        tr.train(2, KillSwitch(4), reader=_batched(),
+                 feed_order=['x', 't'], checkpoint_config=cfg)
+
+    resumed = _make_trainer()  # fresh process-equivalent: no state
+    resumed_steps = []
+    resumed.train(2, lambda e: resumed_steps.append((e.epoch, e.step))
+                  if isinstance(e, fluid.EndStepEvent) else None,
+                  reader=_batched(), feed_order=['x', 't'],
+                  checkpoint_config=cfg)
+    # only the un-done tail of the schedule is replayed
+    assert resumed_steps and len(resumed_steps) < 6
+    np.testing.assert_allclose(np.asarray(resumed.scope.raw('w_tr')),
+                               w_clean, rtol=1e-6)
+
+
+def test_resume_skips_nothing_without_checkpoints(tmp_path):
+    cfg = CheckpointConfig(checkpoint_dir=str(tmp_path / 'empty'),
+                           step_interval=100, backend='npz')
+    tr = _make_trainer()
+    steps = []
+    tr.train(1, lambda e: steps.append(e) if isinstance(
+        e, fluid.EndStepEvent) else None,
+        reader=_batched(), feed_order=['x', 't'], checkpoint_config=cfg)
+    assert len(steps) == 3
+
+
+def test_checkpoint_config_validation(tmp_path):
+    with pytest.raises(ValueError):
+        CheckpointConfig()
+    with pytest.raises(ValueError):
+        CheckpointConfig(checkpoint_dir=str(tmp_path), step_interval=0)
+    tr = _make_trainer()
+    with pytest.raises(TypeError):
+        tr.train(1, lambda e: None, reader=_batched(),
+                 feed_order=['x', 't'], checkpoint_config='/tmp/nope')
+    with pytest.raises(TypeError):
+        tr.train(1, lambda e: None, reader=_batched(),
+                 feed_order=['x', 't'], anomaly_guard='raise')
+
+
+def test_trainer_state_in_manifest_round_trips(tmp_path):
+    cfg = CheckpointConfig(checkpoint_dir=str(tmp_path / 'ck'),
+                           step_interval=2, backend='npz')
+    tr = _make_trainer()
+    tr.train(1, lambda e: None, reader=_batched(),
+             feed_order=['x', 't'], checkpoint_config=cfg)
+    state = pio.load_checkpoint_trainer_state(cfg.checkpoint_dir)
+    assert state is not None
+    assert state['epoch'] >= 0 and state['global_step'] >= 2
+    assert state['rng'] and state['rng']['data']
+
+
+# ---- fault-injection harness mechanics ------------------------------------
+def test_fault_plan_determinism():
+    plan = resilience.FaultPlan()
+    plan.inject('site.a', at=[1, 3])
+    hits = []
+    with fault_plan(plan):
+        for i in range(5):
+            try:
+                faultinject.maybe_fault('site.a')
+                hits.append(i)
+            except FaultInjected as e:
+                assert e.hit == i
+    assert hits == [0, 2, 4]
+    assert plan.hits['site.a'] == 5
+    assert plan.faults['site.a'] == 2
+    # no plan installed -> no-op
+    faultinject.maybe_fault('site.a')
+
+
+def test_fault_plan_every_and_custom_error():
+    class Boom(RuntimeError):
+        pass
+
+    plan = resilience.FaultPlan().inject('s', error=Boom, every=2)
+    with fault_plan(plan):
+        faultinject.maybe_fault('s')  # hit 0: (0+1)%2 != 0
+        with pytest.raises(Boom):
+            faultinject.maybe_fault('s')  # hit 1
+        faultinject.maybe_fault('s')
+        with pytest.raises(Boom):
+            faultinject.maybe_fault('s')
+
+
+def test_nan_reader_poisons_only_chosen_steps():
+    poisoned = faultinject.nan_reader(_batched(), at_steps=[0])
+    batches = list(poisoned())
+    assert len(batches) == 3
+    b0 = np.asarray([s[0] for s in batches[0]])
+    b1 = np.asarray([s[0] for s in batches[1]])
+    assert np.isnan(b0).all()
+    assert np.isfinite(b1).all()

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Validate checkpoint directories: manifest presence + CRC32 integrity.
+
+Usage:
+    python tools/check_checkpoint.py CKPT_DIR [--serial N] [--quiet]
+
+CKPT_DIR is either a checkpoint root (holding checkpoint_<N> serials)
+or a single serial directory. Exit code 0 = every checked serial is
+healthy, 1 = at least one is corrupt/incomplete, 2 = nothing
+checkpoint-shaped found. Meant for CI gates and pre-restore sanity
+checks; uses the exact validator ``io.load_checkpoint`` trusts
+(paddle_tpu/resilience/checkpoint.py).
+"""
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.resilience.checkpoint import (  # noqa: E402
+    MANIFEST_FILENAME, read_manifest, verify_checkpoint)
+
+_SERIAL_RE = re.compile(r'^checkpoint_(\d+)$')
+
+
+def _find_serial_dirs(root, serial=None):
+    if os.path.isfile(os.path.join(root, MANIFEST_FILENAME)) or \
+            os.path.isfile(os.path.join(root, '_SUCCESS')):
+        return [(None, root)]  # root IS a serial dir
+    found = []
+    for name in sorted(os.listdir(root)):
+        m = _SERIAL_RE.match(name)
+        path = os.path.join(root, name)
+        if m and os.path.isdir(path):
+            s = int(m.group(1))
+            if serial is None or s == serial:
+                found.append((s, path))
+    return found
+
+
+def check_dir(root, serial=None, quiet=False):
+    """Returns process exit code (0 healthy / 1 corrupt / 2 empty)."""
+    def say(msg):
+        if not quiet:
+            print(msg)
+
+    if not os.path.isdir(root):
+        say('error: %s is not a directory' % root)
+        return 2
+    dirs = _find_serial_dirs(root, serial)
+    if not dirs:
+        say('error: no checkpoint serials under %s' % root)
+        return 2
+    bad = 0
+    for s, path in dirs:
+        label = path if s is None else 'serial %d (%s)' % (s, path)
+        errors = verify_checkpoint(path)
+        manifest = read_manifest(path)
+        if errors:
+            bad += 1
+            say('CORRUPT  %s' % label)
+            for e in errors:
+                say('         - %s' % e)
+            continue
+        ntensors = len((manifest or {}).get('tensors', {}))
+        nfiles = len((manifest or {}).get('files', {}))
+        extra = ' [legacy: no manifest]' if manifest is None else \
+            ' (%d tensors, %d files, backend=%s)' % (
+                ntensors, nfiles, (manifest or {}).get('backend'))
+        say('OK       %s%s' % (label, extra))
+    say('%d/%d serial(s) healthy' % (len(dirs) - bad, len(dirs)))
+    return 1 if bad else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('ckpt_dir')
+    ap.add_argument('--serial', type=int, default=None,
+                    help='check only this serial')
+    ap.add_argument('--quiet', action='store_true')
+    args = ap.parse_args(argv)
+    return check_dir(args.ckpt_dir, serial=args.serial, quiet=args.quiet)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
