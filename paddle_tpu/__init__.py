@@ -51,6 +51,8 @@ from . import trainer  # noqa
 from .trainer import Trainer  # noqa
 from . import inferencer  # noqa
 from .inferencer import Inferencer  # noqa
+from . import serving  # noqa
+from .serving import ModelServer  # noqa
 from . import debugger  # noqa
 from . import debugger as debuger  # noqa  (reference spelling)
 from . import graphviz  # noqa

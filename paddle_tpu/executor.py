@@ -10,7 +10,9 @@ accumulators, BN moving stats, step counters, PRNG key) flows through the
 executable as donated buffers, so a training step is a single device
 computation with no host round-trips.
 """
+import collections
 import contextlib
+import threading
 
 import numpy as np
 import jax
@@ -25,8 +27,10 @@ from .core.lowering import (lower_block, runtime_dtype, RNG_KEY,
 from .lod import SequenceTensor
 from .resilience import anomaly as _anomaly
 
-__all__ = ['Executor', 'global_scope', 'scope_guard', 'switch_scope',
-           'fetch_var', 'as_numpy']
+__all__ = ['Executor', 'CacheInfo', 'global_scope', 'scope_guard',
+           'switch_scope', 'fetch_var', 'as_numpy']
+
+CacheInfo = collections.namedtuple('CacheInfo', ['hits', 'misses', 'size'])
 
 
 class VarBinding(object):
@@ -287,7 +291,23 @@ def _is_dynamic_program(program):
 class Executor(object):
     def __init__(self, place=None):
         self.place = place or _places.TPUPlace(0)
+        # serving worker threads share one Executor so padded batches of
+        # every model land in ONE compiled-program cache; the lock makes
+        # lookup+insert atomic (lower_block itself is cheap — XLA
+        # compilation happens lazily at first call, outside the lock,
+        # under jax.jit's own thread-safe cache)
         self._cache = {}
+        self._cache_lock = threading.RLock()
+        self._cache_hits = 0
+        self._cache_misses = 0
+
+    def cache_info(self):
+        """Compiled-program cache counters: a serving-layer SLI. A miss
+        means a fresh trace+compile (seconds); shape bucketing exists to
+        keep this at one miss per (program, bucket)."""
+        with self._cache_lock:
+            return CacheInfo(self._cache_hits, self._cache_misses,
+                             len(self._cache))
 
     # -------------------------------------------------------------------------
     def _prepare_feed(self, program, feed, dynamic=False):
@@ -601,29 +621,32 @@ class Executor(object):
         key = program_cache_key(program, feed, static_env, fetch_names,
                                 state_in_names, state_out_names, guard,
                                 profiling)
-        entry = self._cache.get(key)
-        if entry is None:
-            lower_prog = self._maybe_prune(program, fetch_names)
-            fn = lower_block(lower_prog, lower_prog.global_block(),
-                             sorted(feed.keys()), fetch_names,
-                             state_in_names, state_out_names,
-                             dynamic=dynamic, static_env=static_env)
-            if profiling or dynamic:
-                # Per-op profiling and dynamic (beam-decode) programs run
-                # UN-jitted: the lowering executes op by op on the device
-                # with concrete values and host control flow.
-                jitted = fn
-            elif guard:
-                # Debug mode: functionalize the per-op NaN/Inf checks.
-                # No donation — on a thrown error the scope must still
-                # hold live (pre-step) state buffers.
-                from jax.experimental import checkify
-                jitted = jax.jit(checkify.checkify(fn))
+        with self._cache_lock:
+            entry = self._cache.get(key)
+            if entry is None:
+                self._cache_misses += 1
+                lower_prog = self._maybe_prune(program, fetch_names)
+                fn = lower_block(lower_prog, lower_prog.global_block(),
+                                 sorted(feed.keys()), fetch_names,
+                                 state_in_names, state_out_names,
+                                 dynamic=dynamic, static_env=static_env)
+                if profiling or dynamic:
+                    # Per-op profiling and dynamic (beam-decode) programs
+                    # run UN-jitted: the lowering executes op by op on the
+                    # device with concrete values and host control flow.
+                    jitted = fn
+                elif guard:
+                    # Debug mode: functionalize the per-op NaN/Inf checks.
+                    # No donation — on a thrown error the scope must still
+                    # hold live (pre-step) state buffers.
+                    from jax.experimental import checkify
+                    jitted = jax.jit(checkify.checkify(fn))
+                else:
+                    jitted = jax.jit(fn, donate_argnums=(1,))
+                self._cache[key] = jitted
             else:
-                jitted = jax.jit(fn, donate_argnums=(1,))
-            self._cache[key] = jitted
-        else:
-            jitted = entry
+                self._cache_hits += 1
+                jitted = entry
 
         state = {n: scope.raw(n) for n in state_in_names}
 
@@ -685,4 +708,7 @@ class Executor(object):
         }
 
     def close(self):
-        self._cache.clear()
+        with self._cache_lock:
+            self._cache.clear()
+            self._cache_hits = 0
+            self._cache_misses = 0

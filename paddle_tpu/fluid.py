@@ -35,6 +35,8 @@ from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,  # noqa
 from . import resilience  # noqa
 from .resilience import AnomalyGuard, AnomalyError  # noqa
 from .inferencer import Inferencer  # noqa
+from . import serving  # noqa
+from .serving import ModelServer  # noqa
 from . import debugger  # noqa
 from . import debugger as debuger  # noqa
 from . import memory  # noqa

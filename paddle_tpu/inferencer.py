@@ -1,7 +1,12 @@
 """High-level Inferencer API.
 
-Parity: python/paddle/fluid/inferencer.py. The jitted-program cache in
-Executor makes repeated infer() calls compile once per feed signature.
+Parity: python/paddle/fluid/inferencer.py. On top of the Executor's
+jitted-program cache, ``infer`` routes through the serving layer's
+shape-bucketing helper: varying client batch sizes pad up to a small
+set of power-of-two buckets, so a client sweeping batch sizes 1..N pays
+``log2(N)`` compiles instead of N. Results are exact — pad rows are
+stripped, and programs whose fetches aren't row-aligned automatically
+fall back to the direct (unpadded) run.
 """
 import contextlib
 
@@ -15,14 +20,30 @@ __all__ = ['Inferencer']
 
 
 class Inferencer(object):
-    def __init__(self, infer_func, param_path, place=None, parallel=False):
+    def __init__(self, infer_func, param_path, place=None, parallel=False,
+                 bucket_batches=True, bucket_policy=None):
+        """``bucket_batches=False`` restores the raw one-compile-per-
+        batch-size behavior; ``bucket_policy`` overrides the default
+        power-of-two :class:`~paddle_tpu.serving.BucketPolicy`."""
         self.param_path = param_path
         self.scope = executor.Scope()
         self.parallel = parallel
         self.place = check_and_get_place(place)
+        if bucket_batches:
+            from .serving.bucketing import BucketPolicy
+            self.bucket_policy = bucket_policy or BucketPolicy()
+        else:
+            self.bucket_policy = None
 
         self.inference_program = framework.Program()
-        with framework.program_guard(self.inference_program):
+        # A private startup program: infer_func's parameter creation
+        # must not leak init vars/ops into the ambient global startup
+        # program (they collide with auto-generated names left there by
+        # earlier programs); the Inferencer never runs startup — params
+        # come from ``param_path``.
+        self.startup_program = framework.Program()
+        with framework.program_guard(self.inference_program,
+                                     self.startup_program):
             with unique_name.guard():
                 self.predict_var = infer_func()
 
@@ -45,6 +66,13 @@ class Inferencer(object):
             if self.parallel:
                 return self.exe.run([self.predict_var], feed=inputs,
                                     return_numpy=return_numpy)
+            if self.bucket_policy is not None:
+                from .serving.bucketing import run_bucketed
+                return run_bucketed(
+                    self.exe, self.inference_program, inputs,
+                    [self.predict_var], scope=self.scope,
+                    policy=self.bucket_policy,
+                    return_numpy=return_numpy)
             return self.exe.run(self.inference_program, feed=inputs,
                                 fetch_list=[self.predict_var],
                                 return_numpy=return_numpy)

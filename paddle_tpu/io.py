@@ -105,14 +105,17 @@ def _load_npz(dirname, filename=None):
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, scope=None):
+    """``scope`` targets a specific Scope instead of the ambient global
+    one — the serving registry loads each model into its own isolated
+    scope this way, without scope_guard gymnastics."""
     import jax.numpy as jnp
     if vars is None:
         if main_program is None:
             main_program = default_main_program()
         vars = list(filter(predicate, main_program.list_vars()))
     data = _load_npz(dirname, filename)
-    scope = global_scope()
+    scope = scope if scope is not None else global_scope()
     from .core.lowering import runtime_dtype
     for v in vars:
         name = v.name if isinstance(v, Variable) else v
@@ -122,14 +125,16 @@ def load_vars(executor, dirname, main_program=None, vars=None,
             scope.set_var(name, jnp.asarray(arr.astype(dt)))
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
     load_vars(executor, dirname, main_program, predicate=is_parameter,
-              filename=filename)
+              filename=filename, scope=scope)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
     load_vars(executor, dirname, main_program, predicate=is_persistable,
-              filename=filename)
+              filename=filename, scope=scope)
 
 
 # ---- program serialization ------------------------------------------------------
@@ -240,12 +245,12 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, scope=None):
     with open(os.path.join(dirname, model_filename or MODEL_FILE)) as f:
         meta = json.load(f)
     program = program_from_json(meta['program'])
     load_persistables(executor, dirname, program,
-                      filename=params_filename)
+                      filename=params_filename, scope=scope)
     fetch_vars = [program.global_block().var(n)
                   for n in meta['fetch_names']]
     return [program, meta['feed_names'], fetch_vars]

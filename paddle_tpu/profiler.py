@@ -18,10 +18,12 @@ sorted by a key). TPU design, two layers:
 """
 import contextlib
 import os
+import threading
 import time
 
 __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
-           'stop_profiler', 'save_profile']
+           'stop_profiler', 'save_profile', 'serving_span',
+           'record_serving_event', 'serving_stats']
 
 _stats = {'runs': 0, 'wall': 0.0}
 _trace_dir = None
@@ -29,6 +31,8 @@ _op_profiling = [False]
 _op_events = {}   # op_type -> [calls, total_s, max_s, min_s]
 _timeline = []    # raw (op_type, start_s, dur_s) while profiling
 _TIMELINE_CAP = 200000
+_serving_events = {}        # span name -> [calls, total_s, max_s, min_s]
+_serving_lock = threading.Lock()
 
 
 def op_profiling_enabled():
@@ -58,6 +62,44 @@ def save_profile(path):
     return path
 
 
+def record_serving_event(name, seconds):
+    """Record one serving-layer span (queue wait, pad, batch run, ...).
+    Always on — serving spans are host-side and cheap, and the serving
+    stats surface must work in production without enabling the (slow,
+    un-jitted) per-op profiler. Thread-safe: spans land from N serving
+    workers concurrently."""
+    with _serving_lock:
+        ev = _serving_events.get(name)
+        if ev is None:
+            _serving_events[name] = [1, seconds, seconds, seconds]
+        else:
+            ev[0] += 1
+            ev[1] += seconds
+            ev[2] = max(ev[2], seconds)
+            ev[3] = min(ev[3], seconds)
+
+
+@contextlib.contextmanager
+def serving_span(name):
+    """Time a serving-runtime section into the serving event table."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_serving_event(name, time.perf_counter() - t0)
+
+
+def serving_stats():
+    """Snapshot of the serving span table:
+    name -> {calls, total_ms, max_ms, min_ms, ave_ms}."""
+    with _serving_lock:
+        return {
+            name: {'calls': ev[0], 'total_ms': ev[1] * 1e3,
+                   'max_ms': ev[2] * 1e3, 'min_ms': ev[3] * 1e3,
+                   'ave_ms': ev[1] * 1e3 / ev[0]}
+            for name, ev in _serving_events.items()}
+
+
 @contextlib.contextmanager
 def cuda_profiler(output_file, output_mode=None, config=None):
     """Kept for script parity; on TPU this is the XLA trace profiler."""
@@ -70,6 +112,8 @@ def reset_profiler():
     _stats['wall'] = 0.0
     _op_events.clear()
     del _timeline[:]
+    with _serving_lock:
+        _serving_events.clear()
 
 
 def start_profiler(state='All', tracer_option=None,

@@ -1,0 +1,37 @@
+"""Batched, shape-bucketed inference serving runtime.
+
+The training stack compiles one XLA program per (program, feed-shape)
+signature; this package is the layer that keeps *serving* traffic
+inside that cache:
+
+- :mod:`~paddle_tpu.serving.bucketing` — power-of-two shape buckets +
+  ``run_bucketed`` (pad, run, strip; exact results).
+- :mod:`~paddle_tpu.serving.registry` — multi-model registry over
+  ``save_inference_model`` artifacts, one isolated scope per model.
+- :mod:`~paddle_tpu.serving.batcher` — bounded request queues + dynamic
+  micro-batching of compatible requests.
+- :mod:`~paddle_tpu.serving.server` — :class:`ModelServer`: worker
+  threads, admission control (load shedding + deadlines), warmup,
+  transient-failure retry, stats.
+- :mod:`~paddle_tpu.serving.stats` — request/batch latency histograms,
+  occupancy, bucket distribution, compile-cache hit rate.
+
+See SERVING.md for the architecture and tuning guide.
+"""
+from .errors import (ServingError, ServerOverloaded,  # noqa
+                     DeadlineExceeded, ModelNotFound, ServerClosed)
+from .bucketing import BucketPolicy, next_pow2, run_bucketed  # noqa
+from .registry import LoadedModel, ModelRegistry  # noqa
+from .batcher import InferenceRequest, MicroBatcher  # noqa
+from .stats import LatencyHistogram, ServingStats  # noqa
+from .server import ModelServer  # noqa
+
+__all__ = [
+    'ServingError', 'ServerOverloaded', 'DeadlineExceeded',
+    'ModelNotFound', 'ServerClosed',
+    'BucketPolicy', 'next_pow2', 'run_bucketed',
+    'LoadedModel', 'ModelRegistry',
+    'InferenceRequest', 'MicroBatcher',
+    'LatencyHistogram', 'ServingStats',
+    'ModelServer',
+]

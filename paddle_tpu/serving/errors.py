@@ -1,0 +1,38 @@
+"""Serving error taxonomy.
+
+Every failure a client can observe maps to exactly one of these, so
+callers can branch on type (shed vs. expired vs. model bug) instead of
+parsing messages.
+"""
+
+__all__ = ['ServingError', 'ServerOverloaded', 'DeadlineExceeded',
+           'ModelNotFound', 'ServerClosed']
+
+
+class ServingError(RuntimeError):
+    """Base class for all serving-runtime errors."""
+
+
+class ServerOverloaded(ServingError):
+    """Admission control rejected the request: the model's queue is at
+    ``max_queue_depth``. Load was shed at the door — the request was
+    never enqueued and cost the server nothing. Clients should back off
+    and retry."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before a worker could run it. The
+    batch it would have joined was never launched on its behalf."""
+
+
+class ModelNotFound(ServingError, KeyError):
+    """No model registered under the requested name."""
+
+    def __str__(self):
+        # KeyError.__str__ repr()s the message; keep it readable
+        return RuntimeError.__str__(self)
+
+
+class ServerClosed(ServingError):
+    """The server is shut down (or shutting down) and accepts no new
+    requests."""

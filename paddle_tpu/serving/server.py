@@ -1,0 +1,338 @@
+"""ModelServer: the batched, shape-bucketed inference serving runtime.
+
+Request path::
+
+    client thread --submit()--> per-model MicroBatcher (bounded queue)
+        --worker thread--> coalesce compatible requests
+        --> pad to power-of-two bucket (BucketPolicy)
+        --> shared Executor.run (ONE compiled-program cache, locked)
+        --> strip pad rows, split per request, set results
+
+Design points:
+
+- One worker thread per model serializes that model's scope (the
+  Executor donates state buffers per run; serialization makes that
+  safe) while different models run concurrently on the shared Executor.
+- Admission control sheds load at the door: ``max_queue_depth`` bounds
+  memory and tail latency, per-request deadlines bound time-in-queue,
+  and both failure modes surface as typed errors.
+- ``warmup()`` pushes one synthetic request per shape bucket through
+  the *public* path before traffic, so the first real user never pays a
+  trace+compile.
+- Transient run failures (``retry_on``, default OSError — NFS/GCS
+  hiccups under checkpoint-backed embedding stores) are absorbed by
+  :func:`resilience.retry_call` with exponential backoff.
+"""
+import threading
+import time
+
+import numpy as np
+
+from .. import profiler as _prof
+from ..core import places as _places
+from ..executor import Executor
+from ..lod import SequenceTensor
+from ..resilience import retry_call
+from .batcher import (InferenceRequest, MicroBatcher, merge_requests,
+                      split_fetches)
+from .bucketing import BucketPolicy, pad_feed
+from .errors import DeadlineExceeded, ServerClosed, ServingError
+from .registry import ModelRegistry
+from .stats import ServingStats
+
+__all__ = ['ModelServer']
+
+
+class ModelServer(object):
+    """Serve N models from one process with dynamic micro-batching.
+
+    Parameters
+    ----------
+    place : TPUPlace/CPUPlace, optional
+        Device the shared Executor runs on.
+    max_batch_size : int
+        Largest bucket a single run may carry; also the coalescing cap.
+    max_queue_depth : int
+        Per-model admission limit; a full queue raises ServerOverloaded.
+    batch_timeout : float
+        Seconds a worker waits for stragglers once it holds at least one
+        request and the batch is under-full. Latency/occupancy knob.
+    policy : BucketPolicy, optional
+        Shape-bucket ladder; defaults to pow2 buckets up to
+        ``max_batch_size``.
+    retry_attempts / retry_backoff / retry_on
+        Transient-failure retry for each batch run
+        (:mod:`paddle_tpu.resilience`).
+    """
+
+    def __init__(self, place=None, max_batch_size=64, max_queue_depth=128,
+                 batch_timeout=0.002, policy=None, retry_attempts=2,
+                 retry_backoff=0.05, retry_on=(OSError,)):
+        self.place = place or _places.TPUPlace(0)
+        self.executor = Executor(self.place)
+        self.policy = policy or BucketPolicy(max_bucket=max_batch_size)
+        if self.policy.max_bucket < max_batch_size:
+            raise ValueError(
+                'policy.max_bucket=%d < max_batch_size=%d: the largest '
+                'batch could not be bucketed'
+                % (self.policy.max_bucket, max_batch_size))
+        self.max_batch_size = max_batch_size
+        self.max_queue_depth = max_queue_depth
+        self.batch_timeout = batch_timeout
+        self.retry_attempts = retry_attempts
+        self.retry_backoff = retry_backoff
+        self.retry_on = tuple(retry_on)
+        self.registry = ModelRegistry()
+        self.stats = ServingStats()
+        self._batchers = {}            # model name -> MicroBatcher
+        self._workers = {}             # model name -> Thread
+        self._lock = threading.RLock()
+        self._closed = False
+
+    # ---- model management ------------------------------------------------
+    def load_model(self, name, dirname, model_filename=None,
+                   params_filename=None):
+        """Load a ``save_inference_model`` directory and start serving
+        it under ``name``."""
+        model = self.registry.load(name, dirname, self.executor,
+                                   model_filename=model_filename,
+                                   params_filename=params_filename)
+        self._start_worker(model)
+        return model
+
+    def register_model(self, name, program, feed_names, fetch_vars,
+                       scope):
+        """Serve an in-memory (program, scope) pair — no disk round
+        trip. The scope must hold the program's parameters."""
+        model = self.registry.register(name, program, feed_names,
+                                       fetch_vars, scope)
+        self._start_worker(model)
+        return model
+
+    def unload_model(self, name):
+        """Stop serving ``name``; its queued requests drain first."""
+        with self._lock:
+            batcher = self._batchers.pop(name, None)
+            worker = self._workers.pop(name, None)
+        if batcher is not None:
+            batcher.close()
+        if worker is not None:
+            worker.join()
+        return self.registry.unload(name)
+
+    def models(self):
+        return self.registry.names()
+
+    def _start_worker(self, model):
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('server is shut down')
+            batcher = MicroBatcher(max_queue_depth=self.max_queue_depth)
+            self._batchers[model.name] = batcher
+            worker = threading.Thread(
+                target=self._worker_loop, args=(model, batcher),
+                name='serve-%s' % model.name, daemon=True)
+            self._workers[model.name] = worker
+            worker.start()
+
+    # ---- client surface --------------------------------------------------
+    def submit(self, model_name, feeds, deadline=None, _warmup=False):
+        """Enqueue one request; returns an :class:`InferenceRequest`
+        future. ``deadline`` is relative seconds — the request fails
+        with DeadlineExceeded if no worker launches it in time. Raises
+        ServerOverloaded / ServerClosed / ModelNotFound synchronously.
+        """
+        model = self.registry.get(model_name)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('server is shut down')
+            batcher = self._batchers.get(model_name)
+        if batcher is None:
+            raise ServerClosed('model %r is unloaded' % model_name)
+        feeds, n = self._normalize_feeds(model, feeds)
+        abs_deadline = None if deadline is None \
+            else time.monotonic() + deadline
+        req = InferenceRequest(feeds, n, deadline=abs_deadline,
+                               warmup=_warmup)
+        try:
+            batcher.submit(req)
+        except ServingError:
+            self.stats.record_shed()
+            raise
+        self.stats.record_submitted()
+        return req
+
+    def infer(self, model_name, feeds, deadline=None, timeout=30.0):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(model_name, feeds, deadline=deadline).result(
+            timeout=timeout)
+
+    def _normalize_feeds(self, model, feeds):
+        if not isinstance(feeds, dict):
+            raise ValueError("feeds must be {'feed_name': array}")
+        missing = [n for n in model.feed_names if n not in feeds]
+        if missing:
+            raise ValueError('model %r is missing feeds %s'
+                             % (model.name, missing))
+        out, n = {}, None
+        for name in model.feed_names:
+            val = feeds[name]
+            if isinstance(val, SequenceTensor):
+                raise ValueError(
+                    'ModelServer serves dense batches; feed %r is a '
+                    'LoD/sequence tensor — use Executor.run directly'
+                    % name)
+            arr = np.asarray(val)
+            if arr.ndim < 1:
+                raise ValueError('feed %r must have a batch dim' % name)
+            if n is None:
+                n = int(arr.shape[0])
+            elif int(arr.shape[0]) != n:
+                raise ValueError(
+                    'feeds disagree on batch size: %d vs %d rows'
+                    % (n, int(arr.shape[0])))
+            out[name] = arr
+        if n > self.max_batch_size:
+            raise ValueError(
+                'request of %d rows exceeds max_batch_size=%d — split '
+                'it client-side' % (n, self.max_batch_size))
+        return out, n
+
+    # ---- warmup ----------------------------------------------------------
+    def warmup(self, model_name=None, upto=None, timeout=300.0):
+        """Pre-compile every shape bucket (one synthetic request per
+        bucket through the public path) so live traffic never pays a
+        compile. Returns ``{model: [bucket sizes warmed]}``; models
+        whose feed shapes are dynamic (unsynthesizable) are skipped."""
+        names = [model_name] if model_name is not None else self.models()
+        warmed = {}
+        with _prof.serving_span('serving/warmup'):
+            pending = []
+            for name in names:
+                model = self.registry.get(name)
+                warmed[name] = []
+                for bucket in self.policy.buckets(
+                        upto or self.max_batch_size):
+                    if bucket > self.max_batch_size:
+                        break
+                    feed = model.synthetic_feed(bucket)
+                    if feed is None:
+                        break
+                    pending.append(
+                        self.submit(name, feed, _warmup=True))
+                    warmed[name].append(bucket)
+            for req in pending:
+                req.result(timeout=timeout)
+        return {k: v for k, v in warmed.items() if v}
+
+    # ---- ops control -----------------------------------------------------
+    def pause(self, model_name=None):
+        """Stop draining (all models, or one): maintenance/drain
+        control. Admission and deadlines keep applying."""
+        for name in ([model_name] if model_name else list(self._batchers)):
+            self._batchers[name].pause()
+
+    def resume(self, model_name=None):
+        for name in ([model_name] if model_name else list(self._batchers)):
+            self._batchers[name].resume()
+
+    def queue_depth(self, model_name):
+        return self._batchers[model_name].depth()
+
+    def cache_info(self):
+        return self.executor.cache_info()
+
+    def stats_dict(self):
+        return self.stats.as_dict(cache_info=self.executor.cache_info())
+
+    def report(self):
+        return self.stats.report(cache_info=self.executor.cache_info())
+
+    def close(self):
+        """Graceful shutdown: reject new requests, drain every queue,
+        join the workers."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            batchers = list(self._batchers.values())
+            workers = list(self._workers.values())
+        for b in batchers:
+            b.close()
+        for w in workers:
+            w.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ---- worker ----------------------------------------------------------
+    def _worker_loop(self, model, batcher):
+        while True:
+            batch, expired = batcher.next_batch(
+                self.max_batch_size if model.batchable else 1,
+                batch_timeout=self.batch_timeout)
+            for req in expired:
+                self.stats.record_expired()
+                req.set_error(DeadlineExceeded(
+                    'deadline passed after %.3fs in queue'
+                    % req.latency()))
+            if batch is None:
+                return
+            if not batch:
+                continue          # only expired requests this round
+            try:
+                self._run_batch(model, batch)
+            except Exception as e:           # noqa: BLE001 — worker must
+                # never die: every queued client is waiting on it
+                self.stats.record_failed(len(batch))
+                for req in batch:
+                    if not req.done():
+                        req.set_error(e)
+
+    def _exe_run(self, model, feed):
+        return self.executor.run(model.program, feed=feed,
+                                 fetch_list=model.fetch_vars,
+                                 scope=model.scope)
+
+    def _run_guarded(self, model, feed):
+        """One Executor.run with transient-failure retry."""
+        def _on_retry(attempt, error):
+            self.stats.record_retry()
+        return retry_call(self._exe_run, (model, feed),
+                          max_attempts=self.retry_attempts,
+                          backoff=self.retry_backoff,
+                          retry_on=self.retry_on, on_retry=_on_retry)
+
+    def _run_batch(self, model, batch):
+        feed, rows, slices = merge_requests(batch)
+        bucket = self.policy.bucket_for(rows) if model.batchable else rows
+        with _prof.serving_span('serving/pad'):
+            padded = pad_feed(feed, rows, bucket, self.policy.pad_mode)
+        t0 = time.monotonic()
+        with _prof.serving_span('serving/batch_run'):
+            fetches = self._run_guarded(model, padded)
+        self.stats.record_batch(rows, bucket, time.monotonic() - t0)
+        parts = split_fetches(fetches, slices, rows, bucket)
+        if parts is None:
+            # a fetch isn't row-aligned (reduced over the batch): the
+            # padded/merged run polluted it. Serve each request alone,
+            # unpadded — exactness over throughput — and remember.
+            model.batchable = False
+            for req in batch:
+                with _prof.serving_span('serving/exact_fallback'):
+                    out = self._run_guarded(model, req.feeds)
+                self._complete(req, out)
+            return
+        for req, part in zip(batch, parts):
+            self._complete(req, part)
+
+    def _complete(self, req, fetches):
+        latency = req.latency()
+        if not req.warmup:
+            self.stats.record_completed(latency)
+            _prof.record_serving_event('serving/request', latency)
+        req.set_result(fetches)

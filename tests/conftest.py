@@ -41,3 +41,7 @@ def pytest_configure(config):
         'markers',
         'faultinject: tests that drive the resilience fault-injection '
         'harness (tier-1; filter with -m "not faultinject")')
+    config.addinivalue_line(
+        'markers',
+        'serving: tests of the paddle_tpu.serving runtime (tier-1, '
+        'CPU-safe; filter with -m "not serving")')
