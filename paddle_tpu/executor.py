@@ -13,12 +13,14 @@ computation with no host round-trips.
 import collections
 import contextlib
 import threading
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from . import framework
+from . import observability as _obs
 from .framework import Program, Variable, default_main_program
 from .core import places as _places
 from .core import lowering
@@ -300,6 +302,25 @@ class Executor(object):
         self._cache_lock = threading.RLock()
         self._cache_hits = 0
         self._cache_misses = 0
+        # process-wide telemetry (OBSERVABILITY.md): every Executor
+        # publishes into the same registry series; the per-instance
+        # ints above stay the source of the per-Executor cache_info()
+        # contract the serving tests pin.
+        reg = _obs.default_registry()
+        self._m_hits = reg.counter(
+            'executor_cache_hits_total',
+            'compiled-program cache hits across all Executors')
+        self._m_misses = reg.counter(
+            'executor_cache_misses_total',
+            'compiled-program cache misses (each one is a trace+compile)')
+        self._m_hit_rate = reg.gauge(
+            'executor_cache_hit_rate',
+            'process-wide cache hits / lookups')
+        self._m_run = reg.histogram(
+            'executor_run_seconds', 'Executor.run device-execution wall')
+        self._m_compile = reg.histogram(
+            'executor_compile_seconds',
+            'lowering + first (compiling) execution wall per cache miss')
 
     def cache_info(self):
         """Compiled-program cache counters: a serving-layer SLI. A miss
@@ -308,6 +329,17 @@ class Executor(object):
         with self._cache_lock:
             return CacheInfo(self._cache_hits, self._cache_misses,
                              len(self._cache))
+
+    def reset_cache_info(self):
+        """Zero the hit/miss counters WITHOUT dropping compiled
+        programs, so benchmark phases can be measured independently
+        instead of accumulating over the process lifetime. The
+        process-wide registry counters stay cumulative (Prometheus
+        semantics); use ``observability.default_registry().reset()`` to
+        zero those too."""
+        with self._cache_lock:
+            self._cache_hits = 0
+            self._cache_misses = 0
 
     # -------------------------------------------------------------------------
     def _prepare_feed(self, program, feed, dynamic=False):
@@ -621,10 +653,12 @@ class Executor(object):
         key = program_cache_key(program, feed, static_env, fetch_names,
                                 state_in_names, state_out_names, guard,
                                 profiling)
+        t_lookup = time.perf_counter()
         with self._cache_lock:
             entry = self._cache.get(key)
             if entry is None:
                 self._cache_misses += 1
+                _obs.emit('compile_begin', fp=key[0])
                 lower_prog = self._maybe_prune(program, fetch_names)
                 fn = lower_block(lower_prog, lower_prog.global_block(),
                                  sorted(feed.keys()), fetch_names,
@@ -647,9 +681,12 @@ class Executor(object):
             else:
                 self._cache_hits += 1
                 jitted = entry
+        was_miss = entry is None
+        (self._m_misses if was_miss else self._m_hits).inc()
 
         state = {n: scope.raw(n) for n in state_in_names}
 
+        t_run = time.perf_counter()
         with jax.default_device(self.place.jax_device()):
             if guard and not (profiling or dynamic):
                 err, (fetches, new_state) = jitted(feed, state)
@@ -657,6 +694,20 @@ class Executor(object):
             else:
                 # profiling path is eager; its guard checks raise inline
                 fetches, new_state = jitted(feed, state)
+        run_wall = time.perf_counter() - t_run
+        self._m_run.observe(run_wall)
+        h, m = self._m_hits.value, self._m_misses.value
+        self._m_hit_rate.set(h / (h + m) if h + m else 0.0)
+        if was_miss:
+            # jax.jit compiles lazily at the first call, so the real
+            # XLA compile wall is lookup -> end of this first execution
+            compile_wall = time.perf_counter() - t_lookup
+            self._m_compile.observe(compile_wall)
+            _obs.emit('compile_end', fp=key[0],
+                      dur_s=round(compile_wall, 6))
+        if _obs.journal_active():
+            _obs.emit('exe_run', cache='miss' if was_miss else 'hit',
+                      fp=key[0], dur_s=round(run_wall, 6))
         for n, v in new_state.items():
             scope.set_var(n, v)
         if getattr(program, '_half_inference', None):

@@ -15,6 +15,7 @@ import time as _time
 import numpy as np
 
 from . import framework
+from . import observability as _obs
 from . import resilience
 from .framework import Program, Parameter, Variable, default_main_program
 from .executor import global_scope, as_numpy
@@ -373,6 +374,7 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
                 return last_dir
         except OSError:
             pass
+    t_save = _time.monotonic()
     serial = (max(serials) + 1) if serials else 0
     cur_dir = _serial_dir(checkpoint_dir, serial)
     if os.path.isdir(cur_dir):
@@ -411,6 +413,14 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
         if s not in survivors and s != serial:
             shutil.rmtree(_serial_dir(checkpoint_dir, s),
                           ignore_errors=True)
+    dur = _time.monotonic() - t_save
+    reg = _obs.default_registry()
+    reg.counter('checkpoint_saves_total',
+                'atomic checkpoint commits').inc()
+    reg.histogram('checkpoint_save_seconds',
+                  'payload + fsync + rename wall').observe(dur)
+    _obs.emit('checkpoint_save', serial=serial, dir=cur_dir,
+              backend=used_backend, dur_s=round(dur, 6))
     return cur_dir
 
 
@@ -471,6 +481,7 @@ def load_checkpoint(executor, checkpoint_dir=None, serial=None,
     last_err = None
     for s in candidates:
         cur_dir = _serial_dir(checkpoint_dir, s)
+        t_load = _time.monotonic()
         if verify:
             errors = resilience.verify_checkpoint(cur_dir)
             if errors:
@@ -480,9 +491,18 @@ def load_checkpoint(executor, checkpoint_dir=None, serial=None,
                 _logger.warning(
                     'checkpoint serial %d is corrupt (%s); falling back '
                     'to previous serial', s, '; '.join(errors))
+                _obs.default_registry().counter(
+                    'checkpoint_fallbacks_total',
+                    'corrupt serials skipped during restore').inc()
+                _obs.emit('checkpoint_fallback', serial=s,
+                          errors=len(errors))
                 last_err = err
                 continue
         _load_checkpoint_payload(cur_dir, executor, main_program)
+        _obs.default_registry().counter(
+            'checkpoint_loads_total', 'checkpoint restores').inc()
+        _obs.emit('checkpoint_load', serial=s, dir=cur_dir,
+                  dur_s=round(_time.monotonic() - t_load, 6))
         return cur_dir
     raise IOError(
         'all %d checkpoint serial(s) under %s failed verification; '

@@ -1,0 +1,30 @@
+"""Unified telemetry for the paddle_tpu stack (OBSERVABILITY.md).
+
+Two complementary surfaces, both stdlib-only and import-cycle-free:
+
+- :mod:`~paddle_tpu.observability.metrics` — a thread-safe metrics
+  registry (counters, gauges, log2-bucket histograms) with Prometheus
+  text exposition and a JSON snapshot. The Executor, Trainer, serving
+  runtime and resilience layer all publish into
+  :func:`default_registry`.
+- :mod:`~paddle_tpu.observability.journal` — a structured JSONL run
+  journal (:class:`RunJournal`) of typed events with monotonic
+  timestamps and a run id: steps, XLA compiles, executor cache
+  hits/misses, checkpoints, serving batches, anomaly trips. Off by
+  default; install one with :func:`journal` / :func:`set_journal` and
+  render it with ``tools/obs_report.py`` or merge it into a
+  chrome://tracing view with ``tools/timeline.py --journal_path``.
+"""
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, default_registry,
+                      DEFAULT_SECONDS_EDGES)
+from .journal import (SCHEMA_VERSION, RunJournal, set_journal,  # noqa
+                      get_journal, journal, journal_active, emit,
+                      read_journal)
+
+__all__ = [
+    'Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
+    'default_registry', 'DEFAULT_SECONDS_EDGES',
+    'SCHEMA_VERSION', 'RunJournal', 'set_journal', 'get_journal',
+    'journal', 'journal_active', 'emit', 'read_journal',
+]

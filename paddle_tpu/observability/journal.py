@@ -1,0 +1,197 @@
+"""Structured JSONL run journal.
+
+One :class:`RunJournal` == one run artifact: an append-only file of
+newline-delimited JSON records, each carrying the run id, a monotonic
+timestamp (seconds since the journal opened), and typed fields. The
+first line is always a ``run_begin`` header anchoring the monotonic
+clock to wall-clock time, so the file is self-describing.
+
+Event schema (OBSERVABILITY.md has the full field tables):
+
+=================  =====================================================
+``run_begin``      header: wall-clock anchor, pid, schema version
+``train_begin``    trainer loop entry (epochs, resume point)
+``epoch_begin`` / ``epoch_end``
+``step_begin`` / ``step_end``  loss, examples, dur_s, grad_norm, throughput
+``compile_begin`` / ``compile_end``  program fingerprint, dur_s
+``exe_run``        one Executor.run: cache='hit'|'miss', dur_s
+``checkpoint_save`` / ``checkpoint_load`` / ``checkpoint_fallback``
+``serving_admit`` / ``serving_shed`` / ``serving_expired`` / ``serving_retry``
+``serving_batch``  rows, bucket, dur_s
+``anomaly``        kind, where, policy (AnomalyGuard trips)
+=================  =====================================================
+
+Records with a ``dur_s`` field are SPANS — ``tools/timeline.py`` can
+merge them into a chrome://tracing view on their own track, and
+``tools/obs_report.py`` ranks the slowest ones.
+
+Overhead contract: journalling is OFF by default — every wiring point
+goes through :func:`emit`, which is a module-global ``None`` check when
+no journal is installed. With a journal installed, records buffer in
+memory and flush every ``buffer_lines`` records (or ``flush_interval``
+seconds), so the hot path pays one ``json.dumps`` and a list append,
+never a syscall per event.
+"""
+import contextlib
+import json
+import os
+import threading
+import time
+import uuid
+
+__all__ = ['SCHEMA_VERSION', 'RunJournal', 'set_journal', 'get_journal',
+           'journal', 'journal_active', 'emit', 'read_journal']
+
+SCHEMA_VERSION = 1
+
+
+def _jsonable(obj):
+    """json.dumps fallback: numpy scalars -> python numbers, everything
+    else -> repr (a journal write must never throw on a field type)."""
+    try:
+        return float(obj)
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+class RunJournal(object):
+    """Buffered, thread-safe JSONL event writer with a stable run id."""
+
+    def __init__(self, path, run_id=None, buffer_lines=128,
+                 flush_interval=2.0):
+        self.path = path
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        self._buf = []
+        self._closed = False
+        self._buffer_lines = int(buffer_lines)
+        self._flush_interval = float(flush_interval)
+        self._t0 = time.monotonic()
+        self._last_flush = self._t0
+        self._f = open(path, 'w')
+        self.counts = {}   # event type -> records written (introspection)
+        self.record('run_begin', wall=time.time(), pid=os.getpid(),
+                    schema=SCHEMA_VERSION)
+
+    # ---- writing ---------------------------------------------------------
+    def record(self, ev, **fields):
+        """Append one typed event. Never raises on field types; silently
+        drops records after close (late worker threads)."""
+        now = time.monotonic()
+        rec = {'ev': ev, 'run': self.run_id,
+               't': round(now - self._t0, 6)}
+        rec.update(fields)
+        line = json.dumps(rec, separators=(',', ':'), default=_jsonable)
+        with self._lock:
+            if self._closed:
+                return
+            self._buf.append(line)
+            self.counts[ev] = self.counts.get(ev, 0) + 1
+            if len(self._buf) >= self._buffer_lines or \
+                    now - self._last_flush >= self._flush_interval:
+                self._flush_locked(now)
+
+    @contextlib.contextmanager
+    def span(self, ev, **fields):
+        """Time a block into one record with ``dur_s``."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.record(ev, dur_s=round(time.monotonic() - t0, 6),
+                        **fields)
+
+    def _flush_locked(self, now):
+        if self._buf:
+            self._f.write('\n'.join(self._buf) + '\n')
+            self._f.flush()
+            del self._buf[:]
+        self._last_flush = now
+
+    def flush(self):
+        with self._lock:
+            if not self._closed:
+                self._flush_locked(time.monotonic())
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._flush_locked(time.monotonic())
+            self._closed = True
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---- global install ------------------------------------------------------
+_JOURNAL = None
+
+
+def set_journal(j):
+    """Install ``j`` (or None) as the process journal every built-in
+    wiring point emits to. Returns the previous journal."""
+    global _JOURNAL
+    prev = _JOURNAL
+    _JOURNAL = j
+    return prev
+
+
+def get_journal():
+    return _JOURNAL
+
+
+def journal_active():
+    return _JOURNAL is not None
+
+
+@contextlib.contextmanager
+def journal(path, run_id=None, **kwargs):
+    """Open a RunJournal at ``path`` and install it for the block::
+
+        with observability.journal('run.jsonl') as j:
+            trainer.train(...)
+    """
+    j = RunJournal(path, run_id=run_id, **kwargs)
+    prev = set_journal(j)
+    try:
+        yield j
+    finally:
+        set_journal(prev)
+        j.close()
+
+
+def emit(ev, **fields):
+    """Record into the installed journal; a no-op (one None check)
+    when none is installed — safe to call on any hot path."""
+    j = _JOURNAL
+    if j is not None:
+        j.record(ev, **fields)
+
+
+# ---- reading -------------------------------------------------------------
+def read_journal(path):
+    """Parse a journal file -> (records, malformed_line_count). Blank
+    lines are ignored; any other unparsable line counts as malformed
+    (the obs_report smoke gate turns that into a failure)."""
+    records, malformed = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                malformed += 1
+                continue
+            if not isinstance(rec, dict) or 'ev' not in rec:
+                malformed += 1
+                continue
+            records.append(rec)
+    return records, malformed

@@ -1,0 +1,307 @@
+"""Thread-safe metrics registry: counters, gauges, log2-bucket histograms.
+
+The registry is the single host-side numbers surface for the whole
+stack (OBSERVABILITY.md): the Executor publishes compile/cache/run-wall
+series, the Trainer publishes step throughput, the serving runtime
+publishes request/batch counters and latency histograms, and the
+resilience layer publishes checkpoint/anomaly trip counts. Two read
+surfaces, both consistent snapshots:
+
+- ``exposition()`` — Prometheus text format (``# TYPE``/``# HELP``
+  comments, cumulative ``_bucket{le=...}`` histogram series), ready to
+  drop behind any HTTP handler or node-exporter textfile collector.
+- ``snapshot()`` — a plain-JSON dict for programmatic consumers
+  (``tools/obs_report.py``, tests, benchmark gates).
+
+Overhead budget: a counter ``inc()`` is one uncontended lock + an int
+add (sub-microsecond); a histogram ``observe()`` adds a linear scan of
+~24 bucket edges. Metric objects are interned by (name, labels) so hot
+paths hold direct references and never touch the registry dict per
+event. Everything here is stdlib-only — no paddle_tpu imports — so any
+module can depend on it without cycles.
+"""
+import threading
+
+__all__ = ['Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
+           'default_registry', 'DEFAULT_SECONDS_EDGES']
+
+# log2 bucket upper bounds in SECONDS: ~7.6us .. 64s (+inf overflow) —
+# the same constant-relative-resolution philosophy as serving's shape
+# buckets and latency histograms, wide enough for both a sub-ms cache
+# hit dispatch and a multi-second XLA compile.
+DEFAULT_SECONDS_EDGES = tuple(2.0 ** i for i in range(-17, 7))
+
+
+def _fmt_labels(labels, extra=None):
+    items = list(labels)
+    if extra:
+        items += list(extra)
+    if not items:
+        return ''
+    return '{%s}' % ','.join('%s="%s"' % (k, v) for k, v in items)
+
+
+def _fmt_value(v):
+    # Prometheus renders integers bare; avoid '5.0' noise for counters
+    if float(v) == int(v):
+        return '%d' % int(v)
+    return repr(float(v))
+
+
+class Counter(object):
+    """Monotonically increasing value. ``inc`` is the only mutator."""
+
+    __slots__ = ('name', 'labels', '_lock', '_value')
+
+    def __init__(self, name, labels=()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError('counters only go up; use a Gauge')
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0
+
+    def _series(self):
+        return {'labels': dict(self.labels), 'value': self.value}
+
+    def _expose(self):
+        return ['%s%s %s' % (self.name, _fmt_labels(self.labels),
+                             _fmt_value(self.value))]
+
+
+class Gauge(object):
+    """A value that can go up and down (last-write-wins)."""
+
+    __slots__ = ('name', 'labels', '_lock', '_value')
+
+    def __init__(self, name, labels=()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v):
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0.0
+
+    def _series(self):
+        return {'labels': dict(self.labels), 'value': self.value}
+
+    def _expose(self):
+        return ['%s%s %s' % (self.name, _fmt_labels(self.labels),
+                             _fmt_value(self.value))]
+
+
+class Histogram(object):
+    """Log2-bucket histogram. ``observe`` records one sample; buckets
+    are cumulative in the exposition (Prometheus ``le`` semantics)."""
+
+    __slots__ = ('name', 'labels', 'edges', '_lock', '_counts', '_sum',
+                 '_count', '_max')
+
+    def __init__(self, name, labels=(), edges=None):
+        self.name = name
+        self.labels = labels
+        self.edges = tuple(edges) if edges is not None \
+            else DEFAULT_SECONDS_EDGES
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.edges) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._max = 0.0
+
+    def observe(self, v):
+        v = float(v)
+        idx = len(self.edges)
+        for i, edge in enumerate(self.edges):
+            if v <= edge:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q):
+        """Approximate quantile: upper edge of the bucket holding the
+        q-th sample (the observed max for the overflow bucket)."""
+        with self._lock:
+            counts, total, mx = list(self._counts), self._count, self._max
+        if not total:
+            return 0.0
+        target, seen = q * total, 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target and c:
+                return self.edges[i] if i < len(self.edges) else mx
+        return mx
+
+    def _reset(self):
+        with self._lock:
+            self._counts = [0] * (len(self.edges) + 1)
+            self._sum = 0.0
+            self._count = 0
+            self._max = 0.0
+
+    def _series(self):
+        with self._lock:
+            counts = list(self._counts)
+            s, n, mx = self._sum, self._count, self._max
+        buckets, cum = {}, 0
+        for edge, c in zip(self.edges, counts):
+            cum += c
+            buckets[repr(edge)] = cum
+        buckets['+Inf'] = n
+        return {'labels': dict(self.labels), 'count': n, 'sum': s,
+                'max': mx, 'mean': (s / n if n else 0.0),
+                'buckets': buckets}
+
+    def _expose(self):
+        with self._lock:
+            counts = list(self._counts)
+            s, n = self._sum, self._count
+        lines, cum = [], 0
+        for edge, c in zip(self.edges, counts):
+            cum += c
+            lines.append('%s_bucket%s %d' % (
+                self.name,
+                _fmt_labels(self.labels, [('le', repr(edge))]), cum))
+        lines.append('%s_bucket%s %d' % (
+            self.name, _fmt_labels(self.labels, [('le', '+Inf')]), n))
+        lines.append('%s_sum%s %s' % (self.name,
+                                      _fmt_labels(self.labels),
+                                      _fmt_value(s)))
+        lines.append('%s_count%s %d' % (self.name,
+                                        _fmt_labels(self.labels), n))
+        return lines
+
+
+_TYPES = {'counter': Counter, 'gauge': Gauge, 'histogram': Histogram}
+
+
+class MetricsRegistry(object):
+    """Interns metrics by (name, labels); same name must keep one type
+    and one help string. All accessors are thread-safe; hot paths keep
+    the returned metric object and mutate it directly."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}     # (name, labels_tuple) -> metric
+        self._meta = {}        # name -> (kind, help)
+
+    def _get_or_create(self, kind, name, help, labels, **kwargs):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            meta = self._meta.get(name)
+            if meta is not None and meta[0] != kind:
+                raise ValueError(
+                    'metric %r already registered as a %s, requested %s'
+                    % (name, meta[0], kind))
+            m = self._metrics.get(key)
+            if m is None:
+                m = _TYPES[kind](name, labels=key[1], **kwargs)
+                self._metrics[key] = m
+                if meta is None:
+                    self._meta[name] = (kind, help)
+            return m
+
+    def counter(self, name, help='', **labels):
+        return self._get_or_create('counter', name, help, labels)
+
+    def gauge(self, name, help='', **labels):
+        return self._get_or_create('gauge', name, help, labels)
+
+    def histogram(self, name, help='', edges=None, **labels):
+        return self._get_or_create('histogram', name, help, labels,
+                                   edges=edges)
+
+    def get(self, name, **labels):
+        """The existing metric, or None."""
+        with self._lock:
+            return self._metrics.get(
+                (name, tuple(sorted(labels.items()))))
+
+    def reset(self):
+        """Zero every registered series (registrations survive) — for
+        separating benchmark phases without tearing down hot-path
+        metric references."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._reset()
+
+    def snapshot(self):
+        """JSON-ready consistent view:
+        ``{name: {type, help, series: [...]}}``."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+            meta = dict(self._meta)
+        out = {}
+        for (name, _labels), m in items:
+            entry = out.setdefault(name, {
+                'type': meta[name][0], 'help': meta[name][1],
+                'series': []})
+            entry['series'].append(m._series())
+        return out
+
+    def exposition(self):
+        """Prometheus text exposition format (version 0.0.4)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+            meta = dict(self._meta)
+        lines, seen = [], set()
+        for (name, _labels), m in items:
+            if name not in seen:
+                seen.add(name)
+                kind, help = meta[name]
+                if help:
+                    lines.append('# HELP %s %s' % (name, help))
+                lines.append('# TYPE %s %s' % (name, kind))
+            lines.extend(m._expose())
+        return '\n'.join(lines) + ('\n' if lines else '')
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry():
+    """The process-wide registry every built-in wiring point uses."""
+    return _DEFAULT

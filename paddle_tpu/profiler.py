@@ -33,6 +33,10 @@ _timeline = []    # raw (op_type, start_s, dur_s) while profiling
 _TIMELINE_CAP = 200000
 _serving_events = {}        # span name -> [calls, total_s, max_s, min_s]
 _serving_lock = threading.Lock()
+# trace metadata captured at start_profiler so saved profiles are
+# self-describing (run id + wall-clock anchor for the perf_counter
+# timestamps in _timeline)
+_trace_meta = {}
 
 
 def op_profiling_enabled():
@@ -55,11 +59,31 @@ def record_op_event(op_type, seconds, start=None):
 def save_profile(path):
     """Write the raw per-op event stream as JSON for tools/timeline.py
     (parity: the reference saves a profiler proto consumed by
-    tools/timeline.py into a chrome://tracing file)."""
+    tools/timeline.py into a chrome://tracing file).
+
+    The JSON is self-describing: alongside ``events`` it carries the
+    always-on serving span table and a ``meta`` block (run id — the
+    installed journal's when one is active — plus the wall-clock anchor
+    recorded at start_profiler and the save time), so a timeline file
+    can be correlated with its run journal after the fact."""
     import json
+    import uuid
+    from . import observability as _obs
+    j = _obs.get_journal()
+    meta = {'schema': 2,
+            'run_id': j.run_id if j is not None
+            else _trace_meta.get('run_id') or uuid.uuid4().hex[:12],
+            'saved_at': time.time(),
+            'clock': 'perf_counter'}
+    meta.update(_trace_meta)
     with open(path, 'w') as f:
-        json.dump({'events': [[n, s, d] for n, s, d in _timeline]}, f)
+        json.dump({'events': [[n, s, d] for n, s, d in _timeline],
+                   'serving': serving_stats(),
+                   'meta': meta}, f)
     return path
+
+
+_span_hists = {}   # span name -> observability Histogram (interned)
 
 
 def record_serving_event(name, seconds):
@@ -67,7 +91,8 @@ def record_serving_event(name, seconds):
     Always on — serving spans are host-side and cheap, and the serving
     stats surface must work in production without enabling the (slow,
     un-jitted) per-op profiler. Thread-safe: spans land from N serving
-    workers concurrently."""
+    workers concurrently. Each span also publishes into the process
+    metrics registry as ``serving_span_seconds{span=...}``."""
     with _serving_lock:
         ev = _serving_events.get(name)
         if ev is None:
@@ -77,6 +102,15 @@ def record_serving_event(name, seconds):
             ev[1] += seconds
             ev[2] = max(ev[2], seconds)
             ev[3] = min(ev[3], seconds)
+        hist = _span_hists.get(name)
+    if hist is None:
+        from . import observability as _obs
+        hist = _obs.default_registry().histogram(
+            'serving_span_seconds', 'host-side serving span wall times',
+            span=name)
+        with _serving_lock:
+            _span_hists[name] = hist
+    hist.observe(seconds)
 
 
 @contextlib.contextmanager
@@ -108,10 +142,16 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
+    """Zero every host-side table — per-op events, the raw timeline,
+    the serving span table AND the run/trace metadata — so benchmark
+    phases start from a clean slate instead of accumulating across the
+    process lifetime (Executor.reset_cache_info() is the matching knob
+    for the compiled-program cache counters)."""
     _stats['runs'] = 0
     _stats['wall'] = 0.0
     _op_events.clear()
     del _timeline[:]
+    _trace_meta.clear()
     with _serving_lock:
         _serving_events.clear()
 
@@ -121,6 +161,9 @@ def start_profiler(state='All', tracer_option=None,
     global _trace_dir
     import jax
     _op_profiling[0] = True
+    # wall-clock <-> perf_counter anchor for save_profile consumers
+    _trace_meta['started_at_wall'] = time.time()
+    _trace_meta['started_at_perf'] = time.perf_counter()
     os.makedirs(trace_dir, exist_ok=True)
     try:
         jax.profiler.start_trace(trace_dir)

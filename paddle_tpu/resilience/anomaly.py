@@ -19,10 +19,24 @@ import logging
 
 import numpy as np
 
+from .. import observability as _obs
+
 __all__ = ['AnomalyError', 'AnomalyGuard', 'global_norm',
            'executor_guard', 'observe_fetches', 'any_active']
 
 logger = logging.getLogger('paddle_tpu.resilience')
+
+
+def _record_trip(guard, counter_key, kind, where, value=None):
+    """One anomaly detection: bump the guard's local counter, the
+    process registry, and journal the trip (policy included so a
+    post-mortem can tell a logged skip from a rollback)."""
+    guard.anomalies[counter_key] += 1
+    _obs.default_registry().counter(
+        'anomaly_trips_total', 'AnomalyGuard detections',
+        kind=kind).inc()
+    _obs.emit('anomaly', kind=kind, where=where, policy=guard.policy,
+              value=value)
 
 POLICIES = ('raise', 'skip_batch', 'rollback_to_checkpoint')
 
@@ -103,7 +117,8 @@ class AnomalyGuard(object):
             except (TypeError, ValueError):
                 continue
             if bad:
-                self.anomalies['feed_nan'] += 1
+                _record_trip(self, 'feed_nan', 'nan_inf',
+                             'feed:%s' % name)
                 logger.warning('anomaly: non-finite feed %r', name)
                 return AnomalyError('nan_inf', 'feed:%s' % name)
         return None
@@ -115,7 +130,7 @@ class AnomalyGuard(object):
         except (TypeError, ValueError, IndexError):
             return None
         if not np.isfinite(scalar):
-            self.anomalies['loss_nan'] += 1
+            _record_trip(self, 'loss_nan', 'nan_inf', where, scalar)
             logger.warning('anomaly: non-finite %s (%r)', where, scalar)
             return AnomalyError('nan_inf', where, scalar)
         err = self._inspect_spike(self._loss_window, scalar, where)
@@ -124,7 +139,7 @@ class AnomalyGuard(object):
 
     def inspect_grad_norm(self, norm):
         if not np.isfinite(norm):
-            self.anomalies['grad_nan'] += 1
+            _record_trip(self, 'grad_nan', 'nan_inf', 'grad_norm', norm)
             logger.warning('anomaly: non-finite gradient norm')
             return AnomalyError('nan_inf', 'grad_norm', norm)
         err = self._inspect_spike(self._norm_window, norm, 'grad_norm')
@@ -136,7 +151,7 @@ class AnomalyGuard(object):
             return None
         baseline = float(np.median(window))
         if baseline > 0 and abs(scalar) > self.spike_factor * baseline:
-            self.anomalies['spike'] += 1
+            _record_trip(self, 'spike', 'spike', where, scalar)
             logger.warning('anomaly: %s spike %.4g (median %.4g x%.1f)',
                            where, scalar, baseline, self.spike_factor)
             return AnomalyError('spike', where, scalar)
@@ -157,7 +172,8 @@ class AnomalyGuard(object):
             except (TypeError, ValueError):
                 continue
             if bad:
-                self.anomalies['fetch_nan'] += 1
+                _record_trip(self, 'fetch_nan', 'nan_inf',
+                             'fetch:%s' % name)
                 logger.warning('anomaly: non-finite fetch %r', name)
                 if self.policy == 'raise':
                     raise AnomalyError('nan_inf', 'fetch:%s' % name)

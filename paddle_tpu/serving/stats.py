@@ -10,6 +10,8 @@ relative resolution.
 """
 import threading
 
+from .. import observability as _obs
+
 __all__ = ['LatencyHistogram', 'ServingStats']
 
 # histogram bucket upper bounds in milliseconds: 0.25ms .. 32768ms + inf
@@ -78,27 +80,65 @@ class ServingStats(object):
         self.bucket_counts = {}    # bucket size -> batches launched
         self.request_latency = LatencyHistogram()  # submit -> result set
         self.batch_latency = LatencyHistogram()    # one executor run
+        # process registry mirrors (OBSERVABILITY.md): the per-server
+        # counters above stay the exact per-ModelServer surface; these
+        # aggregate across every server in the process.
+        reg = _obs.default_registry()
+        self._m = {
+            'submitted': reg.counter('serving_requests_submitted_total',
+                                     'requests admitted to a queue'),
+            'completed': reg.counter('serving_requests_completed_total',
+                                     'requests answered'),
+            'shed': reg.counter('serving_requests_shed_total',
+                                'requests rejected at admission'),
+            'expired': reg.counter('serving_requests_expired_total',
+                                   'requests whose deadline passed'),
+            'failed': reg.counter('serving_requests_failed_total',
+                                  'requests failed after retries'),
+            'retries': reg.counter('serving_retries_total',
+                                   'transient batch-run retries'),
+            'batches': reg.counter('serving_batches_total',
+                                   'device batches launched'),
+            'rows': reg.counter('serving_batch_rows_total',
+                                'real rows carried by batches'),
+            'padded': reg.counter('serving_padded_rows_total',
+                                  'pad rows added by bucketing'),
+            'request_lat': reg.histogram('serving_request_seconds',
+                                         'submit -> result latency'),
+            'batch_lat': reg.histogram('serving_batch_seconds',
+                                       'one batched executor run'),
+        }
 
     # ---- recording (worker/client threads) -------------------------------
     def record_submitted(self, n=1):
         with self._lock:
             self.submitted += n
+        self._m['submitted'].inc(n)
+        _obs.emit('serving_admit', n=n)
 
     def record_shed(self, n=1):
         with self._lock:
             self.shed += n
+        self._m['shed'].inc(n)
+        _obs.emit('serving_shed', n=n)
 
     def record_expired(self, n=1):
         with self._lock:
             self.expired += n
+        self._m['expired'].inc(n)
+        _obs.emit('serving_expired', n=n)
 
     def record_failed(self, n=1):
         with self._lock:
             self.failed += n
+        self._m['failed'].inc(n)
+        _obs.emit('serving_failed', n=n)
 
     def record_retry(self, n=1):
         with self._lock:
             self.retries += n
+        self._m['retries'].inc(n)
+        _obs.emit('serving_retry', n=n)
 
     def record_batch(self, rows, bucket, seconds):
         with self._lock:
@@ -108,12 +148,20 @@ class ServingStats(object):
             self.bucket_counts[bucket] = \
                 self.bucket_counts.get(bucket, 0) + 1
             self.batch_latency.record(seconds)
+        self._m['batches'].inc()
+        self._m['rows'].inc(rows)
+        self._m['padded'].inc(bucket - rows)
+        self._m['batch_lat'].observe(seconds)
+        _obs.emit('serving_batch', rows=rows, bucket=bucket,
+                  dur_s=round(seconds, 6))
 
     def record_completed(self, latency_seconds, n=1):
         with self._lock:
             self.completed += n
             for _ in range(n):
                 self.request_latency.record(latency_seconds)
+        self._m['completed'].inc(n)
+        self._m['request_lat'].observe(latency_seconds)
 
     # ---- snapshots -------------------------------------------------------
     def occupancy(self):

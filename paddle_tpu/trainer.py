@@ -19,11 +19,13 @@ policy: ``raise`` / ``skip_batch`` / ``rollback_to_checkpoint``.
 import contextlib
 import logging
 import os
+import time
 
 import numpy as np
 
 from . import framework
 from . import executor
+from . import observability as _obs
 from . import io
 from . import optimizer as opt_module
 from . import data_feeder
@@ -301,8 +303,33 @@ class Trainer(object):
             grad_names = self._grad_fetch_names()
         reload_exe = executor.Executor(self.place)
         start_epoch, resume_step, global_step = self._maybe_resume(cfg)
+        # telemetry (OBSERVABILITY.md): per-step metrics into the
+        # process registry + typed records into the installed journal
+        reg = _obs.default_registry()
+        m_steps = reg.counter('trainer_steps_total',
+                              'optimizer steps completed')
+        m_examples = reg.counter('trainer_examples_total',
+                                 'training examples consumed')
+        m_step_wall = reg.histogram('trainer_step_seconds',
+                                    'one training step wall time')
+        m_steps_ps = reg.gauge('trainer_steps_per_second',
+                               'steps/s over the current train() call')
+        m_examples_ps = reg.gauge(
+            'trainer_examples_per_second',
+            'examples/s over the current train() call')
+        m_ttfs = reg.gauge(
+            'trainer_time_to_first_step_seconds',
+            'train() entry to first completed step (compile included)')
+        m_loss = reg.gauge('trainer_last_loss', 'last fetched loss')
+        loop_t0 = time.monotonic()
+        steps_done = examples_done = 0
+        _obs.emit('train_begin', epochs=num_epochs,
+                  start_epoch=start_epoch, global_step=global_step)
         for epoch_id in range(start_epoch, num_epochs):
             event_handler(BeginEpochEvent(epoch_id))
+            _obs.emit('epoch_begin', epoch=epoch_id)
+            epoch_t0 = time.monotonic()
+            epoch_steps0 = steps_done
             for step_id, data in enumerate(reader()):
                 if self.__stop:
                     return
@@ -310,6 +337,9 @@ class Trainer(object):
                     continue  # completed before the restart
                 begin = BeginStepEvent(epoch_id, step_id)
                 event_handler(begin)
+                _obs.emit('step_begin', epoch=epoch_id, step=step_id,
+                          global_step=global_step)
+                step_t0 = time.monotonic()
                 feed = feeder.feed(data)
                 if guard is not None and guard.check_feeds:
                     err = guard.inspect_feed(feed)
@@ -319,6 +349,9 @@ class Trainer(object):
                         # clean; the event stream still advances so
                         # step counts match an un-poisoned run
                         global_step += 1
+                        _obs.emit('step_end', epoch=epoch_id,
+                                  step=step_id, global_step=global_step,
+                                  skipped='anomaly')
                         event_handler(EndStepEvent(epoch_id, step_id,
                                                    None))
                         continue
@@ -330,26 +363,63 @@ class Trainer(object):
                 else:
                     outs = exe.run(feed=feed, fetch_list=run_fetches)
                 metrics = outs[:len(fetch_names)] if want_fetch else outs
+                grad_norm = None
                 if guard is not None and want_fetch:
                     err = None
                     if guard.check_metrics and metrics:
                         err = guard.inspect_loss(metrics[0])
                     if err is None and grad_names:
-                        norm = _anomaly.global_norm(
+                        grad_norm = _anomaly.global_norm(
                             outs[len(fetch_names):])
-                        err = guard.inspect_grad_norm(norm)
+                        err = guard.inspect_grad_norm(grad_norm)
                     if err is not None:
                         # post-step detection: the update already ran,
                         # so 'skip_batch' can only log; 'rollback'
                         # restores the last good params; 'raise' stops
                         self._handle_anomaly(err, reload_exe)
                 global_step += 1
+                step_wall = time.monotonic() - step_t0
+                steps_done += 1
+                try:
+                    examples = len(data)
+                except TypeError:
+                    examples = 0
+                examples_done += examples
+                elapsed = time.monotonic() - loop_t0
+                m_steps.inc()
+                m_examples.inc(examples)
+                m_step_wall.observe(step_wall)
+                if elapsed > 0:
+                    m_steps_ps.set(steps_done / elapsed)
+                    m_examples_ps.set(examples_done / elapsed)
+                if steps_done == 1:
+                    m_ttfs.set(elapsed)
+                loss = _scalar_or_none(metrics[0]) if metrics else None
+                if loss is not None:
+                    m_loss.set(loss)
+                if _obs.journal_active():
+                    rec = {'epoch': epoch_id, 'step': step_id,
+                           'global_step': global_step,
+                           'dur_s': round(step_wall, 6),
+                           'examples': examples,
+                           'examples_per_s': round(
+                               examples_done / elapsed, 3)
+                           if elapsed > 0 else 0.0}
+                    if loss is not None:
+                        rec['loss'] = loss
+                    if grad_norm is not None:
+                        rec['grad_norm'] = grad_norm
+                    _obs.emit('step_end', **rec)
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 if cfg is not None and \
                         global_step % cfg.step_interval == 0:
                     self._save_progress_checkpoint(cfg, epoch_id,
                                                    step_id, global_step)
             event_handler(EndEpochEvent(epoch_id))
+            epoch_wall = time.monotonic() - epoch_t0
+            _obs.emit('epoch_end', epoch=epoch_id,
+                      steps=steps_done - epoch_steps0,
+                      dur_s=round(epoch_wall, 6))
             if cfg is not None and \
                     (epoch_id + 1) % cfg.epoch_interval == 0:
                 # recorded as "epoch_id+1, nothing done yet": a resume
@@ -385,6 +455,16 @@ class Trainer(object):
                 use_cuda=False, main_program=self.train_program,
                 loss_name=self.train_func_outputs[0].name)
         return self._get_parallel_executor()
+
+
+def _scalar_or_none(value):
+    """First element of a fetched metric as a plain float, or None for
+    non-numeric/empty fetches (journal fields must stay JSON-clean)."""
+    try:
+        v = float(np.asarray(value).ravel()[0])
+    except (TypeError, ValueError, IndexError):
+        return None
+    return v
 
 
 def build_feed_var_list(program, feed_order):

@@ -45,3 +45,7 @@ def pytest_configure(config):
         'markers',
         'serving: tests of the paddle_tpu.serving runtime (tier-1, '
         'CPU-safe; filter with -m "not serving")')
+    config.addinivalue_line(
+        'markers',
+        'observability: tests of the metrics registry / run journal / '
+        'telemetry tools (tier-1; filter with -m "not observability")')

@@ -1,0 +1,141 @@
+"""tools/timeline.py: the multi-trainer profile spec and the
+observability journal-merge track (chrome://tracing / catapult
+trace-event output). Complements test_profiler.py's live
+profiler->timeline roundtrip with format-level coverage over synthetic
+inputs."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.observability
+
+TOOL = os.path.join(os.path.dirname(__file__), '..', 'tools',
+                    'timeline.py')
+
+
+def _write_profile(path, events):
+    with open(path, 'w') as f:
+        json.dump({'events': events}, f)
+
+
+def _write_journal(path, records):
+    with open(path, 'w') as f:
+        for r in records:
+            f.write(json.dumps(r) + '\n')
+
+
+def _run(args):
+    subprocess.run([sys.executable, TOOL] + args, check=True)
+
+
+def _assert_catapult(trace):
+    """Every event is a valid catapult trace event."""
+    assert isinstance(trace['traceEvents'], list)
+    for e in trace['traceEvents']:
+        assert {'ph', 'pid', 'tid', 'name'} <= set(e)
+        if e['ph'] == 'X':
+            assert isinstance(e['ts'], int) and isinstance(e['dur'], int)
+            assert e['dur'] >= 0 and e['ts'] >= 0
+        elif e['ph'] == 'i':
+            assert isinstance(e['ts'], int) and e['s'] == 't'
+        else:
+            assert e['ph'] == 'M'
+
+
+def test_multi_trainer_spec(tmp_path):
+    """name1=file1,name2=file2 -> one pid track per trainer, events
+    rebased to each file's first start."""
+    p1 = str(tmp_path / 'p1.json')
+    p2 = str(tmp_path / 'p2.json')
+    _write_profile(p1, [['mul', 10.0, 0.002], ['relu', 10.002, 0.001]])
+    _write_profile(p2, [['softmax', 20.0, 0.004]])
+    out = str(tmp_path / 'tl.json')
+    _run(['--profile_path', 'a=%s,b=%s' % (p1, p2),
+          '--timeline_path', out])
+    trace = json.load(open(out))
+    _assert_catapult(trace)
+    evs = [e for e in trace['traceEvents'] if e['ph'] == 'X']
+    names = {e['name'] for e in evs}
+    assert names == {'mul', 'relu', 'softmax'}
+    assert {e['pid'] for e in evs} == {0, 1}
+    # per-track rebase: each track's first event starts at ts 0
+    by_pid = {}
+    for e in evs:
+        by_pid.setdefault(e['pid'], []).append(e['ts'])
+    assert all(min(ts) == 0 for ts in by_pid.values())
+    # process_name metadata names both trainers
+    procs = {e['args']['name'] for e in trace['traceEvents']
+             if e['ph'] == 'M' and e['name'] == 'process_name'}
+    assert procs == {'a(op kernels)', 'b(op kernels)'}
+
+
+def test_journal_merge_track(tmp_path):
+    """--journal_path merges journal spans (dur_s -> X slices) and
+    instants onto a separate pid track alongside the op-kernel track."""
+    prof = str(tmp_path / 'p.json')
+    _write_profile(prof, [['mul', 5.0, 0.003]])
+    journal = str(tmp_path / 'run.jsonl')
+    _write_journal(journal, [
+        {'ev': 'run_begin', 'run': 'r1', 't': 0.0, 'wall': 1.0,
+         'schema': 1},
+        {'ev': 'step_end', 'run': 'r1', 't': 0.5, 'dur_s': 0.4,
+         'loss': 1.25, 'step': 0},
+        {'ev': 'compile_end', 'run': 'r1', 't': 0.09, 'dur_s': 0.09,
+         'fp': 'abc'},
+        {'ev': 'serving_batch', 'run': 'r1', 't': 0.7, 'dur_s': 0.01,
+         'rows': 3, 'bucket': 4},
+        {'ev': 'anomaly', 'run': 'r1', 't': 0.8, 'kind': 'nan_inf',
+         'where': 'loss'},
+    ])
+    out = str(tmp_path / 'tl.json')
+    _run(['--profile_path', prof, '--journal_path', journal,
+          '--timeline_path', out])
+    trace = json.load(open(out))
+    _assert_catapult(trace)
+    evs = trace['traceEvents']
+    op_track = {e['pid'] for e in evs
+                if e['ph'] == 'X' and e['cat'] == 'Op'}
+    j_track = {e['pid'] for e in evs
+               if e.get('cat') == 'journal'}
+    assert op_track == {0} and j_track == {1}   # separate tracks
+    spans = {e['name']: e for e in evs
+             if e['ph'] == 'X' and e.get('cat') == 'journal'}
+    assert set(spans) == {'step_end', 'compile_end', 'serving_batch'}
+    # span [ts, ts+dur] is anchored to END at t (records are written
+    # when the block closes): step_end at t=0.5s dur=0.4s -> ts=100ms
+    assert spans['step_end']['ts'] == 100000
+    assert spans['step_end']['dur'] == 400000
+    assert spans['step_end']['args']['loss'] == 1.25
+    instants = [e for e in evs if e['ph'] == 'i']
+    assert len(instants) == 1 and instants[0]['name'] == 'anomaly'
+    # run_begin is metadata, never an event
+    assert all(e['name'] != 'run_begin' for e in evs if e['ph'] != 'M')
+    # event types get their own named rows
+    rows = {e['args']['name'] for e in evs
+            if e['ph'] == 'M' and e['name'] == 'thread_name'}
+    assert {'step_end', 'compile_end', 'anomaly',
+            'serving_batch'} <= rows
+    # journal process row is labeled with the run id
+    assert any(e['ph'] == 'M' and e['name'] == 'process_name' and
+               'r1' in e['args']['name'] for e in evs)
+
+
+def test_journal_only_and_malformed_lines(tmp_path):
+    """A journal alone is a valid input; malformed lines are skipped
+    (the smoke gate, not the viewer, polices them)."""
+    journal = str(tmp_path / 'run.jsonl')
+    with open(journal, 'w') as f:
+        f.write('{"ev":"run_begin","run":"r2","t":0.0}\n')
+        f.write('NOT JSON\n')
+        f.write('{"ev":"exe_run","run":"r2","t":0.2,"dur_s":0.1,'
+                '"cache":"hit"}\n')
+    out = str(tmp_path / 'tl.json')
+    _run(['--journal_path', journal, '--timeline_path', out])
+    trace = json.load(open(out))
+    _assert_catapult(trace)
+    evs = [e for e in trace['traceEvents'] if e['ph'] == 'X']
+    assert len(evs) == 1 and evs[0]['name'] == 'exe_run'
+    assert evs[0]['args']['cache'] == 'hit'

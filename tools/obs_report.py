@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Render a paddle_tpu observability run journal into a human report.
+
+The input is the JSONL file written by
+``paddle_tpu.observability.RunJournal`` (schema: OBSERVABILITY.md).
+Standalone on purpose — only stdlib imports, so it runs anywhere the
+journal file landed, with no jax/paddle_tpu install.
+
+    python tools/obs_report.py run.jsonl            # human report
+    python tools/obs_report.py run.jsonl --top 20   # more slow spans
+    python tools/obs_report.py run.jsonl --json -   # summary as JSON
+    python tools/obs_report.py run.jsonl --smoke    # CI gate
+
+``--smoke`` exits nonzero when the journal is empty, contains malformed
+lines, or lacks the required records (``--require step`` by default —
+a training journal must hold step records; ``--require serving`` for a
+serving soak; ``--require any`` for presence only).
+``tools/serve_bench.py --smoke`` runs this gate over the journal its
+load run writes.
+"""
+import argparse
+import json
+import sys
+
+REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
+               'any': None}
+
+
+def load_journal(path):
+    """(records, malformed_line_count) — same contract as
+    ``observability.read_journal`` without importing paddle_tpu."""
+    records, malformed = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                malformed += 1
+                continue
+            if not isinstance(rec, dict) or 'ev' not in rec:
+                malformed += 1
+                continue
+            records.append(rec)
+    return records, malformed
+
+
+def _mean(xs):
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+def summarize(records, malformed=0):
+    """Aggregate a record list into a JSON-ready summary dict."""
+    by_ev = {}
+    for r in records:
+        by_ev.setdefault(r['ev'], []).append(r)
+    header = (by_ev.get('run_begin') or [{}])[0]
+    steps = [r for r in by_ev.get('step_end', ())
+             if 'skipped' not in r]
+    step_walls = [r['dur_s'] for r in steps if 'dur_s' in r]
+    losses = [r['loss'] for r in steps if 'loss' in r]
+    compiles = by_ev.get('compile_end', [])
+    exe_runs = by_ev.get('exe_run', [])
+    batches = by_ev.get('serving_batch', [])
+    spans = sorted((r for r in records if 'dur_s' in r),
+                   key=lambda r: -r['dur_s'])
+    duration = max((r.get('t', 0.0) for r in records), default=0.0)
+    summary = {
+        'run_id': header.get('run') or (records[0].get('run')
+                                        if records else None),
+        'started_wall': header.get('wall'),
+        'schema': header.get('schema'),
+        'duration_s': duration,
+        'malformed_lines': malformed,
+        'event_counts': {ev: len(rs) for ev, rs in sorted(by_ev.items())},
+        'steps': {
+            'count': len(steps),
+            'skipped': len(by_ev.get('step_end', ())) - len(steps),
+            'examples': sum(r.get('examples', 0) for r in steps),
+            'mean_step_s': _mean(step_walls),
+            'max_step_s': max(step_walls) if step_walls else 0.0,
+            'steps_per_s': len(steps) / duration if duration else 0.0,
+            'examples_per_s': (sum(r.get('examples', 0) for r in steps)
+                               / duration if duration else 0.0),
+            'first_loss': losses[0] if losses else None,
+            'last_loss': losses[-1] if losses else None,
+        },
+        'compiles': {
+            'count': len(compiles),
+            'total_s': sum(r.get('dur_s', 0.0) for r in compiles),
+            'max_s': max((r.get('dur_s', 0.0) for r in compiles),
+                         default=0.0),
+        },
+        'executor': {
+            'runs': len(exe_runs),
+            'cache_hits': sum(1 for r in exe_runs
+                              if r.get('cache') == 'hit'),
+            'cache_misses': sum(1 for r in exe_runs
+                                if r.get('cache') == 'miss'),
+        },
+        'serving': {
+            'batches': len(batches),
+            'rows': sum(r.get('rows', 0) for r in batches),
+            'padded_rows': sum(r.get('bucket', 0) - r.get('rows', 0)
+                               for r in batches),
+            'admitted': sum(r.get('n', 1)
+                            for r in by_ev.get('serving_admit', ())),
+            'shed': sum(r.get('n', 1)
+                        for r in by_ev.get('serving_shed', ())),
+            'retries': sum(r.get('n', 1)
+                           for r in by_ev.get('serving_retry', ())),
+        },
+        'checkpoints': {
+            'saves': len(by_ev.get('checkpoint_save', ())),
+            'loads': len(by_ev.get('checkpoint_load', ())),
+            'fallbacks': len(by_ev.get('checkpoint_fallback', ())),
+        },
+        'anomalies': len(by_ev.get('anomaly', ())),
+        'slowest_spans': [
+            {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
+             'detail': {k: v for k, v in r.items()
+                        if k not in ('ev', 'run', 't', 'dur_s')}}
+            for r in spans],
+    }
+    return summary
+
+
+def render(summary, top=10):
+    s = summary
+    lines = [
+        '----------------->   Run Journal Report   <-----------------',
+        'run %s  (%.2fs journalled, schema %s)'
+        % (s['run_id'], s['duration_s'], s['schema']),
+    ]
+    if s['malformed_lines']:
+        lines.append('!! %d malformed line(s)' % s['malformed_lines'])
+    st = s['steps']
+    if st['count']:
+        lines.append(
+            'training: %d steps (%d skipped), %d examples | %.1f '
+            'steps/s, %.1f examples/s | step mean %.1fms max %.1fms'
+            % (st['count'], st['skipped'], st['examples'],
+               st['steps_per_s'], st['examples_per_s'],
+               st['mean_step_s'] * 1e3, st['max_step_s'] * 1e3))
+        if st['first_loss'] is not None:
+            lines.append('loss:     %.6g -> %.6g'
+                         % (st['first_loss'], st['last_loss']))
+    ex = s['executor']
+    if ex['runs']:
+        lookups = ex['cache_hits'] + ex['cache_misses']
+        lines.append(
+            'executor: %d runs | cache %d hits / %d misses (%.1f%% hit '
+            'rate)' % (ex['runs'], ex['cache_hits'], ex['cache_misses'],
+                       100.0 * ex['cache_hits'] / lookups
+                       if lookups else 0.0))
+    c = s['compiles']
+    if c['count']:
+        lines.append('compiles: %d, %.2fs total (max %.2fs)'
+                     % (c['count'], c['total_s'], c['max_s']))
+    sv = s['serving']
+    if sv['batches'] or sv['admitted'] or sv['shed']:
+        lines.append(
+            'serving:  %d admitted, %d shed, %d retries | %d batches, '
+            '%d rows (+%d pad)'
+            % (sv['admitted'], sv['shed'], sv['retries'], sv['batches'],
+               sv['rows'], sv['padded_rows']))
+    ck = s['checkpoints']
+    if ck['saves'] or ck['loads'] or ck['fallbacks']:
+        lines.append('ckpts:    %d saves, %d loads, %d corruption '
+                     'fallbacks' % (ck['saves'], ck['loads'],
+                                    ck['fallbacks']))
+    if s['anomalies']:
+        lines.append('anomaly:  %d guard trips' % s['anomalies'])
+    lines.append('events:   %s' % ', '.join(
+        '%s=%d' % kv for kv in sorted(s['event_counts'].items())))
+    if s['slowest_spans']:
+        lines.append('top %d slowest spans:' % min(
+            top, len(s['slowest_spans'])))
+        for r in s['slowest_spans'][:top]:
+            detail = ' '.join('%s=%s' % kv
+                              for kv in sorted(r['detail'].items()))
+            lines.append('  %10.3fms  t=%-10.3f %-16s %s'
+                         % (r['dur_s'] * 1e3, r.get('t') or 0.0,
+                            r['ev'], detail))
+    return '\n'.join(lines)
+
+
+def check_journal(path, require='step'):
+    """Smoke validation -> list of problems (empty == healthy)."""
+    if require not in REQUIRED_EV:
+        raise ValueError('require must be one of %s'
+                         % sorted(REQUIRED_EV))
+    try:
+        records, malformed = load_journal(path)
+    except OSError as e:
+        return ['journal unreadable: %r' % (e,)]
+    problems = []
+    if malformed:
+        problems.append('%d malformed journal line(s)' % malformed)
+    if not records:
+        problems.append('journal contains no records')
+        return problems
+    if records[0].get('ev') != 'run_begin':
+        problems.append('journal does not start with run_begin')
+    need = REQUIRED_EV[require]
+    if need is not None:
+        n = sum(1 for r in records
+                if r['ev'] == need and 'skipped' not in r)
+        if n == 0:
+            problems.append('journal contains zero %s records' % need)
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('journal', help='path to a RunJournal .jsonl file')
+    ap.add_argument('--top', type=int, default=10,
+                    help='slowest spans to list')
+    ap.add_argument('--json', default=None, metavar='PATH',
+                    help="write the summary dict as JSON ('-' = stdout)")
+    ap.add_argument('--smoke', action='store_true',
+                    help='validate instead of report; nonzero exit on '
+                         'an empty/malformed/step-less journal')
+    ap.add_argument('--require', default='step',
+                    choices=sorted(REQUIRED_EV),
+                    help='record type --smoke insists on (default: step)')
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        problems = check_journal(args.journal, require=args.require)
+        if problems:
+            print('JOURNAL SMOKE FAILED (%s):' % args.journal,
+                  file=sys.stderr)
+            for p in problems:
+                print('  - %s' % p, file=sys.stderr)
+            return 1
+        print('journal smoke OK (%s)' % args.journal)
+        return 0
+
+    records, malformed = load_journal(args.journal)
+    summary = summarize(records, malformed)
+    if args.json == '-':
+        json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        if args.json:
+            with open(args.json, 'w') as f:
+                json.dump(summary, f, indent=2, sort_keys=True)
+        print(render(summary, top=args.top))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

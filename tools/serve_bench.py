@@ -92,15 +92,24 @@ def _reference_runners(dirs):
 
 
 def run_load(n_models=1, n_threads=8, requests_per_thread=25,
-             max_batch=16, batch_timeout=0.002, verify=False, seed=0):
-    """Returns the result dict (throughput, latency, serving stats)."""
+             max_batch=16, batch_timeout=0.002, verify=False, seed=0,
+             journal_path=None):
+    """Returns the result dict (throughput, latency, serving stats).
+    ``journal_path`` installs an observability RunJournal over the
+    serving section, so the run leaves a JSONL artifact that
+    ``tools/obs_report.py`` can render/validate."""
+    import contextlib
     import paddle_tpu.fluid as fluid
+    from paddle_tpu import observability
     from paddle_tpu.serving import ModelServer
     results = {}
     with tempfile.TemporaryDirectory(prefix='serve_bench_') as workdir:
         dirs = _build_artifacts(workdir, n_models)
         oracles = _reference_runners(dirs) if verify else None
-        with ModelServer(place=fluid.CPUPlace(), max_batch_size=max_batch,
+        jctx = observability.journal(journal_path) if journal_path \
+            else contextlib.nullcontext()
+        with jctx, \
+             ModelServer(place=fluid.CPUPlace(), max_batch_size=max_batch,
                          max_queue_depth=n_threads * requests_per_thread,
                          batch_timeout=batch_timeout) as srv:
             for name, d in dirs.items():
@@ -209,19 +218,34 @@ def main(argv=None):
     ap.add_argument('--update-baseline', action='store_true')
     ap.add_argument('--json', default=None,
                     help='write the full result dict to this path')
+    ap.add_argument('--journal', default=None, metavar='PATH',
+                    help='write an observability run journal (JSONL) '
+                         'covering the serving run; --smoke validates '
+                         'it via tools/obs_report.py')
     args = ap.parse_args(argv)
     _force_cpu()
+
+    journal_path = args.journal
+    if args.smoke and journal_path is None:
+        # the smoke gate always exercises the journal path end to end
+        fd, journal_path = tempfile.mkstemp(prefix='serve_bench_',
+                                            suffix='.jsonl')
+        os.close(fd)
 
     if args.smoke:
         results = run_load(n_models=2, n_threads=4,
                            requests_per_thread=6, max_batch=8,
-                           verify=True, seed=1)
+                           verify=True, seed=1,
+                           journal_path=journal_path)
     else:
         results = run_load(n_models=args.models, n_threads=args.threads,
                            requests_per_thread=args.requests,
                            max_batch=args.max_batch,
                            batch_timeout=args.batch_timeout,
-                           verify=args.verify)
+                           verify=args.verify,
+                           journal_path=journal_path)
+    if journal_path:
+        print('journal written to %s' % journal_path)
 
     if args.json:
         payload = dict(results)
@@ -251,6 +275,10 @@ def main(argv=None):
     with open(args.baseline) as f:
         baseline = json.load(f)
     problems = check_smoke(results, baseline)
+    if journal_path:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from obs_report import check_journal
+        problems += check_journal(journal_path, require='serving')
     if problems:
         print('SMOKE REGRESSION:', file=sys.stderr)
         for p in problems:

@@ -8,6 +8,17 @@ is the JSON event stream written by
 seconds) instead of the reference's profiler protobuf; the output is
 the same catapult trace-event format, loadable in chrome://tracing or
 https://ui.perfetto.dev.
+
+``--journal_path`` additionally merges an observability run journal
+(``paddle_tpu.observability.RunJournal`` JSONL) into the same trace on
+its own process track: records carrying ``dur_s`` (steps, XLA
+compiles, serving batches, executor runs) become duration slices
+grouped into one named row per event type, and instantaneous records
+(checkpoints, anomalies, shed requests) become instant events — so ONE
+artifact shows op kernels, compiles, and serving batches together.
+Journal timestamps are monotonic seconds from the journal's own
+``run_begin``; profile timestamps are rebased to their first event, so
+tracks share an origin but are only loosely aligned across clocks.
 """
 import argparse
 import json
@@ -23,12 +34,24 @@ class ChromeTraceFormatter(object):
             'ph': 'M', 'pid': pid, 'tid': 0,
             'name': 'process_name', 'args': {'name': name}})
 
+    def emit_tid(self, name, pid, tid):
+        self._metadata.append({
+            'ph': 'M', 'pid': pid, 'tid': tid,
+            'name': 'thread_name', 'args': {'name': name}})
+
     def emit_region(self, timestamp_us, duration_us, pid, tid, category,
                     name, args):
         self._events.append({
             'ph': 'X', 'cat': category, 'name': name, 'pid': pid,
             'tid': tid, 'ts': int(timestamp_us),
             'dur': int(duration_us), 'args': args})
+
+    def emit_instant(self, timestamp_us, pid, tid, category, name,
+                     args):
+        self._events.append({
+            'ph': 'i', 's': 't', 'cat': category, 'name': name,
+            'pid': pid, 'tid': tid, 'ts': int(timestamp_us),
+            'args': args})
 
     def format_to_string(self, pretty=False):
         trace = {'traceEvents': self._metadata + self._events}
@@ -50,8 +73,27 @@ def _load_profiles(profile_path):
     return out
 
 
-def build_timeline(profiles):
+def _load_journal(journal_path):
+    """Parsed journal records (malformed lines skipped — the smoke gate
+    in tools/obs_report.py is where malformedness fails a run)."""
+    records = []
+    with open(journal_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and 'ev' in rec:
+                records.append(rec)
+    return records
+
+
+def build_timeline(profiles, journal=None):
     tracer = ChromeTraceFormatter()
+    pid = 0
     for pid, (name, events) in enumerate(sorted(profiles.items())):
         tracer.emit_pid('%s(op kernels)' % name, pid)
         if not events:
@@ -60,6 +102,32 @@ def build_timeline(profiles):
         for op, start, dur in events:
             tracer.emit_region((start - base) * 1e6, dur * 1e6, pid, 0,
                                'Op', op, {'name': op})
+    if journal:
+        jpid = len(profiles)
+        run_id = next((r.get('run') for r in journal if r.get('run')),
+                      '?')
+        tracer.emit_pid('journal(run %s)' % run_id, jpid)
+        tids = {}
+        for rec in journal:
+            ev = rec['ev']
+            if ev == 'run_begin':
+                continue
+            tid = tids.get(ev)
+            if tid is None:
+                tid = tids[ev] = len(tids)
+                tracer.emit_tid(ev, jpid, tid)
+            args = {k: v for k, v in rec.items()
+                    if k not in ('ev', 'run')}
+            ts_us = rec.get('t', 0.0) * 1e6
+            if 'dur_s' in rec:
+                dur_us = rec['dur_s'] * 1e6
+                # 't' is the END of a span (records are written when
+                # the block closes); slice back to its start
+                tracer.emit_region(max(ts_us - dur_us, 0.0), dur_us,
+                                   jpid, tid, 'journal', ev, args)
+            else:
+                tracer.emit_instant(ts_us, jpid, tid, 'journal', ev,
+                                    args)
     return tracer
 
 
@@ -69,10 +137,20 @@ def main():
         '--profile_path', type=str, default='',
         help='Input profile file name. If there are multiple files, the '
              'format should be trainer1=file1,trainer2=file2,ps=file3')
+    parser.add_argument(
+        '--journal_path', type=str, default='',
+        help='Optional observability run journal (.jsonl) merged into '
+             'the trace on its own track.')
     parser.add_argument('--timeline_path', type=str, default='',
                         help='Output timeline file name.')
     args = parser.parse_args()
-    tracer = build_timeline(_load_profiles(args.profile_path))
+    profiles = _load_profiles(args.profile_path) if args.profile_path \
+        else {}
+    journal = _load_journal(args.journal_path) if args.journal_path \
+        else None
+    if not profiles and not journal:
+        parser.error('need --profile_path and/or --journal_path')
+    tracer = build_timeline(profiles, journal=journal)
     with open(args.timeline_path, 'w') as f:
         f.write(tracer.format_to_string())
     print('timeline written to %s' % args.timeline_path)
