@@ -18,6 +18,10 @@ Event schema (OBSERVABILITY.md has the full field tables):
 ``checkpoint_save`` / ``checkpoint_load`` / ``checkpoint_fallback``
 ``serving_admit`` / ``serving_shed`` / ``serving_expired`` / ``serving_retry``
 ``serving_batch``  rows, bucket, dur_s
+``serving_breaker``  model, to (closed|half_open|open), reason
+``serving_breaker_rejected`` / ``serving_cancelled``  guardrail sheds
+``serving_watchdog_trip``  model, stage, failed, overrun_s
+``serving_drain`` / ``serving_swap`` / ``serving_abandoned_worker``
 ``anomaly``        kind, where, policy (AnomalyGuard trips)
 =================  =====================================================
 

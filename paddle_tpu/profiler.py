@@ -87,12 +87,15 @@ _span_hists = {}   # span name -> observability Histogram (interned)
 
 
 def record_serving_event(name, seconds):
-    """Record one serving-layer span (queue wait, pad, batch run, ...).
-    Always on — serving spans are host-side and cheap, and the serving
-    stats surface must work in production without enabling the (slow,
-    un-jitted) per-op profiler. Thread-safe: spans land from N serving
-    workers concurrently. Each span also publishes into the process
-    metrics registry as ``serving_span_seconds{span=...}``."""
+    """Record one serving-layer span (``serving/pad``,
+    ``serving/batch_run``, ``serving/warmup``,
+    ``serving/exact_fallback``, ``serving/request``, and the guardrail
+    ops ``serving/drain`` / ``serving/swap``). Always on — serving
+    spans are host-side and cheap, and the serving stats surface must
+    work in production without enabling the (slow, un-jitted) per-op
+    profiler. Thread-safe: spans land from N serving workers
+    concurrently. Each span also publishes into the process metrics
+    registry as ``serving_span_seconds{span=...}``."""
     with _serving_lock:
         ev = _serving_events.get(name)
         if ev is None:

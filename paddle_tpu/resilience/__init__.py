@@ -33,7 +33,9 @@ from .anomaly import (AnomalyError, AnomalyGuard, global_norm,  # noqa
 from .faultinject import (FaultPlan, fault_plan, maybe_fault,  # noqa
                           FaultInjected, corrupt_checkpoint,
                           truncate_checkpoint, nan_reader, flaky_reader,
-                          SimulatedKill, KillSwitch)
+                          SimulatedKill, KillSwitch,
+                          SITE_SERVING_RUN, SITE_SERVING_LOAD,
+                          SITE_SERVING_PAD)
 from .autoresume import CheckpointConfig  # noqa
 
 __all__ = [
@@ -45,5 +47,6 @@ __all__ = [
     'FaultPlan', 'fault_plan', 'maybe_fault', 'FaultInjected',
     'corrupt_checkpoint', 'truncate_checkpoint', 'nan_reader',
     'flaky_reader', 'SimulatedKill', 'KillSwitch',
+    'SITE_SERVING_RUN', 'SITE_SERVING_LOAD', 'SITE_SERVING_PAD',
     'CheckpointConfig',
 ]

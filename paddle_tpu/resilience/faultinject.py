@@ -21,6 +21,7 @@ import collections
 import glob
 import os
 import re
+import time
 
 import numpy as np
 
@@ -33,6 +34,10 @@ SITE_CKPT_WRITE = 'checkpoint.write'      # payload serialization
 SITE_CKPT_COMMIT = 'checkpoint.commit'    # between payload and rename
 SITE_CKPT_READ = 'checkpoint.read'        # payload deserialization
 SITE_READER_NEXT = 'reader.next'          # program-reader batch pull
+# serving runtime sites (SERVING.md "Failure domains & SLO guardrails")
+SITE_SERVING_RUN = 'serving/run_batch'    # inside the per-attempt run
+SITE_SERVING_LOAD = 'serving/load_model'  # model load / hot swap
+SITE_SERVING_PAD = 'serving/pad'          # bucket padding stage
 
 
 class FaultInjected(IOError):
@@ -52,7 +57,11 @@ class FaultPlan(object):
     indices; ``times`` faults the first N hits; ``every`` faults every
     Nth hit. Each matched hit raises ``error`` (a class instantiated
     with (site, hit) for FaultInjected, else called with no args; an
-    instance is raised as-is)."""
+    instance is raised as-is). ``delay`` sleeps that many seconds at
+    the injection point before raising — and with ``error=None`` it
+    raises nothing at all, modelling a *wedged* (not failed) stage:
+    the hang the serving watchdog and ``close(timeout=)`` escalation
+    exist to bound."""
 
     def __init__(self):
         self._rules = collections.defaultdict(list)
@@ -60,13 +69,16 @@ class FaultPlan(object):
         self.faults = collections.Counter()
 
     def inject(self, site, error=FaultInjected, at=None, times=None,
-               every=None):
+               every=None, delay=None):
         if at is None and times is None and every is None:
             times = 1
+        if error is None and delay is None:
+            raise ValueError('error=None requires delay= (a pure hang)')
         self._rules[site].append({'error': error,
                                   'at': None if at is None
                                   else frozenset(at),
-                                  'times': times, 'every': every})
+                                  'times': times, 'every': every,
+                                  'delay': delay})
         return self
 
     def check(self, site):
@@ -82,7 +94,11 @@ class FaultPlan(object):
             if not matched:
                 continue
             self.faults[site] += 1
+            if rule['delay']:
+                time.sleep(rule['delay'])
             err = rule['error']
+            if err is None:
+                continue          # pure hang: no error to raise
             if isinstance(err, BaseException):
                 return err
             if err is FaultInjected or (isinstance(err, type) and

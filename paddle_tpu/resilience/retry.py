@@ -19,19 +19,26 @@ _jitter_rng = random.Random(0x5EED)
 
 
 class RetryError(RuntimeError):
-    """All attempts exhausted. ``last_error`` holds the final cause and
-    ``attempts`` how many times the callable ran."""
+    """All attempts exhausted — or the deadline left no room for the
+    next backoff. ``last_error`` holds the final cause, ``attempts``
+    how many times the callable ran, and ``deadline_exceeded`` whether
+    the retry loop gave up early because sleeping again would overshoot
+    the caller's deadline."""
 
-    def __init__(self, fn_name, attempts, last_error):
+    def __init__(self, fn_name, attempts, last_error,
+                 deadline_exceeded=False):
+        why = 'deadline left no room for retry %d' % (attempts + 1) \
+            if deadline_exceeded else 'failed'
         super(RetryError, self).__init__(
-            '%s failed after %d attempt(s): %r' % (fn_name, attempts,
-                                                   last_error))
+            '%s %s after %d attempt(s): %r' % (fn_name, why, attempts,
+                                               last_error))
         self.attempts = attempts
         self.last_error = last_error
+        self.deadline_exceeded = deadline_exceeded
 
 
 def retry(max_attempts=3, backoff=0.1, jitter=0.1, retry_on=(OSError,),
-          sleep=time.sleep, on_retry=None):
+          sleep=time.sleep, on_retry=None, deadline=None):
     """Decorator: re-run the callable on ``retry_on`` errors.
 
     Attempt ``k`` (1-based) sleeps ``backoff * 2**(k-1) * (1 + U[0,
@@ -39,6 +46,12 @@ def retry(max_attempts=3, backoff=0.1, jitter=0.1, retry_on=(OSError,),
     immediately; exhausting ``max_attempts`` raises :class:`RetryError`
     chaining the last cause. ``on_retry(attempt, error)`` is invoked
     before each sleep — the hook the tests use to count attempts.
+
+    ``deadline`` (absolute ``time.monotonic()`` seconds) caps the total
+    backoff: when the next sleep would overshoot it, the loop raises
+    :class:`RetryError` (``deadline_exceeded=True``) immediately
+    instead — retries must never spend a budget the caller no longer
+    has (a serving client's request deadline, a checkpoint window).
     """
     if max_attempts < 1:
         raise ValueError('max_attempts must be >= 1, got %r'
@@ -50,14 +63,15 @@ def retry(max_attempts=3, backoff=0.1, jitter=0.1, retry_on=(OSError,),
             return retry_call(fn, args, kwargs,
                               max_attempts=max_attempts, backoff=backoff,
                               jitter=jitter, retry_on=retry_on,
-                              sleep=sleep, on_retry=on_retry)
+                              sleep=sleep, on_retry=on_retry,
+                              deadline=deadline)
         return wrapper
     return deco
 
 
 def retry_call(fn, args=(), kwargs=None, max_attempts=3, backoff=0.1,
                jitter=0.1, retry_on=(OSError,), sleep=time.sleep,
-               on_retry=None):
+               on_retry=None, deadline=None):
     """Functional form of :func:`retry` for one-off call sites."""
     kwargs = kwargs or {}
     last = None
@@ -72,6 +86,14 @@ def retry_call(fn, args=(), kwargs=None, max_attempts=3, backoff=0.1,
             delay = backoff * (2 ** (attempt - 1))
             if jitter:
                 delay *= 1.0 + _jitter_rng.uniform(0.0, jitter)
+            if deadline is not None and \
+                    time.monotonic() + delay > deadline:
+                logger.warning(
+                    'retry %d/%d of %s abandoned: %.3fs backoff would '
+                    'overshoot the deadline', attempt, max_attempts,
+                    name, delay)
+                raise RetryError(name, attempt, e,
+                                 deadline_exceeded=True) from e
             logger.warning('retry %d/%d of %s after %r (sleeping %.3fs)',
                            attempt, max_attempts, name, e, delay)
             if on_retry is not None:

@@ -12,26 +12,39 @@ inside that cache:
   micro-batching of compatible requests.
 - :mod:`~paddle_tpu.serving.server` — :class:`ModelServer`: worker
   threads, admission control (load shedding + deadlines), warmup,
-  transient-failure retry, stats.
+  transient-failure retry, health/drain/swap, stats.
+- :mod:`~paddle_tpu.serving.breaker` — per-model
+  :class:`CircuitBreaker` (closed -> open -> half-open probes), shed
+  at admission as typed :class:`CircuitOpen`.
+- :mod:`~paddle_tpu.serving.watchdog` — :class:`Watchdog`: per-stage
+  deadlines over in-flight batches; a wedged run fails its futures
+  (:class:`WatchdogTimeout`) instead of hanging clients and
+  ``close()``.
 - :mod:`~paddle_tpu.serving.stats` — request/batch latency histograms,
-  occupancy, bucket distribution, compile-cache hit rate.
+  occupancy, bucket distribution, compile-cache hit rate, guardrail
+  counters.
 
-See SERVING.md for the architecture and tuning guide.
+See SERVING.md for the architecture, tuning, and the "Failure domains
+& SLO guardrails" design.
 """
 from .errors import (ServingError, ServerOverloaded,  # noqa
-                     DeadlineExceeded, ModelNotFound, ServerClosed)
+                     DeadlineExceeded, ModelNotFound, ServerClosed,
+                     CircuitOpen, WatchdogTimeout)
 from .bucketing import BucketPolicy, next_pow2, run_bucketed  # noqa
 from .registry import LoadedModel, ModelRegistry  # noqa
 from .batcher import InferenceRequest, MicroBatcher  # noqa
+from .breaker import CircuitBreaker  # noqa
+from .watchdog import Watchdog  # noqa
 from .stats import LatencyHistogram, ServingStats  # noqa
-from .server import ModelServer  # noqa
+from .server import ModelServer, DEFAULT_STAGE_TIMEOUTS  # noqa
 
 __all__ = [
     'ServingError', 'ServerOverloaded', 'DeadlineExceeded',
-    'ModelNotFound', 'ServerClosed',
+    'ModelNotFound', 'ServerClosed', 'CircuitOpen', 'WatchdogTimeout',
     'BucketPolicy', 'next_pow2', 'run_bucketed',
     'LoadedModel', 'ModelRegistry',
     'InferenceRequest', 'MicroBatcher',
+    'CircuitBreaker', 'Watchdog',
     'LatencyHistogram', 'ServingStats',
-    'ModelServer',
+    'ModelServer', 'DEFAULT_STAGE_TIMEOUTS',
 ]

@@ -30,7 +30,7 @@ class InferenceRequest(object):
     calling client thread on an Event, never a busy-wait."""
 
     __slots__ = ('feeds', 'n', 'signature', 'deadline', 'submit_time',
-                 '_event', '_result', '_error', 'warmup')
+                 '_event', '_result', '_error', 'warmup', 'probe')
 
     def __init__(self, feeds, n, deadline=None, warmup=False):
         self.feeds = feeds
@@ -41,6 +41,7 @@ class InferenceRequest(object):
         self.deadline = deadline          # absolute time.monotonic()
         self.submit_time = _now()
         self.warmup = warmup
+        self.probe = False    # admitted as a half-open breaker probe
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -131,6 +132,16 @@ class MicroBatcher(object):
             self._closed = True
             self._paused = False
             self._cond.notify_all()
+
+    def drain_pending(self):
+        """Pop and return every still-queued request — the shutdown
+        escalation path: when the worker is wedged and can't drain the
+        queue, the caller fails these futures itself (typed
+        ServerClosed) instead of leaving clients blocked forever."""
+        with self._cond:
+            pending = list(self._queue)
+            self._queue.clear()
+        return pending
 
     # ---- consumer side (the model's worker thread) -----------------------
     def _pop_ready(self, expired_out):

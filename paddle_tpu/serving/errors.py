@@ -6,7 +6,8 @@ parsing messages.
 """
 
 __all__ = ['ServingError', 'ServerOverloaded', 'DeadlineExceeded',
-           'ModelNotFound', 'ServerClosed']
+           'ModelNotFound', 'ServerClosed', 'CircuitOpen',
+           'WatchdogTimeout']
 
 
 class ServingError(RuntimeError):
@@ -36,3 +37,23 @@ class ModelNotFound(ServingError, KeyError):
 class ServerClosed(ServingError):
     """The server is shut down (or shutting down) and accepts no new
     requests."""
+
+
+class CircuitOpen(ServingError):
+    """The model's circuit breaker is open (or probing in half-open):
+    recent batches failed hard enough that the server refuses to burn
+    device time on this model. The request was shed at admission — it
+    cost the server one lock acquisition. ``retry_after`` (seconds,
+    may be None) hints when the breaker's next half-open probe window
+    starts; clients should back off at least that long."""
+
+    def __init__(self, message, retry_after=None):
+        super(CircuitOpen, self).__init__(message)
+        self.retry_after = retry_after
+
+
+class WatchdogTimeout(ServingError):
+    """The batch carrying this request exceeded its per-stage deadline
+    and the watchdog failed it. The worker thread may still be wedged
+    inside the stage; the model's breaker is opened so no new work
+    piles onto it."""

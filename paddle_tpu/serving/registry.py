@@ -94,6 +94,15 @@ class ModelRegistry(object):
             self._models[name] = model
         return model
 
+    def replace(self, name, model):
+        """Atomically swap the entry under ``name`` for an
+        already-built :class:`LoadedModel` (hot model swap: the worker
+        re-reads the registry per batch, so queued requests flow onto
+        the replacement without a drop). Returns the new model."""
+        with self._lock:
+            self._models[name] = model
+        return model
+
     def get(self, name):
         with self._lock:
             model = self._models.get(name)

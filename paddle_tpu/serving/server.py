@@ -21,26 +21,65 @@ Design points:
   trace+compile.
 - Transient run failures (``retry_on``, default OSError — NFS/GCS
   hiccups under checkpoint-backed embedding stores) are absorbed by
-  :func:`resilience.retry_call` with exponential backoff.
+  :func:`resilience.retry_call` with exponential backoff, capped by the
+  batch's earliest request deadline.
+
+SLO guardrails (SERVING.md "Failure domains & SLO guardrails"):
+
+- A per-model :class:`~paddle_tpu.serving.breaker.CircuitBreaker`
+  wraps the batch run: a model whose every batch errors stops burning
+  retries in the hot loop — new requests shed with typed
+  :class:`CircuitOpen` at admission until half-open probes prove the
+  model healthy again.
+- A :class:`~paddle_tpu.serving.watchdog.Watchdog` thread bounds every
+  stage (pad, batch run) with a deadline: a wedged ``Executor.run``
+  gets its futures failed (:class:`WatchdogTimeout`), its breaker
+  opened, and its worker marked wedged instead of hanging clients.
+- ``health()`` reports per-model ready/degraded/open/draining state;
+  ``drain()`` completes queued work then unloads; ``swap_model()``
+  flips a replacement in atomically without dropping the queue;
+  ``close(timeout=)`` escalates graceful drain -> fail-pending ->
+  abandon-worker so shutdown is bounded even against a wedged worker.
+- The worker loop is threaded with deterministic fault-injection sites
+  (``serving/run_batch``, ``serving/load_model``, ``serving/pad``) so
+  ``tests/test_chaos.py`` and ``tools/chaos_bench.py`` can kill
+  batches mid-flight and assert the guardrails hold.
 """
+import logging
 import threading
 import time
 
 import numpy as np
 
+from .. import observability as _obs
 from .. import profiler as _prof
 from ..core import places as _places
-from ..executor import Executor
+from ..executor import Executor, Scope
+from ..io import load_inference_model as _load_inference_model
 from ..lod import SequenceTensor
 from ..resilience import retry_call
+from ..resilience import faultinject as _fi
 from .batcher import (InferenceRequest, MicroBatcher, merge_requests,
                       split_fetches)
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from .bucketing import BucketPolicy, pad_feed
-from .errors import DeadlineExceeded, ServerClosed, ServingError
-from .registry import ModelRegistry
+from .errors import (CircuitOpen, DeadlineExceeded, ServerClosed,
+                     ServingError, WatchdogTimeout)
+from .registry import LoadedModel, ModelRegistry
 from .stats import ServingStats
+from .watchdog import Watchdog
 
-__all__ = ['ModelServer']
+__all__ = ['ModelServer', 'DEFAULT_STAGE_TIMEOUTS']
+
+logger = logging.getLogger('paddle_tpu.serving')
+
+# per-stage watchdog deadlines (seconds); keys double as the
+# fault-injection site names. The run stage covers retries, so its
+# budget bounds the whole retry storm, not one attempt.
+DEFAULT_STAGE_TIMEOUTS = {
+    _fi.SITE_SERVING_PAD: 10.0,
+    _fi.SITE_SERVING_RUN: 120.0,
+}
 
 
 class ModelServer(object):
@@ -63,11 +102,22 @@ class ModelServer(object):
     retry_attempts / retry_backoff / retry_on
         Transient-failure retry for each batch run
         (:mod:`paddle_tpu.resilience`).
+    breaker_config : dict, optional
+        Per-model :class:`CircuitBreaker` kwargs (failure_threshold,
+        window, failure_rate, cooldown, probe_successes, max_probes).
+    stage_timeouts : dict, optional
+        Watchdog deadline per stage, merged over
+        :data:`DEFAULT_STAGE_TIMEOUTS`; None disables a stage's
+        deadline.
+    watchdog_poll : float
+        Watchdog scan interval (seconds).
     """
 
     def __init__(self, place=None, max_batch_size=64, max_queue_depth=128,
                  batch_timeout=0.002, policy=None, retry_attempts=2,
-                 retry_backoff=0.05, retry_on=(OSError,)):
+                 retry_backoff=0.05, retry_on=(OSError,),
+                 breaker_config=None, stage_timeouts=None,
+                 watchdog_poll=0.05):
         self.place = place or _places.TPUPlace(0)
         self.executor = Executor(self.place)
         self.policy = policy or BucketPolicy(max_bucket=max_batch_size)
@@ -82,10 +132,20 @@ class ModelServer(object):
         self.retry_attempts = retry_attempts
         self.retry_backoff = retry_backoff
         self.retry_on = tuple(retry_on)
+        self.breaker_config = dict(breaker_config or {})
+        self.stage_timeouts = dict(DEFAULT_STAGE_TIMEOUTS)
+        self.stage_timeouts.update(stage_timeouts or {})
         self.registry = ModelRegistry()
         self.stats = ServingStats()
+        self.watchdog = Watchdog(poll_interval=watchdog_poll,
+                                 on_trip=self._on_watchdog_trip)
         self._batchers = {}            # model name -> MicroBatcher
         self._workers = {}             # model name -> Thread
+        self._breakers = {}            # model name -> CircuitBreaker
+        self._draining = set()         # models mid-drain
+        self._wedged = set()           # models whose worker overran
+        self._trip_counts = {}         # model name -> watchdog trips
+        self._abandoned = []           # worker threads close() gave up on
         self._lock = threading.RLock()
         self._closed = False
 
@@ -94,6 +154,7 @@ class ModelServer(object):
                    params_filename=None):
         """Load a ``save_inference_model`` directory and start serving
         it under ``name``."""
+        _fi.maybe_fault(_fi.SITE_SERVING_LOAD)
         model = self.registry.load(name, dirname, self.executor,
                                    model_filename=model_filename,
                                    params_filename=params_filename)
@@ -109,43 +170,111 @@ class ModelServer(object):
         self._start_worker(model)
         return model
 
-    def unload_model(self, name):
-        """Stop serving ``name``; its queued requests drain first."""
+    def unload_model(self, name, timeout=None):
+        """Stop serving ``name``; its queued requests drain first (see
+        :meth:`drain` for the timeout escalation)."""
+        return self.drain(name, timeout=timeout)
+
+    def drain(self, name, timeout=None):
+        """Graceful per-model shutdown: stop admission, let the worker
+        complete every queued request, then unload and return the
+        model. With ``timeout`` (seconds), a worker still running past
+        it is escalated: in-flight and queued futures fail with typed
+        errors and the worker thread is abandoned — ``drain`` returns
+        instead of hanging on a wedged model."""
+        self.registry.get(name)            # raises ModelNotFound
         with self._lock:
+            self._draining.add(name)
             batcher = self._batchers.pop(name, None)
             worker = self._workers.pop(name, None)
-        if batcher is not None:
-            batcher.close()
-        if worker is not None:
-            worker.join()
-        return self.registry.unload(name)
+        try:
+            with _prof.serving_span('serving/drain'):
+                if batcher is not None:
+                    batcher.close()
+                if worker is not None:
+                    worker.join(timeout)
+                    if worker.is_alive():
+                        self._abandon_worker(name, batcher, worker)
+            _obs.emit('serving_drain', model=name)
+            return self.registry.unload(name)
+        finally:
+            with self._lock:
+                self._draining.discard(name)
+                self._breakers.pop(name, None)
+                self._wedged.discard(name)
+
+    def swap_model(self, name, dirname, model_filename=None,
+                   params_filename=None, validate=True):
+        """Hot model swap: load the replacement artifact into a fresh
+        Scope, validate it off the serving path, then flip the registry
+        entry atomically. The worker re-reads the registry per batch,
+        so queued requests flow onto the replacement without a drop —
+        and a bad deploy (unloadable or failing validation) raises
+        here while the old model keeps serving untouched."""
+        self.registry.get(name)            # raises ModelNotFound
+        with _prof.serving_span('serving/swap'):
+            _fi.maybe_fault(_fi.SITE_SERVING_LOAD)
+            scope = Scope()
+            program, feed_names, fetch_vars = _load_inference_model(
+                dirname, self.executor, model_filename=model_filename,
+                params_filename=params_filename, scope=scope)
+            candidate = LoadedModel(name, program, feed_names,
+                                    fetch_vars, scope)
+            if validate:
+                feed = candidate.synthetic_feed(1)
+                if feed is not None:
+                    # a bad deploy raises HERE, before the flip
+                    self.executor.run(program, feed=feed,
+                                      fetch_list=fetch_vars, scope=scope)
+            new = self.registry.replace(name, candidate)
+        breaker = self._breakers.get(name)
+        if breaker is not None:
+            breaker.reset('model swapped')
+        with self._lock:
+            self._wedged.discard(name)
+        _obs.emit('serving_swap', model=name, dirname=dirname)
+        return new
 
     def models(self):
         return self.registry.names()
+
+    def breaker(self, name):
+        """The model's :class:`CircuitBreaker` (introspection: tests
+        and the chaos harness assert on its transition log)."""
+        return self._breakers[name]
 
     def _start_worker(self, model):
         with self._lock:
             if self._closed:
                 raise ServerClosed('server is shut down')
             batcher = MicroBatcher(max_queue_depth=self.max_queue_depth)
+            breaker = CircuitBreaker(
+                name=model.name,
+                on_transition=self._on_breaker_transition,
+                **self.breaker_config)
             self._batchers[model.name] = batcher
+            self._breakers[model.name] = breaker
             worker = threading.Thread(
-                target=self._worker_loop, args=(model, batcher),
+                target=self._worker_loop, args=(model.name, batcher),
                 name='serve-%s' % model.name, daemon=True)
             self._workers[model.name] = worker
             worker.start()
+        self.stats.record_breaker_state(model.name, CLOSED)
 
     # ---- client surface --------------------------------------------------
     def submit(self, model_name, feeds, deadline=None, _warmup=False):
         """Enqueue one request; returns an :class:`InferenceRequest`
         future. ``deadline`` is relative seconds — the request fails
         with DeadlineExceeded if no worker launches it in time. Raises
-        ServerOverloaded / ServerClosed / ModelNotFound synchronously.
+        ServerOverloaded / ServerClosed / ModelNotFound / CircuitOpen
+        synchronously.
         """
         model = self.registry.get(model_name)
         with self._lock:
             if self._closed:
                 raise ServerClosed('server is shut down')
+            if model_name in self._draining:
+                raise ServerClosed('model %r is draining' % model_name)
             batcher = self._batchers.get(model_name)
         if batcher is None:
             raise ServerClosed('model %r is unloaded' % model_name)
@@ -154,9 +283,18 @@ class ModelServer(object):
             else time.monotonic() + deadline
         req = InferenceRequest(feeds, n, deadline=abs_deadline,
                                warmup=_warmup)
+        breaker = self._breakers.get(model_name)
+        if breaker is not None and not _warmup:
+            try:
+                req.probe = breaker.admit()
+            except CircuitOpen:
+                self.stats.record_breaker_rejected(model_name)
+                raise
         try:
             batcher.submit(req)
         except ServingError:
+            if req.probe:
+                breaker.release_probe()
             self.stats.record_shed()
             raise
         self.stats.record_submitted()
@@ -248,19 +386,130 @@ class ModelServer(object):
     def report(self):
         return self.stats.report(cache_info=self.executor.cache_info())
 
-    def close(self):
-        """Graceful shutdown: reject new requests, drain every queue,
-        join the workers."""
+    # ---- health / readiness ----------------------------------------------
+    def health(self):
+        """Readiness snapshot: ``{'status': ..., 'models': {name:
+        {...}}}``. Per-model ``state`` is one of ``ready`` (breaker
+        closed, worker live), ``degraded`` (breaker half-open, or the
+        watchdog tripped a stage and the worker may be wedged),
+        ``open`` (breaker open: admission sheds), ``draining`` (drain
+        in progress). The same signal feeds the
+        ``serving_breaker_state`` / ``serving_watchdog_trips_total``
+        metrics, so a scraper and this call never disagree."""
+        with self._lock:
+            closed = self._closed
+            draining = set(self._draining)
+            wedged = set(self._wedged)
+            trip_counts = dict(self._trip_counts)
+            batchers = dict(self._batchers)
+            workers = dict(self._workers)
+        models = {}
+        for name in self.registry.names():
+            breaker = self._breakers.get(name)
+            bstate = breaker.state if breaker is not None else CLOSED
+            if name in draining:
+                state = 'draining'
+            elif bstate == OPEN:
+                state = 'open'
+            elif bstate == HALF_OPEN or name in wedged:
+                state = 'degraded'
+            else:
+                state = 'ready'
+            batcher = batchers.get(name)
+            worker = workers.get(name)
+            models[name] = {
+                'state': state,
+                'breaker': bstate,
+                'queue_depth': batcher.depth() if batcher else 0,
+                'worker_alive': bool(worker and worker.is_alive()),
+                'wedged': name in wedged,
+                'watchdog_trips': trip_counts.get(name, 0),
+            }
+        return {'status': 'closed' if closed else 'serving',
+                'models': models}
+
+    # ---- guardrail callbacks ---------------------------------------------
+    def _on_breaker_transition(self, name, to_state, reason):
+        self.stats.record_breaker_transition(name, to_state, reason)
+
+    def _on_watchdog_trip(self, entry):
+        name = entry['model']
+        forced = entry.get('error')
+        err = forced if forced is not None else WatchdogTimeout(
+            'model %r: %s exceeded its %.3fs deadline (%.3fs over); '
+            'in-flight batch failed, breaker opened'
+            % (name, entry['stage'], entry['timeout'],
+               entry.get('overrun', 0.0)))
+        # open the breaker and record the trip BEFORE failing the
+        # futures: a client woken by the error must observe a breaker
+        # that already tripped (health() and metrics agree with it)
+        with self._lock:
+            self._wedged.add(name)
+            self._trip_counts[name] = self._trip_counts.get(name, 0) + 1
+        if forced is None:
+            breaker = self._breakers.get(name)
+            if breaker is not None:
+                breaker.trip('watchdog: %s overran' % entry['stage'])
+        pending = [req for req in entry['batch'] if not req.done()]
+        if pending:
+            self.stats.record_failed(len(pending))
+        self.stats.record_watchdog_trip(
+            name, stage=entry['stage'], failed=len(pending),
+            overrun=entry.get('overrun', 0.0))
+        for req in pending:
+            req.set_error(err)
+        logger.warning('watchdog tripped %s on model %r (%d futures '
+                       'failed)', entry['stage'], name, len(pending))
+
+    def _abandon_worker(self, name, batcher, worker):
+        """Escalation: the worker outlived its join timeout. Fail its
+        in-flight futures and everything still queued, then give the
+        (daemon) thread up — shutdown must not hang on a wedged run."""
+        self.watchdog.trip_all(
+            model=name,
+            error=ServerClosed(
+                'server closed while the batch was in flight; worker '
+                '%r abandoned' % name))
+        pending = batcher.drain_pending() if batcher is not None else []
+        cancelled = 0
+        for req in pending:
+            if not req.done():
+                req.set_error(ServerClosed(
+                    'server closed before the request ran; worker %r '
+                    'abandoned' % name))
+                cancelled += 1
+        if cancelled:
+            self.stats.record_cancelled(cancelled)
+        with self._lock:
+            self._abandoned.append(worker)
+        _obs.emit('serving_abandoned_worker', model=name,
+                  cancelled=cancelled)
+        logger.error('abandoned wedged worker %r (%d queued futures '
+                     'failed)', worker.name, cancelled)
+
+    def close(self, timeout=30.0):
+        """Shutdown with bounded escalation: reject new requests, drain
+        every queue, join the workers — and if a worker is still alive
+        once ``timeout`` seconds have elapsed (wedged in a run), fail
+        its in-flight and queued futures with :class:`ServerClosed` and
+        abandon the thread instead of hanging forever. ``timeout=None``
+        restores the wait-forever behavior."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
-            batchers = list(self._batchers.values())
-            workers = list(self._workers.values())
-        for b in batchers:
+            batchers = dict(self._batchers)
+            workers = dict(self._workers)
+        for b in batchers.values():
             b.close()
-        for w in workers:
-            w.join()
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        for name, w in workers.items():
+            w.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+            if w.is_alive():
+                self._abandon_worker(name, batchers.get(name), w)
+        self.watchdog.stop()
 
     def __enter__(self):
         return self
@@ -270,13 +519,24 @@ class ModelServer(object):
         return False
 
     # ---- worker ----------------------------------------------------------
-    def _worker_loop(self, model, batcher):
+    def _current_model(self, name):
+        try:
+            return self.registry.get(name)
+        except ServingError:
+            return None
+
+    def _worker_loop(self, name, batcher):
         while True:
+            model = self._current_model(name)
+            max_rows = self.max_batch_size \
+                if (model is None or model.batchable) else 1
             batch, expired = batcher.next_batch(
-                self.max_batch_size if model.batchable else 1,
-                batch_timeout=self.batch_timeout)
+                max_rows, batch_timeout=self.batch_timeout)
+            breaker = self._breakers.get(name)
             for req in expired:
                 self.stats.record_expired()
+                if req.probe and breaker is not None:
+                    breaker.release_probe()   # the probe never ran
                 req.set_error(DeadlineExceeded(
                     'deadline passed after %.3fs in queue'
                     % req.latency()))
@@ -284,37 +544,92 @@ class ModelServer(object):
                 return
             if not batch:
                 continue          # only expired requests this round
+            # re-read the registry so a hot swap lands between batches
+            model = self._current_model(name)
+            if model is None:
+                err = ServerClosed('model %r was unloaded' % name)
+                for req in batch:
+                    if not req.done():
+                        req.set_error(err)
+                continue
             try:
                 self._run_batch(model, batch)
             except Exception as e:           # noqa: BLE001 — worker must
-                # never die: every queued client is waiting on it
+                # never die: every queued client is waiting on it.
+                # Record the breaker outcome BEFORE failing the futures
+                # so a client woken by the error observes a breaker
+                # that already counted it.
+                if breaker is not None:
+                    breaker.record_failure()
                 self.stats.record_failed(len(batch))
                 for req in batch:
                     if not req.done():
                         req.set_error(e)
+                with self._lock:
+                    self._wedged.discard(name)
+            else:
+                # success was recorded on the breaker inside
+                # _run_batch, before any future completed
+                with self._lock:
+                    self._wedged.discard(name)
 
     def _exe_run(self, model, feed):
+        _fi.maybe_fault(_fi.SITE_SERVING_RUN)
         return self.executor.run(model.program, feed=feed,
                                  fetch_list=model.fetch_vars,
                                  scope=model.scope)
 
-    def _run_guarded(self, model, feed):
-        """One Executor.run with transient-failure retry."""
+    def _run_guarded(self, model, feed, deadline=None):
+        """One Executor.run with transient-failure retry, backoff
+        capped by the batch's earliest request deadline."""
         def _on_retry(attempt, error):
             self.stats.record_retry()
         return retry_call(self._exe_run, (model, feed),
                           max_attempts=self.retry_attempts,
                           backoff=self.retry_backoff,
-                          retry_on=self.retry_on, on_retry=_on_retry)
+                          retry_on=self.retry_on, on_retry=_on_retry,
+                          deadline=deadline)
+
+    def _earliest_deadline(self, batch):
+        deadlines = [r.deadline for r in batch if r.deadline is not None]
+        return min(deadlines) if deadlines else None
 
     def _run_batch(self, model, batch):
+        """Run one coalesced batch. Returns True when the watchdog
+        tripped a stage mid-flight — the futures are already failed, so
+        the caller must not complete (or count) them again."""
         feed, rows, slices = merge_requests(batch)
         bucket = self.policy.bucket_for(rows) if model.batchable else rows
-        with _prof.serving_span('serving/pad'):
-            padded = pad_feed(feed, rows, bucket, self.policy.pad_mode)
+        deadline = self._earliest_deadline(batch)
+        token = self.watchdog.enter(
+            model.name, _fi.SITE_SERVING_PAD,
+            self.stage_timeouts.get(_fi.SITE_SERVING_PAD), batch)
+        try:
+            with _prof.serving_span('serving/pad'):
+                _fi.maybe_fault(_fi.SITE_SERVING_PAD)
+                padded = pad_feed(feed, rows, bucket,
+                                  self.policy.pad_mode)
+        finally:
+            pad_entry = self.watchdog.exit(token)
+        if pad_entry is None:
+            return True
         t0 = time.monotonic()
-        with _prof.serving_span('serving/batch_run'):
-            fetches = self._run_guarded(model, padded)
+        token = self.watchdog.enter(
+            model.name, _fi.SITE_SERVING_RUN,
+            self.stage_timeouts.get(_fi.SITE_SERVING_RUN), batch)
+        try:
+            with _prof.serving_span('serving/batch_run'):
+                fetches = self._run_guarded(model, padded,
+                                            deadline=deadline)
+        finally:
+            run_entry = self.watchdog.exit(token)
+        if run_entry is None:
+            return True
+        breaker = self._breakers.get(model.name)
+        if breaker is not None:
+            # count the success BEFORE completing any future, so a
+            # client woken by its result observes a consistent breaker
+            breaker.record_success()
         self.stats.record_batch(rows, bucket, time.monotonic() - t0)
         parts = split_fetches(fetches, slices, rows, bucket)
         if parts is None:
@@ -323,12 +638,23 @@ class ModelServer(object):
             # unpadded — exactness over throughput — and remember.
             model.batchable = False
             for req in batch:
-                with _prof.serving_span('serving/exact_fallback'):
-                    out = self._run_guarded(model, req.feeds)
+                token = self.watchdog.enter(
+                    model.name, _fi.SITE_SERVING_RUN,
+                    self.stage_timeouts.get(_fi.SITE_SERVING_RUN),
+                    [req])
+                try:
+                    with _prof.serving_span('serving/exact_fallback'):
+                        out = self._run_guarded(model, req.feeds,
+                                                deadline=req.deadline)
+                finally:
+                    entry = self.watchdog.exit(token)
+                if entry is None:
+                    continue           # tripped: future already failed
                 self._complete(req, out)
-            return
+            return False
         for req, part in zip(batch, parts):
             self._complete(req, part)
+        return False
 
     def _complete(self, req, fetches):
         latency = req.latency()

@@ -11,6 +11,7 @@ relative resolution.
 import threading
 
 from .. import observability as _obs
+from .breaker import STATE_CODES
 
 __all__ = ['LatencyHistogram', 'ServingStats']
 
@@ -74,6 +75,10 @@ class ServingStats(object):
         self.expired = 0       # deadline passed before a worker ran it
         self.failed = 0        # run raised after retries
         self.retries = 0       # transient failures absorbed by retry
+        self.breaker_rejected = 0  # shed by an open circuit breaker
+        self.cancelled = 0         # failed by close()/abandon escalation
+        self.watchdog_trips = 0    # stages tripped past their deadline
+        self.breaker_transitions = {}   # to_state -> count
         self.batches = 0
         self.batched_rows = 0      # real rows carried by all batches
         self.padded_rows = 0       # pad rows added by bucketing
@@ -97,6 +102,12 @@ class ServingStats(object):
                                   'requests failed after retries'),
             'retries': reg.counter('serving_retries_total',
                                    'transient batch-run retries'),
+            'breaker_rejected': reg.counter(
+                'serving_breaker_rejected_total',
+                'requests shed by an open circuit breaker'),
+            'cancelled': reg.counter(
+                'serving_requests_cancelled_total',
+                'requests failed by close()/abandon escalation'),
             'batches': reg.counter('serving_batches_total',
                                    'device batches launched'),
             'rows': reg.counter('serving_batch_rows_total',
@@ -140,6 +151,49 @@ class ServingStats(object):
         self._m['retries'].inc(n)
         _obs.emit('serving_retry', n=n)
 
+    def record_breaker_rejected(self, model, n=1):
+        with self._lock:
+            self.breaker_rejected += n
+        self._m['breaker_rejected'].inc(n)
+        _obs.emit('serving_breaker_rejected', model=model, n=n)
+
+    def record_cancelled(self, n=1):
+        with self._lock:
+            self.cancelled += n
+        self._m['cancelled'].inc(n)
+        _obs.emit('serving_cancelled', n=n)
+
+    def record_breaker_state(self, model, state):
+        """Publish the per-model breaker gauge (0 closed / 1 half-open
+        / 2 open) without counting a transition — the init path."""
+        _obs.default_registry().gauge(
+            'serving_breaker_state',
+            'circuit state per model: 0 closed / 1 half-open / 2 open',
+            model=model).set(STATE_CODES[state])
+
+    def record_breaker_transition(self, model, to_state, reason=''):
+        with self._lock:
+            self.breaker_transitions[to_state] = \
+                self.breaker_transitions.get(to_state, 0) + 1
+        self.record_breaker_state(model, to_state)
+        _obs.default_registry().counter(
+            'serving_breaker_transitions_total',
+            'circuit-breaker state transitions',
+            model=model, to=to_state).inc()
+        _obs.emit('serving_breaker', model=model, to=to_state,
+                  reason=reason)
+
+    def record_watchdog_trip(self, model, stage='', failed=0,
+                             overrun=0.0):
+        with self._lock:
+            self.watchdog_trips += 1
+        _obs.default_registry().counter(
+            'serving_watchdog_trips_total',
+            'in-flight stages failed past their deadline',
+            model=model).inc()
+        _obs.emit('serving_watchdog_trip', model=model, stage=stage,
+                  failed=failed, overrun_s=round(overrun, 6))
+
     def record_batch(self, rows, bucket, seconds):
         with self._lock:
             self.batches += 1
@@ -179,6 +233,13 @@ class ServingStats(object):
                     'expired': self.expired,
                     'failed': self.failed,
                     'retries': self.retries,
+                    'breaker_rejected': self.breaker_rejected,
+                    'cancelled': self.cancelled,
+                },
+                'guardrails': {
+                    'watchdog_trips': self.watchdog_trips,
+                    'breaker_transitions': dict(
+                        self.breaker_transitions),
                 },
                 'batches': {
                     'count': self.batches,
@@ -206,11 +267,18 @@ class ServingStats(object):
         """Human-readable dashboard, profiler-report style."""
         d = self.as_dict(cache_info=cache_info)
         r, b, lat = d['requests'], d['batches'], d['latency']
+        g = d['guardrails']
         lines = [
             '----------------->     Serving Report     <-----------------',
             'requests: %(submitted)d submitted, %(completed)d completed, '
             '%(shed)d shed, %(expired)d expired, %(failed)d failed, '
             '%(retries)d retries' % r,
+            'guardrails: %d breaker-rejected, %d cancelled, '
+            '%d watchdog trips, breaker transitions %s'
+            % (r['breaker_rejected'], r['cancelled'],
+               g['watchdog_trips'],
+               ', '.join('%s->%d' % (k, v) for k, v in sorted(
+                   g['breaker_transitions'].items())) or '-'),
             'batches:  %d launched, %d rows (+%d pad), occupancy %.1f%%'
             % (b['count'], b['rows'], b['padded_rows'],
                100.0 * b['occupancy']),

@@ -49,3 +49,8 @@ def pytest_configure(config):
         'markers',
         'observability: tests of the metrics registry / run journal / '
         'telemetry tools (tier-1; filter with -m "not observability")')
+    config.addinivalue_line(
+        'markers',
+        'chaos: deterministic chaos-harness tests of the serving SLO '
+        'guardrails — breaker/watchdog/drain/close escalation (tier-1; '
+        'filter with -m "not chaos")')

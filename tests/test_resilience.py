@@ -99,6 +99,53 @@ def test_retry_does_not_catch_unlisted_errors():
         typo()
 
 
+def test_retry_call_deadline_raises_early_instead_of_oversleeping():
+    """A deadline the next backoff would overshoot ends the retry loop
+    NOW (typed, with deadline_exceeded set) — retries must never spend
+    a budget the caller no longer has (ISSUE 4 satellite)."""
+    import time
+    sleeps, calls = [], [0]
+
+    def always_fails():
+        calls[0] += 1
+        raise IOError('permanent')
+
+    with pytest.raises(RetryError) as ei:
+        resilience.retry_call(
+            always_fails, max_attempts=10, backoff=10.0, jitter=0.0,
+            sleep=sleeps.append, deadline=time.monotonic() + 0.05)
+    assert ei.value.deadline_exceeded is True
+    assert ei.value.attempts == 1          # gave up before retry 2
+    assert calls[0] == 1
+    assert sleeps == []                    # never slept past the budget
+    assert 'deadline' in str(ei.value)
+
+
+def test_retry_call_deadline_allows_retries_that_fit():
+    import time
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise IOError('transient')
+        return 'ok'
+
+    assert resilience.retry_call(
+        flaky, max_attempts=5, backoff=0.001, jitter=0.0,
+        deadline=time.monotonic() + 30.0) == 'ok'
+    assert calls[0] == 3
+
+
+def test_retry_without_deadline_keeps_legacy_exhaustion_message():
+    with pytest.raises(RetryError) as ei:
+        resilience.retry_call(lambda: (_ for _ in ()).throw(
+            IOError('x')), max_attempts=2, backoff=0.0, jitter=0.0,
+            sleep=lambda s: None)
+    assert ei.value.deadline_exceeded is False
+    assert 'failed after 2 attempt(s)' in str(ei.value)
+
+
 @pytest.mark.faultinject
 def test_retry_reader_absorbs_transient_failures():
     def source():
@@ -325,6 +372,38 @@ def test_check_checkpoint_cli(tmp_path, capsys):
     assert check_checkpoint.main([str(tmp_path / 'nothing_here')]) == 2
 
 
+@pytest.mark.faultinject
+def test_check_checkpoint_cli_json(tmp_path, capsys):
+    """--json prints one machine-readable document (automation gate,
+    ISSUE 4 satellite); exit codes match the human mode."""
+    import json
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..',
+                                    'tools'))
+    try:
+        import check_checkpoint
+    finally:
+        sys.path.pop(0)
+    _main, _exe, ckdir, _ws = _saved_scope(tmp_path, nsaves=2)
+    assert check_checkpoint.main([ckdir, '--json']) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['exit_code'] == 0
+    assert doc['healthy'] == 2 and doc['corrupt'] == 0
+    assert [e['serial'] for e in doc['serials']] == [0, 1]
+    assert all(e['healthy'] and e['tensors'] > 0
+               for e in doc['serials'])
+    faultinject.corrupt_checkpoint(ckdir)    # newest serial = 1
+    assert check_checkpoint.main([ckdir, '--json']) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['exit_code'] == 1 and doc['corrupt'] == 1
+    bad = [e for e in doc['serials'] if not e['healthy']]
+    assert len(bad) == 1 and bad[0]['serial'] == 1 and bad[0]['errors']
+    # empty target: error surfaces in the document, code 2
+    assert check_checkpoint.main(
+        [str(tmp_path / 'nothing_here'), '--json']) == 2
+    assert 'error' in json.loads(capsys.readouterr().out)
+
+
 # ---- anomaly guards -------------------------------------------------------
 def _make_trainer():
     def train_func():
@@ -545,6 +624,33 @@ def test_fault_plan_every_and_custom_error():
         faultinject.maybe_fault('s')
         with pytest.raises(Boom):
             faultinject.maybe_fault('s')
+
+
+def test_fault_plan_delay_models_a_hang():
+    """``delay=`` sleeps at the injection point; with ``error=None`` it
+    raises nothing — a pure wedged stage, the hang the serving watchdog
+    bounds (ISSUE 4)."""
+    import time
+    plan = resilience.FaultPlan().inject('s', error=None, delay=0.05,
+                                         at=[1])
+    with fault_plan(plan):
+        t0 = time.monotonic()
+        faultinject.maybe_fault('s')              # hit 0: instant
+        assert time.monotonic() - t0 < 0.04
+        t0 = time.monotonic()
+        faultinject.maybe_fault('s')              # hit 1: hangs, no raise
+        assert time.monotonic() - t0 >= 0.05
+    assert plan.faults['s'] == 1
+    # delay composes with an error: sleep THEN raise
+    plan2 = resilience.FaultPlan().inject('s', delay=0.05, times=1)
+    with fault_plan(plan2):
+        t0 = time.monotonic()
+        with pytest.raises(FaultInjected):
+            faultinject.maybe_fault('s')
+        assert time.monotonic() - t0 >= 0.05
+    # a pure hang needs a delay, by construction
+    with pytest.raises(ValueError):
+        resilience.FaultPlan().inject('s', error=None)
 
 
 def test_nan_reader_poisons_only_chosen_steps():

@@ -323,6 +323,81 @@ def test_server_micro_batches_coalesce(tmp_path):
     assert srv.stats.bucket_counts.get(8, 0) >= 1
 
 
+# ---- MicroBatcher edge cases (ISSUE 4 satellite) -------------------------
+def _req(n, deadline=None):
+    from paddle_tpu.serving.batcher import InferenceRequest
+    return InferenceRequest({'x': np.ones((n, IN_DIM), 'float32')}, n,
+                            deadline=deadline)
+
+
+def test_batcher_expired_head_preserves_fifo_for_survivors():
+    """An already-expired request at the head must not reorder the
+    live requests behind it: the batch comes out in submit order."""
+    b = serving.MicroBatcher()
+    dead = _req(1, deadline=time.monotonic() - 1.0)
+    live1, live2 = _req(2), _req(1)
+    for r in (dead, live1, live2):
+        b.submit(r)
+    batch, expired = b.next_batch(max_rows=8, batch_timeout=0.0)
+    assert expired == [dead]
+    assert batch == [live1, live2]          # FIFO, coalesced
+    assert b.depth() == 0
+
+
+def test_batcher_all_expired_round_returns_empty_batch():
+    """A round holding only dead requests hands them back NOW with an
+    empty batch (the worker's `continue` path) instead of sitting on
+    them until live traffic arrives."""
+    b = serving.MicroBatcher()
+    dead = [_req(1, deadline=time.monotonic() - 1.0) for _ in range(3)]
+    for r in dead:
+        b.submit(r)
+    batch, expired = b.next_batch(max_rows=8, batch_timeout=0.0)
+    assert batch == []
+    assert expired == dead                  # all three, in order
+    # the queue is clean: close() drains immediately
+    b.close()
+    batch, expired = b.next_batch(max_rows=8)
+    assert batch is None and expired == []
+
+
+def test_server_mid_batch_failure_fails_exactly_that_batch(tmp_path,
+                                                           monkeypatch):
+    """A worker that raises mid-batch fails exactly that batch's
+    futures; the next batch serves normally on the same worker."""
+    d = _save_model(tmp_path)
+    expected = _expected_fn(d)
+    rng = np.random.RandomState(21)
+    with ModelServer(place=fluid.CPUPlace(), max_batch_size=16,
+                     retry_attempts=1, retry_backoff=0.0) as srv:
+        srv.load_model('m', d)
+        srv.warmup('m')
+        real = srv.executor.run
+        boom = {'left': 1}
+
+        def run_once_broken(*args, **kwargs):
+            if boom['left'] > 0:
+                boom['left'] -= 1
+                raise ValueError('mid-batch explosion')
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(srv.executor, 'run', run_once_broken)
+        srv.pause('m')
+        xs = [_rand_batch(rng, 2) for _ in range(3)]
+        doomed = [srv.submit('m', {'x': x}) for x in xs]  # one batch
+        srv.resume('m')
+        for r in doomed:
+            with pytest.raises(ValueError):
+                r.result(timeout=30.0)
+        st = srv.stats_dict()['requests']
+        assert st['failed'] == 3            # exactly the doomed batch
+        # the worker survived: the next request is exact
+        x = _rand_batch(rng, 3)
+        out, = srv.infer('m', {'x': x}, timeout=30.0)
+        assert np.array_equal(np.asarray(out), np.asarray(expected(x)))
+        assert srv.stats_dict()['requests']['failed'] == 3
+
+
 def test_server_deadline_expiry(tmp_path):
     d = _save_model(tmp_path)
     with ModelServer(place=fluid.CPUPlace(), max_batch_size=8) as srv:

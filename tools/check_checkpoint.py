@@ -3,6 +3,7 @@
 
 Usage:
     python tools/check_checkpoint.py CKPT_DIR [--serial N] [--quiet]
+                                     [--json]
 
 CKPT_DIR is either a checkpoint root (holding checkpoint_<N> serials)
 or a single serial directory. Exit code 0 = every checked serial is
@@ -10,8 +11,14 @@ healthy, 1 = at least one is corrupt/incomplete, 2 = nothing
 checkpoint-shaped found. Meant for CI gates and pre-restore sanity
 checks; uses the exact validator ``io.load_checkpoint`` trusts
 (paddle_tpu/resilience/checkpoint.py).
+
+``--json`` replaces the human lines with one machine-readable JSON
+document on stdout (per-serial health + errors + manifest summary), so
+automation can gate on it alongside ``serve_bench.py --smoke`` and
+``chaos_bench.py --smoke``; the exit codes are unchanged.
 """
 import argparse
+import json
 import os
 import re
 import sys
@@ -40,38 +47,61 @@ def _find_serial_dirs(root, serial=None):
     return found
 
 
+def scan_dir(root, serial=None):
+    """Validate every matching serial. Returns ``(exit_code,
+    result_dict)`` — the dict is what ``--json`` prints."""
+    result = {'root': root, 'serials': [], 'healthy': 0, 'corrupt': 0}
+    if not os.path.isdir(root):
+        result['error'] = '%s is not a directory' % root
+        return 2, result
+    dirs = _find_serial_dirs(root, serial)
+    if not dirs:
+        result['error'] = 'no checkpoint serials under %s' % root
+        return 2, result
+    for s, path in dirs:
+        errors = verify_checkpoint(path)
+        manifest = read_manifest(path)
+        entry = {
+            'serial': s,
+            'path': path,
+            'healthy': not errors,
+            'errors': list(errors),
+            'legacy_no_manifest': manifest is None,
+            'tensors': len((manifest or {}).get('tensors', {})),
+            'files': len((manifest or {}).get('files', {})),
+            'backend': (manifest or {}).get('backend'),
+        }
+        result['serials'].append(entry)
+        result['corrupt' if errors else 'healthy'] += 1
+    return (1 if result['corrupt'] else 0), result
+
+
 def check_dir(root, serial=None, quiet=False):
     """Returns process exit code (0 healthy / 1 corrupt / 2 empty)."""
     def say(msg):
         if not quiet:
             print(msg)
 
-    if not os.path.isdir(root):
-        say('error: %s is not a directory' % root)
-        return 2
-    dirs = _find_serial_dirs(root, serial)
-    if not dirs:
-        say('error: no checkpoint serials under %s' % root)
-        return 2
-    bad = 0
-    for s, path in dirs:
-        label = path if s is None else 'serial %d (%s)' % (s, path)
-        errors = verify_checkpoint(path)
-        manifest = read_manifest(path)
-        if errors:
-            bad += 1
+    code, result = scan_dir(root, serial=serial)
+    if 'error' in result:
+        say('error: %s' % result['error'])
+        return code
+    for entry in result['serials']:
+        s = entry['serial']
+        label = entry['path'] if s is None \
+            else 'serial %d (%s)' % (s, entry['path'])
+        if not entry['healthy']:
             say('CORRUPT  %s' % label)
-            for e in errors:
+            for e in entry['errors']:
                 say('         - %s' % e)
             continue
-        ntensors = len((manifest or {}).get('tensors', {}))
-        nfiles = len((manifest or {}).get('files', {}))
-        extra = ' [legacy: no manifest]' if manifest is None else \
-            ' (%d tensors, %d files, backend=%s)' % (
-                ntensors, nfiles, (manifest or {}).get('backend'))
+        extra = ' [legacy: no manifest]' if entry['legacy_no_manifest'] \
+            else ' (%d tensors, %d files, backend=%s)' % (
+                entry['tensors'], entry['files'], entry['backend'])
         say('OK       %s%s' % (label, extra))
-    say('%d/%d serial(s) healthy' % (len(dirs) - bad, len(dirs)))
-    return 1 if bad else 0
+    say('%d/%d serial(s) healthy'
+        % (result['healthy'], len(result['serials'])))
+    return code
 
 
 def main(argv=None):
@@ -80,7 +110,15 @@ def main(argv=None):
     ap.add_argument('--serial', type=int, default=None,
                     help='check only this serial')
     ap.add_argument('--quiet', action='store_true')
+    ap.add_argument('--json', action='store_true',
+                    help='print one machine-readable JSON document '
+                         'instead of the human lines')
     args = ap.parse_args(argv)
+    if args.json:
+        code, result = scan_dir(args.ckpt_dir, serial=args.serial)
+        result['exit_code'] = code
+        print(json.dumps(result, indent=2, sort_keys=True))
+        return code
     return check_dir(args.ckpt_dir, serial=args.serial, quiet=args.quiet)
 
 
