@@ -854,6 +854,112 @@ def bench_flash_attention(on_tpu):
     return out
 
 
+def bench_input_pipeline(on_tpu):
+    """Product-path dispatch pipelining (PERF.md "Dispatch pipelining"):
+    the SAME `Trainer.train` loop at recognize_digits scale (MLP whose
+    per-step compute is small enough that per-dispatch tunnel latency
+    and host feed work dominate), measured step-by-step vs pipelined
+    (`prefetch=4, steps_per_dispatch=8, sync_interval=8`). The reader
+    does REAL host work per batch (uint8 decode + pad/crop/flip
+    augmentation + normalize, then DataFeeder conversion); epoch 0
+    absorbs compiles, epoch 1 is the timed steady state. The host-bound
+    fraction comes from the `trainer_host_wait_seconds` histogram — the
+    measured SLI, not an inference. On the CPU backend the
+    steps_per_dispatch lever is inert (dispatch is microseconds; it
+    exists to amortize the TPU tunnel's 8-60 ms round trip) — the CPU
+    speedup is pure prefetch overlap of decode/augment host work."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import observability as obs
+
+    batch = 64
+    steps = 30 if on_tpu else 10
+    rng = np.random.RandomState(0)
+    raw = [rng.randint(0, 256, (28, 28)).astype('uint8')
+           for _ in range(batch * steps)]
+    labels = rng.randint(0, 10, (batch * steps, 1)).astype('int64')
+
+    def _augment(img8, rr):
+        img = np.pad(img8, 2)
+        y, x = rr.randint(0, 5), rr.randint(0, 5)
+        img = img[y:y + 28, x:x + 28]
+        if rr.rand() < 0.5:
+            img = img[:, ::-1]
+        return ((img.astype('float32') / 255.0) - 0.1307) / 0.3081
+
+    def reader():
+        rr = np.random.RandomState(1)
+        for i in range(0, len(raw), batch):
+            yield [(_augment(raw[j], rr).reshape(-1), labels[j])
+                   for j in range(i, i + batch)]
+
+    def train_func():
+        img = fluid.layers.data(name='img', shape=[784],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='int64')
+        h = fluid.layers.fc(input=img, size=200, act='relu')
+        pred = fluid.layers.fc(input=h, size=10, act='softmax')
+        return fluid.layers.mean(fluid.layers.cross_entropy(
+            input=pred, label=label))
+
+    place = fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace()
+    reg = obs.default_registry()
+    host_wait = reg.histogram('trainer_host_wait_seconds')
+
+    def one_mode(**train_kw):
+        trainer = fluid.Trainer(train_func=train_func,
+                                optimizer=fluid.optimizer.Adam(
+                                    learning_rate=1e-3),
+                                place=place)
+        marks = {}
+
+        def handler(ev):
+            if isinstance(ev, fluid.BeginEpochEvent) and ev.epoch == 1:
+                marks['t0'] = time.perf_counter()
+                marks['w0'] = host_wait.sum
+            elif isinstance(ev, fluid.EndEpochEvent) and ev.epoch == 1:
+                marks['t1'] = time.perf_counter()
+                marks['w1'] = host_wait.sum
+            elif isinstance(ev, fluid.EndStepEvent) and ev.metrics:
+                marks['loss'] = ev.metrics[0]
+
+        trainer.train(num_epochs=2, event_handler=handler,
+                      reader=reader, feed_order=['img', 'label'],
+                      **train_kw)
+        wall = marks['t1'] - marks['t0']
+        return {
+            'steps_per_sec': round(steps / wall, 2),
+            'examples_per_sec': round(steps * batch / wall, 1),
+            'host_wait_fraction': round(
+                (marks['w1'] - marks['w0']) / wall, 4),
+            'last_loss': round(float(np.asarray(
+                marks['loss']).ravel()[0]), 4),
+        }
+
+    out = {'batch_size': batch, 'steps_per_epoch': steps,
+           'baseline': one_mode(),
+           'prefetch_only': one_mode(prefetch=4),
+           'pipelined': one_mode(prefetch=4, steps_per_dispatch=8,
+                                 sync_interval=8)}
+    out['speedup'] = round(out['pipelined']['steps_per_sec'] /
+                           max(out['baseline']['steps_per_sec'], 1e-9),
+                           3)
+    if not on_tpu:
+        out['note'] = ('cpu backend: per-dispatch latency is '
+                       'microseconds, so the steps_per_dispatch lever '
+                       'is inert here (it amortizes the TPU tunnel '
+                       'round trip); the speedup shown is prefetch '
+                       'overlapping the decode/augment host work with '
+                       'compute')
+    log('input_pipeline: %.1f -> %.1f steps/s (%.2fx); host-wait '
+        'fraction %.1f%% -> %.1f%%' % (
+            out['baseline']['steps_per_sec'],
+            out['pipelined']['steps_per_sec'], out['speedup'],
+            100 * out['baseline']['host_wait_fraction'],
+            100 * out['pipelined']['host_wait_fraction']))
+    return out
+
+
 def main():
     record = {
         'metric': 'resnet50_train_images_per_sec_per_chip',
@@ -929,6 +1035,7 @@ def main():
                     ('decode', bench_decode),
                     ('long_context', bench_long_context),
                     ('half_inference', bench_half_inference),
+                    ('input_pipeline', bench_input_pipeline),
                     ('memory', bench_memory)):
         try:
             record[key] = fn(on_tpu)
@@ -1010,6 +1117,8 @@ def _headline(record):
                  row.get('speedup'), (int, float))),
             default=None),
         'decode_jit_speedup': _dig(record, 'decode', 'jitted_speedup'),
+        'input_pipeline_speedup': _dig(record, 'input_pipeline',
+                                       'speedup'),
     }
     h.update({k: v for k, v in per_model.items() if v is not None})
     errs = [k for k in record if k.endswith('_error')]

@@ -552,6 +552,47 @@ def _merge_shared_muls(block, ops):
 SHAPE_INPUT_SLOTS = frozenset({('reshape', 'Shape')})
 
 
+def lower_block_chained(program, block, feed_names, fetch_names,
+                        state_in_names, state_out_names, static_env=None):
+    """K training steps inside ONE jitted program.
+
+    Dispatch amortization (PERF.md "Dispatch pipelining"): every
+    ``Executor.run`` pays one host->device round trip through the axon
+    tunnel (~8-60 ms), so at small step walls the product training loop
+    is dispatch-bound. This builds ``fn(stacked_feeds, state) ->
+    (stacked_fetches, final_state)`` where feeds carry a leading [K]
+    axis and the single-step computation from :func:`lower_block` runs
+    under ``jax.lax.scan`` — persistable state (params, optimizer
+    accumulators, PRNG key) threads step-to-step as the scan carry, and
+    each step's fetches come back stacked on the same [K] axis.
+
+    Because the scan body IS the single-step lowering, the K-step
+    program performs the exact op sequence of K sequential ``run``
+    calls: same RNG splits, same optimizer updates — bit-exactness is
+    pinned by tests/test_pipeline.py. K itself is not baked into the
+    trace; the same compiled program serves any chain length of the
+    same per-step feed spec (XLA recompiles per distinct K through the
+    jit shape cache, which the executor's cache key mirrors).
+
+    Not valid for dynamic (eager) programs, per-op profiling, or
+    checkify NaN-guard mode — the executor falls back to sequential
+    single-step runs for those.
+    """
+    step = lower_block(program, block, feed_names, fetch_names,
+                       state_in_names, state_out_names,
+                       dynamic=False, static_env=static_env)
+
+    def fn(stacked_feeds, state):
+        def body(carry, feeds_i):
+            fetches, new_state = step(feeds_i, carry)
+            return new_state, tuple(fetches)
+
+        final_state, stacked = jax.lax.scan(body, state, stacked_feeds)
+        return list(stacked), final_state
+
+    return fn
+
+
 def lower_block(program, block, feed_names, fetch_names, state_in_names,
                 state_out_names, dynamic=False, static_env=None):
     """Build ``fn(feeds, state) -> (fetches, new_state)`` for jit.

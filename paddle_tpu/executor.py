@@ -163,6 +163,11 @@ def scope_guard(scope):
 def as_numpy(value):
     if isinstance(value, VarBinding):
         value = value.value()
+    if isinstance(value, np.ndarray):
+        # already a host array: hand it back as-is instead of running it
+        # through np.asarray again (the half-inference _to_f32_fetch
+        # path used to double-convert here)
+        return value
     if isinstance(value, SequenceTensor):
         if value.lengths is None:
             # packed/dense-wrapped mode: preserve offsets, not lengths
@@ -182,22 +187,36 @@ def as_numpy(value):
 
 def _to_f32_fetch(f):
     """Half-inference boundary: float fetches back to f32, preserving
-    SequenceTensor structure (incl. packed mode)."""
+    SequenceTensor structure (incl. packed mode). A fetch that is
+    already a HOST numpy array is converted host-side — the old
+    ``jnp.asarray`` spelling shipped it device-ward only for
+    ``as_numpy`` to immediately pull it back (a redundant H2D+D2H round
+    trip per fetch)."""
+    def _cast(arr):
+        if isinstance(arr, np.ndarray):
+            # jnp.issubdtype also recognizes ml_dtypes halves (bf16)
+            # that numpy's own issubdtype does not class as floating
+            if jnp.issubdtype(arr.dtype, jnp.floating) and \
+                    arr.dtype != np.float32:
+                return arr.astype(np.float32)
+            return arr
+        arr = jnp.asarray(arr)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            return arr.astype(jnp.float32)
+        return arr
+
     if isinstance(f, SequenceTensor):
         if f._packed is not None and f._offsets:
-            p = jnp.asarray(f._packed)
-            if jnp.issubdtype(p.dtype, jnp.floating):
-                return SequenceTensor.from_packed(
-                    p.astype(jnp.float32), f._offsets)
+            p = _cast(f._packed)
+            if p is f._packed:
+                return f
+            return SequenceTensor.from_packed(p, f._offsets)
+        d = _cast(f.data)
+        if d is f.data:
             return f
-        d = jnp.asarray(f.data)
-        if jnp.issubdtype(d.dtype, jnp.floating):
-            return SequenceTensor(d.astype(jnp.float32), f.lengths,
-                                  f.sub_lengths)
-        return f
-    if hasattr(f, 'dtype') and jnp.issubdtype(jnp.asarray(f).dtype,
-                                              jnp.floating):
-        return jnp.asarray(f).astype(jnp.float32)
+        return SequenceTensor(d, f.lengths, f.sub_lengths)
+    if hasattr(f, 'dtype'):
+        return _cast(f)
     return f
 
 
@@ -234,6 +253,16 @@ def program_cache_key(program, feed, static_env, fetch_names, state_in,
                          for n, v in static_env.items())),
             tuple(fetch_names), tuple(state_in), tuple(state_out),
             guard, lowering.MERGE_SHARED_MULS[0]) + tuple(extra)
+
+
+def _stack_steps(*xs):
+    """Stack K per-step feed leaves onto a leading [K] axis for
+    run_chained. Host numpy leaves stack on host, so the whole chunk
+    crosses to the device as ONE transfer at dispatch; device-resident
+    leaves stack on device."""
+    if all(isinstance(x, np.ndarray) for x in xs):
+        return np.stack(xs)
+    return jnp.stack([jnp.asarray(x) for x in xs])
 
 
 def _block_has(block, types):
@@ -627,7 +656,15 @@ class Executor(object):
 
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name='feed', fetch_var_name='fetch', scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True,
+            async_fetch=False):
+        """``async_fetch=True`` exploits JAX async dispatch: the fetches
+        come back as LAZY device handles (no host transfer, no sync) so
+        the caller's loop can enqueue the next step while this one still
+        executes; materialize later with ``as_numpy``/``np.asarray`` or
+        ``jax.block_until_ready``. Overrides ``return_numpy``. An
+        installed AnomalyGuard still observes every fetch (observation
+        materializes — guard correctness beats overlap)."""
         if program is None:
             program = default_main_program()
         if not isinstance(program, Program):
@@ -719,6 +756,9 @@ class Executor(object):
             # fetch (NaN/Inf policy for raw exe.run loops); no-op by
             # default
             _anomaly.observe_fetches(fetch_names, fetches)
+        if async_fetch:
+            # lazy device handles: dispatch returned, values unforced
+            return fetches
         if return_numpy:
             fetches = [as_numpy(f) for f in fetches]
         else:
@@ -727,6 +767,164 @@ class Executor(object):
             fetches = [SequenceTensor(f, None) if isinstance(
                 f, (jax.Array, np.ndarray)) else f for f in fetches]
         return fetches
+
+    def run_chained(self, program=None, feed_list=None, fetch_list=None,
+                    scope=None, return_numpy=True, async_fetch=False):
+        """Run K training steps as ONE device dispatch (PERF.md
+        "Dispatch pipelining").
+
+        ``feed_list`` is a list of K per-step feed dicts; the K prepared
+        feeds are stacked on a leading axis and executed through
+        :func:`core.lowering.lower_block_chained` (``lax.scan`` over the
+        single-step lowering, persistable state threaded through the
+        carry, state donated). Returns a list of K per-step fetch lists
+        — bit-exact vs K sequential :meth:`run` calls (same RNG splits,
+        same optimizer updates; pinned by tests/test_pipeline.py).
+
+        Falls back to sequential :meth:`run` calls (identical results,
+        K dispatches) whenever chaining can't hold: dynamic (eager)
+        programs, per-op profiling, checkify NaN-guard mode, program
+        readers, feeds whose specs differ across the chunk (ragged tail
+        batches), shape-feed values that differ, or persistable-state
+        churn mid-chunk.
+        """
+        if program is None:
+            program = default_main_program()
+        if not isinstance(program, Program):
+            raise TypeError("Executor requires Program as its Parameter."
+                            " But you passed in %s" % type(program))
+        feed_list = list(feed_list or [])
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+        if not feed_list:
+            return []
+
+        def _sequential():
+            return [self.run(program, feed=f, fetch_list=fetch_list,
+                             scope=scope, return_numpy=return_numpy,
+                             async_fetch=async_fetch)
+                    for f in feed_list]
+
+        k = len(feed_list)
+        dynamic = program.__dict__.setdefault(
+            '_dynamic_memo', {}).get(program.fingerprint())
+        if dynamic is None:
+            dynamic = _is_dynamic_program(program)
+            program._dynamic_memo[program.fingerprint()] = dynamic
+        from .debugging import nan_checks_enabled
+        from . import profiler as _prof
+        from .layers.io import ReaderVar
+        has_reader = any(
+            isinstance(v, ReaderVar) and getattr(v, 'source', None)
+            is not None
+            for v in program.global_block().vars.values())
+        if k == 1 or dynamic or nan_checks_enabled() or \
+                _prof.op_profiling_enabled() or has_reader:
+            return _sequential()
+
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+        prepped, static_envs = [], []
+        for f in feed_list:
+            pf = self._prepare_feed(program, dict(f))
+            static_envs.append(self._extract_static_feeds(program, pf))
+            prepped.append(pf)
+        specs = [tuple(sorted((n, _spec(v)) for n, v in pf.items()))
+                 for pf in prepped]
+        env0 = tuple(sorted((n, v.dtype.str, v.shape, v.tobytes())
+                            for n, v in static_envs[0].items()))
+        static_same = all(
+            tuple(sorted((n, v.dtype.str, v.shape, v.tobytes())
+                         for n, v in se.items())) == env0
+            for se in static_envs[1:])
+        if any(s != specs[0] for s in specs[1:]) or not static_same:
+            return _sequential()      # ragged tail / shape-feed churn
+
+        state_in_names, state_out_names = self._state_names(program,
+                                                            scope)
+        if scope.find_var(RNG_KEY) is None:
+            scope.set_var(RNG_KEY,
+                          jax.random.PRNGKey(program.random_seed or 0))
+        state_in_names = sorted(set(state_in_names) | {RNG_KEY})
+        state_out_names = sorted(set(state_out_names) | {RNG_KEY})
+        if state_in_names != state_out_names:
+            # the scan carry must be treedef-stable step to step; a
+            # program writing persistables absent from the scope would
+            # grow it mid-chain
+            return _sequential()
+
+        try:
+            stacked = jax.tree_util.tree_map(_stack_steps, *prepped)
+        except (ValueError, TypeError):
+            return _sequential()      # heterogeneous feed structure
+
+        key = program_cache_key(program, prepped[0], static_envs[0],
+                                fetch_names, state_in_names,
+                                state_out_names, False, 'chain')
+        t_lookup = time.perf_counter()
+        with self._cache_lock:
+            entry = self._cache.get(key)
+            if entry is None:
+                self._cache_misses += 1
+                _obs.emit('compile_begin', fp=key[0], chain=k)
+                lower_prog = self._maybe_prune(program, fetch_names)
+                fn = lowering.lower_block_chained(
+                    lower_prog, lower_prog.global_block(),
+                    sorted(prepped[0].keys()), fetch_names,
+                    state_in_names, state_out_names,
+                    static_env=static_envs[0])
+                jitted = jax.jit(fn, donate_argnums=(1,))
+                self._cache[key] = jitted
+            else:
+                self._cache_hits += 1
+                jitted = entry
+        was_miss = entry is None
+        (self._m_misses if was_miss else self._m_hits).inc()
+
+        state = {n: scope.raw(n) for n in state_in_names}
+        t_run = time.perf_counter()
+        with jax.default_device(self.place.jax_device()):
+            # commit the state to the run device BEFORE the first call:
+            # prefetch-staged feeds arrive committed, while fresh
+            # startup state is uncommitted — without this the second
+            # chunk's jit signature differs (state now = committed jit
+            # outputs) and silently retraces+recompiles the whole
+            # K-step program once more. device_put on already-committed
+            # same-device arrays is a no-op.
+            state = jax.device_put(state, self.place.jax_device())
+            fetches, new_state = jitted(stacked, state)
+        run_wall = time.perf_counter() - t_run
+        self._m_run.observe(run_wall)
+        h, m = self._m_hits.value, self._m_misses.value
+        self._m_hit_rate.set(h / (h + m) if h + m else 0.0)
+        if was_miss:
+            compile_wall = time.perf_counter() - t_lookup
+            self._m_compile.observe(compile_wall)
+            _obs.emit('compile_end', fp=key[0], chain=k,
+                      dur_s=round(compile_wall, 6))
+        if _obs.journal_active():
+            _obs.emit('exe_run', cache='miss' if was_miss else 'hit',
+                      fp=key[0], chain=k, dur_s=round(run_wall, 6))
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if getattr(program, '_half_inference', None):
+            fetches = [_to_f32_fetch(f) for f in fetches]
+        anomaly_on = _anomaly.any_active()
+        steps_out = []
+        for i in range(k):
+            row = [jax.tree_util.tree_map(lambda x: x[i], f)
+                   for f in fetches]
+            if anomaly_on:
+                _anomaly.observe_fetches(fetch_names, row)
+            if async_fetch:
+                pass
+            elif return_numpy:
+                row = [as_numpy(f) for f in row]
+            else:
+                row = [SequenceTensor(f, None) if isinstance(
+                    f, (jax.Array, np.ndarray)) else f for f in row]
+            steps_out.append(row)
+        return steps_out
 
     def cost_analysis(self, program, feed, fetch_list, scope=None):
         """XLA's own ledger for the step this program compiles to:

@@ -123,6 +123,12 @@ def batch(reader, batch_size):
 
 
 def double_buffer(reader, place=None, name=None):
+    """Overlap host batch production with device compute (parity:
+    reference layers/io.py::double_buffer / create_double_buffer_reader).
+    A worker thread pulls ahead into a bounded 2-deep queue through
+    :class:`paddle_tpu.reader.prefetch.PrefetchPipeline`; when ``place``
+    is given, each batch is additionally ``jax.device_put`` onto that
+    place ON the worker, so the H2D transfer is prepaid too."""
     reader.decorators.append(('double_buffer', place))
     return reader
 

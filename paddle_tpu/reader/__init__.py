@@ -10,7 +10,15 @@ import queue as Queue
 
 __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
            'ComposeNotAligned', 'firstn', 'xmap_readers', 'batch',
-           'retry_reader']
+           'retry_reader', 'PrefetchPipeline', 'prefetch_feeds',
+           'stage_on_device']
+
+
+def __getattr__(name):  # lazy: prefetch pulls jax only when staging
+    if name in ('PrefetchPipeline', 'prefetch_feeds', 'stage_on_device'):
+        from . import prefetch as _prefetch
+        return getattr(_prefetch, name)
+    raise AttributeError(name)
 
 
 def retry_reader(reader, max_attempts=3, backoff=0.05, jitter=0.1,

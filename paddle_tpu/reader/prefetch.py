@@ -1,0 +1,196 @@
+"""Bounded async host-prefetch pipeline (PERF.md "Dispatch pipelining").
+
+The training hot loop's host work — pulling the next reader batch,
+``DataFeeder`` conversion, the H2D ``jax.device_put`` — runs serially
+with device compute unless something pulls it ahead.
+:class:`PrefetchPipeline` is that something: a daemon worker thread
+drains the source through an optional ``transform`` (batch -> feed
+dict) and optional device staging into a bounded queue, so by the time
+the consuming step asks for batch *i+1* its host cost has already been
+paid while the device was busy with batch *i*.
+
+Contract (mirrors the reference's create_double_buffer_reader /
+create_threaded_reader semantics, reader_io.iterate_reader):
+
+- **order-preserving** — one worker, one FIFO queue;
+- **bounded** — at most ``depth`` converted batches are ever ahead
+  (memory stays O(depth), and a slow consumer back-pressures the
+  source);
+- **exception propagation** — a source/transform error surfaces at the
+  consumer exactly where the stream broke, with the original exception
+  object (not an EOF);
+- **clean shutdown** — ``close()`` (or abandoning the iterator: break,
+  GC, ``with`` exit) stops the worker promptly; the worker never blocks
+  forever on a full queue, and a worker that dies without signalling is
+  detected instead of hanging the consumer.
+
+``layers.io.double_buffer(place=...)`` and
+``Trainer.train(prefetch=N)`` both route through this class.
+"""
+import queue
+import threading
+
+__all__ = ['PrefetchPipeline', 'stage_on_device', 'prefetch_feeds']
+
+_END = object()
+
+
+class _Err(object):
+    __slots__ = ('exc',)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def stage_on_device(value, place):
+    """``jax.device_put`` a batch/feed (dict, tuple, SequenceTensor —
+    any pytree) onto ``place``'s device. ``place`` may be a
+    core.places.Place, a raw jax Device, or None (no staging)."""
+    if place is None:
+        return value
+    import jax
+    device = place.jax_device() if hasattr(place, 'jax_device') else place
+    return jax.device_put(value, device)
+
+
+class PrefetchPipeline(object):
+    """Iterate a reader ahead of its consumer through a bounded queue.
+
+    ``source``: a reader callable (paddle convention: ``source()``
+    yields batches) or a plain iterable. ``transform``: optional
+    per-batch host conversion (e.g. ``feeder.feed``) executed on the
+    WORKER thread — that is the whole point. ``place``: optional device
+    place; transformed batches are ``jax.device_put`` onto it, still on
+    the worker, so H2D transfer overlaps the consuming step too.
+    """
+
+    def __init__(self, source, transform=None, depth=2, place=None):
+        if depth < 1:
+            raise ValueError('prefetch depth must be >= 1, got %r'
+                             % (depth,))
+        self._source = source
+        self._transform = transform
+        self._place = place
+        self._queue = queue.Queue(maxsize=int(depth))
+        self._stop = threading.Event()
+        self._thread = None
+        self._consumed = False
+
+    # ---- worker side ----------------------------------------------------
+    def _offer(self, item):
+        # never block forever on a full queue: an abandoned consumer
+        # (close(), break, interpreter teardown) sets _stop
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            it = self._source() if callable(self._source) \
+                else iter(self._source)
+            for batch in it:
+                if self._stop.is_set():
+                    return
+                if self._transform is not None:
+                    batch = self._transform(batch)
+                if self._place is not None:
+                    batch = stage_on_device(batch, self._place)
+                if not self._offer(batch):
+                    return
+        except BaseException as e:  # surface at the consumer, not EOF
+            self._offer(_Err(e))
+            return
+        self._offer(_END)
+
+    # ---- consumer side --------------------------------------------------
+    def _start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._worker, name='paddle_tpu-prefetch',
+                daemon=True)
+            self._thread.start()
+
+    def __iter__(self):
+        # plain method (not a generator) so the single-use check and
+        # worker start happen AT iter() time, not first next()
+        if self._consumed:
+            raise RuntimeError(
+                'PrefetchPipeline is single-use: build a fresh one per '
+                'pass (Trainer does, once per epoch)')
+        self._consumed = True
+        self._start()
+        return self._drain()
+
+    def _drain(self):
+        try:
+            while True:
+                try:
+                    item = self._queue.get(timeout=5.0)
+                except queue.Empty:
+                    # liveness check: a worker killed without posting
+                    # _END/_Err (daemon teardown mid-put) must raise,
+                    # not hang the trainer forever
+                    if not self._thread.is_alive():
+                        raise RuntimeError(
+                            'prefetch worker thread died without '
+                            'signalling end-of-data')
+                    continue
+                if item is _END:
+                    return
+                if isinstance(item, _Err):
+                    raise item.exc
+                yield item
+        finally:
+            self.close()
+
+    def close(self, timeout=5.0):
+        """Stop the worker and release queue slots. Idempotent; safe
+        from any thread."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive() and \
+                t is not threading.current_thread():
+            # unblock a worker parked in put(): drain whatever is queued
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):  # pragma: no cover - GC timing dependent
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+
+def prefetch_feeds(reader, feeder, depth=2, place=None):
+    """Convenience: iterate ``reader()`` batches as ``(batch_size,
+    feed_dict)`` pairs with conversion (and optional device staging)
+    running ``depth`` batches ahead on a worker thread."""
+
+    def _convert(data):
+        try:
+            n = len(data)
+        except TypeError:
+            n = 0
+        feed = feeder.feed(data)
+        if place is not None:
+            # stage only the feed dict — the count stays a host int
+            feed = stage_on_device(feed, place)
+        return n, feed
+
+    pipe = PrefetchPipeline(reader, transform=_convert, depth=depth)
+    return iter(pipe)

@@ -190,64 +190,18 @@ def iterate_reader(reader_var):
                                 for i in range(len(cur[0])))
         elif kind in ('parallel', 'double_buffer'):
             # threaded prefetch (ref create_threaded_reader /
-            # create_double_buffer_reader): a daemon thread pulls
-            # ahead into a bounded queue; order is preserved
-            def it_fn(prev=prev, depth=4 if kind == 'parallel' else 2):
-                import queue
-                import threading
-                q = queue.Queue(maxsize=depth)
-                END = object()
-
-                class _Err(object):
-                    def __init__(self, exc):
-                        self.exc = exc
-
-                stop = threading.Event()
-
-                def offer(item):
-                    # never block forever: an abandoned consumer
-                    # (reader.reset(), early break) sets `stop`
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            return True
-                        except queue.Full:
-                            continue
-                    return False
-
-                def worker():
-                    try:
-                        for item in prev():
-                            if not offer(item):
-                                return
-                    except BaseException as e:  # surface, don't EOF
-                        offer(_Err(e))
-                        return
-                    offer(END)
-
-                t = threading.Thread(target=worker, daemon=True)
-                t.start()
-                try:
-                    while True:
-                        # bounded wait + liveness check: if the worker
-                        # dies without posting END/_Err (interpreter
-                        # teardown killing the daemon mid-put), raise
-                        # instead of blocking forever (ADVICE r4)
-                        try:
-                            item = q.get(timeout=5.0)
-                        except queue.Empty:
-                            if not t.is_alive():
-                                raise RuntimeError(
-                                    "prefetch worker thread died "
-                                    "without signalling end-of-data")
-                            continue
-                        if item is END:
-                            return
-                        if isinstance(item, _Err):
-                            raise item.exc
-                        yield item
-                finally:
-                    stop.set()
+            # create_double_buffer_reader) through the shared
+            # PrefetchPipeline: a daemon thread pulls ahead into a
+            # bounded queue; order preserved, errors propagate, clean
+            # shutdown on abandonment. double_buffer(place=...) stages
+            # each pulled batch onto that device ON THE WORKER, so the
+            # H2D copy overlaps the consuming step instead of silently
+            # ignoring the requested place.
+            def it_fn(prev=prev, depth=4 if kind == 'parallel' else 2,
+                      place=arg if kind == 'double_buffer' else None):
+                from .reader.prefetch import PrefetchPipeline
+                return iter(PrefetchPipeline(prev, depth=depth,
+                                             place=place))
         else:  # pragma: no cover - unknown decorators pass through
             it_fn = prev
     return it_fn()

@@ -140,13 +140,34 @@ class Trainer(object):
 
     def train(self, num_epochs, event_handler, reader=None,
               feed_order=None, checkpoint_config=None,
-              anomaly_guard=None):
+              anomaly_guard=None, prefetch=0, steps_per_dispatch=1,
+              sync_interval=1):
         """``checkpoint_config``: a resilience.CheckpointConfig — save
         progress every ``step_interval`` steps / ``epoch_interval``
         epochs through the atomic checkpoint protocol and auto-resume
         from the newest healthy serial when one exists.
         ``anomaly_guard``: a resilience.AnomalyGuard screening feeds,
-        losses and (optionally) gradient norms each step."""
+        losses and (optionally) gradient norms each step.
+
+        Pipelining knobs (PERF.md "Dispatch pipelining"; bit-exact vs
+        the default step-by-step loop, pinned by tests/test_pipeline.py):
+
+        ``prefetch=N``: run reader pulls + DataFeeder conversion + H2D
+        staging N batches ahead on a background thread
+        (reader.prefetch.PrefetchPipeline), so host feed work overlaps
+        device compute. ``trainer_host_wait_seconds`` measures what the
+        loop still waits for.
+
+        ``steps_per_dispatch=K``: chain K steps into ONE device
+        dispatch (``Executor.run_chained``); amortizes per-dispatch
+        latency. Partial tails and shape changes fall back to
+        sequential steps automatically. Requires the plain Executor
+        path (``parallel=False``).
+
+        ``sync_interval=M``: materialize fetched losses only every M
+        steps — between syncs, ``EndStepEvent.metrics`` carry LAZY
+        device values (``np.asarray`` them to force). Ignored (forced
+        to 1) when an ``anomaly_guard`` must inspect every loss."""
         if checkpoint_config is not None and not isinstance(
                 checkpoint_config, CheckpointConfig):
             raise TypeError('checkpoint_config must be a '
@@ -155,8 +176,17 @@ class Trainer(object):
                 anomaly_guard, AnomalyGuard):
             raise TypeError('anomaly_guard must be a '
                             'resilience.AnomalyGuard')
+        if int(prefetch) < 0:
+            raise ValueError('prefetch must be >= 0')
+        if int(steps_per_dispatch) < 1:
+            raise ValueError('steps_per_dispatch must be >= 1')
+        if int(sync_interval) < 1:
+            raise ValueError('sync_interval must be >= 1')
         self._checkpoint_config = checkpoint_config
         self._anomaly_guard = anomaly_guard
+        self._prefetch = int(prefetch)
+        self._steps_per_dispatch = int(steps_per_dispatch)
+        self._sync_interval = int(sync_interval)
         if self.parallel:
             self._train_by_parallel_executor(num_epochs, event_handler,
                                              reader, feed_order)
@@ -292,12 +322,44 @@ class Trainer(object):
                                 '(%s)', err)
         return 'skip'
 
+    def _feed_stream(self, reader, feeder, prefetch, stage_place):
+        """(examples, feed_dict) pairs. ``prefetch > 0`` moves reader
+        pulls + DataFeeder conversion + H2D staging onto a background
+        pipeline; the consumer-side ``next()`` wait is then the
+        measured ``trainer_host_wait_seconds`` — near zero when the
+        host keeps up, the host-bound fraction when it does not.
+        ``stage_place`` is None on the ParallelExecutor path: feeds
+        must stay host-side numpy so pjit shards them over the mesh
+        (a single-device commit would fight the NamedSharding)."""
+        if prefetch > 0:
+            from .reader.prefetch import prefetch_feeds
+            return prefetch_feeds(reader, feeder, depth=prefetch,
+                                  place=stage_place)
+
+        def gen():
+            for data in reader():
+                try:
+                    n = len(data)
+                except TypeError:
+                    n = 0
+                yield n, feeder.feed(data)
+        return gen()
+
     def _train_loop(self, event_handler, exe, num_epochs, reader, feeder):
         fetch_names = [v.name for v in self.train_func_outputs]
         guard = self._anomaly_guard = getattr(self, '_anomaly_guard',
                                               None)
         cfg = self._checkpoint_config = getattr(self, '_checkpoint_config',
                                                 None)
+        prefetch = getattr(self, '_prefetch', 0)
+        chain_k = getattr(self, '_steps_per_dispatch', 1)
+        sync_interval = getattr(self, '_sync_interval', 1)
+        is_pe = isinstance(exe, parallel_executor.ParallelExecutor)
+        if is_pe:
+            chain_k = 1      # run_chained is a plain-Executor feature
+        if guard is not None:
+            sync_interval = 1    # the guard inspects every loss
+        lazy = sync_interval > 1 and not is_pe
         grad_names = []
         if guard is not None and guard.monitor_gradients:
             grad_names = self._grad_fetch_names()
@@ -321,50 +383,49 @@ class Trainer(object):
             'trainer_time_to_first_step_seconds',
             'train() entry to first completed step (compile included)')
         m_loss = reg.gauge('trainer_last_loss', 'last fetched loss')
+        m_host_wait = reg.histogram(
+            'trainer_host_wait_seconds',
+            'time the train loop blocked on the next host batch (feed '
+            'conversion + H2D not overlapped by prefetch)')
+        m_dispatch = reg.histogram(
+            'trainer_dispatch_seconds',
+            'Executor dispatch wall per chunk (1 step, or K chained)')
         loop_t0 = time.monotonic()
         steps_done = examples_done = 0
         _obs.emit('train_begin', epochs=num_epochs,
-                  start_epoch=start_epoch, global_step=global_step)
-        for epoch_id in range(start_epoch, num_epochs):
-            event_handler(BeginEpochEvent(epoch_id))
-            _obs.emit('epoch_begin', epoch=epoch_id)
-            epoch_t0 = time.monotonic()
-            epoch_steps0 = steps_done
-            for step_id, data in enumerate(reader()):
-                if self.__stop:
-                    return
-                if epoch_id == start_epoch and step_id <= resume_step:
-                    continue  # completed before the restart
-                begin = BeginStepEvent(epoch_id, step_id)
-                event_handler(begin)
-                _obs.emit('step_begin', epoch=epoch_id, step=step_id,
-                          global_step=global_step)
-                step_t0 = time.monotonic()
-                feed = feeder.feed(data)
-                if guard is not None and guard.check_feeds:
-                    err = guard.inspect_feed(feed)
-                    if err is not None and self._handle_anomaly(
-                            err, reload_exe) == 'skip':
-                        # batch never reaches the device: params stay
-                        # clean; the event stream still advances so
-                        # step counts match an un-poisoned run
-                        global_step += 1
-                        _obs.emit('step_end', epoch=epoch_id,
-                                  step=step_id, global_step=global_step,
-                                  skipped='anomaly')
-                        event_handler(EndStepEvent(epoch_id, step_id,
-                                                   None))
-                        continue
-                want_fetch = begin.fetch_metrics or bool(grad_names)
-                run_fetches = (fetch_names + grad_names) if want_fetch \
-                    else []
-                if isinstance(exe, parallel_executor.ParallelExecutor):
-                    outs = exe.run(run_fetches, feed=feed)
-                else:
-                    outs = exe.run(feed=feed, fetch_list=run_fetches)
+                  start_epoch=start_epoch, global_step=global_step,
+                  prefetch=prefetch, steps_per_dispatch=chain_k)
+
+        def flush(epoch_id, chunk):
+            """Dispatch a collected chunk (1 step, or K chained) and run
+            the per-step bookkeeping/events for each member."""
+            nonlocal global_step, steps_done, examples_done
+            want_fetch = bool(grad_names) or any(
+                b.fetch_metrics for _, b, _, _, _ in chunk)
+            run_fetches = (fetch_names + grad_names) if want_fetch \
+                else []
+            gs0 = global_step
+            t0 = time.monotonic()
+            if len(chunk) > 1:
+                outs_steps = exe.run_chained(
+                    feed_list=[c[2] for c in chunk],
+                    fetch_list=run_fetches, async_fetch=lazy)
+            elif is_pe:
+                outs_steps = [exe.run(run_fetches, feed=chunk[0][2])]
+            else:
+                outs_steps = [exe.run(feed=chunk[0][2],
+                                      fetch_list=run_fetches,
+                                      async_fetch=lazy)]
+            dispatch_wall = time.monotonic() - t0
+            m_dispatch.observe(dispatch_wall)
+            per_step = dispatch_wall / len(chunk)
+            for (step_id, begin, feed, examples, wait_s), outs in zip(
+                    chunk, outs_steps):
                 metrics = outs[:len(fetch_names)] if want_fetch else outs
                 grad_norm = None
                 if guard is not None and want_fetch:
+                    # guard active => sync_interval forced to 1, so the
+                    # metrics here are concrete (materialized) values
                     err = None
                     if guard.check_metrics and metrics:
                         err = guard.inspect_loss(metrics[0])
@@ -378,13 +439,9 @@ class Trainer(object):
                         # restores the last good params; 'raise' stops
                         self._handle_anomaly(err, reload_exe)
                 global_step += 1
-                step_wall = time.monotonic() - step_t0
                 steps_done += 1
-                try:
-                    examples = len(data)
-                except TypeError:
-                    examples = 0
                 examples_done += examples
+                step_wall = wait_s + per_step
                 elapsed = time.monotonic() - loop_t0
                 m_steps.inc()
                 m_examples.inc(examples)
@@ -394,27 +451,95 @@ class Trainer(object):
                     m_examples_ps.set(examples_done / elapsed)
                 if steps_done == 1:
                     m_ttfs.set(elapsed)
-                loss = _scalar_or_none(metrics[0]) if metrics else None
+                loss = None
+                if metrics and (not lazy or
+                                global_step % sync_interval == 0):
+                    # materialization point: with sync_interval=M only
+                    # every M-th step pays the device->host loss sync
+                    loss = _scalar_or_none(metrics[0])
                 if loss is not None:
                     m_loss.set(loss)
                 if _obs.journal_active():
                     rec = {'epoch': epoch_id, 'step': step_id,
                            'global_step': global_step,
                            'dur_s': round(step_wall, 6),
+                           'feed_wait': round(wait_s, 6),
+                           'dispatch_s': round(per_step, 6),
                            'examples': examples,
                            'examples_per_s': round(
                                examples_done / elapsed, 3)
                            if elapsed > 0 else 0.0}
+                    if len(chunk) > 1:
+                        rec['chain'] = len(chunk)
                     if loss is not None:
                         rec['loss'] = loss
                     if grad_norm is not None:
                         rec['grad_norm'] = grad_norm
                     _obs.emit('step_end', **rec)
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                if cfg is not None and \
-                        global_step % cfg.step_interval == 0:
-                    self._save_progress_checkpoint(cfg, epoch_id,
-                                                   step_id, global_step)
+            if cfg is not None and (global_step // cfg.step_interval) \
+                    > (gs0 // cfg.step_interval):
+                # chunk-granular: the scope holds chunk-END state, so
+                # the checkpoint records the chunk's last step (for
+                # K=1 this is exactly the old per-step behavior)
+                self._save_progress_checkpoint(cfg, epoch_id,
+                                               chunk[-1][0], global_step)
+
+        for epoch_id in range(start_epoch, num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            _obs.emit('epoch_begin', epoch=epoch_id)
+            epoch_t0 = time.monotonic()
+            epoch_steps0 = steps_done
+            stream = self._feed_stream(reader, feeder, prefetch,
+                                       None if is_pe else self.place)
+            try:
+                step_id = -1
+                chunk = []   # [(step_id, begin, feed, examples, wait_s)]
+                while True:
+                    if self.__stop:
+                        return
+                    t_wait = time.monotonic()
+                    try:
+                        examples, feed = next(stream)
+                    except StopIteration:
+                        break
+                    wait_s = time.monotonic() - t_wait
+                    step_id += 1
+                    if epoch_id == start_epoch and \
+                            step_id <= resume_step:
+                        continue  # completed before the restart
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    _obs.emit('step_begin', epoch=epoch_id,
+                              step=step_id, global_step=global_step)
+                    m_host_wait.observe(wait_s)
+                    if guard is not None and guard.check_feeds:
+                        err = guard.inspect_feed(feed)
+                        if err is not None and self._handle_anomaly(
+                                err, reload_exe) == 'skip':
+                            # batch never reaches the device: params
+                            # stay clean; the event stream still
+                            # advances so step counts match an
+                            # un-poisoned run
+                            global_step += 1
+                            _obs.emit('step_end', epoch=epoch_id,
+                                      step=step_id,
+                                      global_step=global_step,
+                                      skipped='anomaly')
+                            event_handler(EndStepEvent(epoch_id,
+                                                       step_id, None))
+                            continue
+                    chunk.append((step_id, begin, feed, examples,
+                                  wait_s))
+                    if len(chunk) >= chain_k:
+                        flush(epoch_id, chunk)
+                        chunk = []
+                if chunk:
+                    flush(epoch_id, chunk)   # epoch tail (< K steps)
+            finally:
+                close = getattr(stream, 'close', None)
+                if close is not None:
+                    close()   # stop the prefetch worker promptly
             event_handler(EndEpochEvent(epoch_id))
             epoch_wall = time.monotonic() - epoch_t0
             _obs.emit('epoch_end', epoch=epoch_id,

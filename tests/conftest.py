@@ -54,3 +54,8 @@ def pytest_configure(config):
         'chaos: deterministic chaos-harness tests of the serving SLO '
         'guardrails — breaker/watchdog/drain/close escalation (tier-1; '
         'filter with -m "not chaos")')
+    config.addinivalue_line(
+        'markers',
+        'pipeline: tests of the pipelined training hot loop — async '
+        'prefetch, K-step chained dispatch, non-blocking fetch '
+        '(tier-1; filter with -m "not pipeline")')

@@ -1,0 +1,515 @@
+"""Pipelined training hot loop (PERF.md "Dispatch pipelining").
+
+Pins the PR-5 acceptance contracts:
+- `Executor.run_chained` / `Trainer.train(steps_per_dispatch=K)` are
+  BIT-exact vs the step-by-step loop — params, optimizer accumulators,
+  RNG key and every per-step loss, over multiple dispatches including a
+  ragged tail batch;
+- the async prefetch pipeline preserves order, propagates source
+  exceptions at the break point, and shuts down cleanly;
+- `DataFeeder.feed`'s dense fast path is value-identical to the
+  per-row converter path;
+- `layers.io.double_buffer(place=)` actually stages batches on the
+  requested place;
+- the new journal fields gate through `obs_report --require pipeline`.
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import executor as exe_mod
+from paddle_tpu import observability as obs
+from paddle_tpu import unique_name
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.reader.prefetch import PrefetchPipeline, prefetch_feeds
+
+pytestmark = pytest.mark.pipeline
+
+TOOLS = os.path.join(os.path.dirname(__file__), '..', 'tools')
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import obs_report  # noqa: E402  (tools/ has no package __init__)
+
+
+# ---- helpers -------------------------------------------------------------
+def _build_train_program(dropout=True):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start), unique_name.guard():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=8, act='relu')
+        if dropout:
+            h = fluid.layers.dropout(h, dropout_prob=0.3)
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return main, start, loss
+
+
+def _feeds(n_steps=8, batch=16, ragged_tail=True, seed=0):
+    rng = np.random.RandomState(seed)
+    feeds = [{'x': rng.randn(batch, 4).astype('float32'),
+              'y': rng.randn(batch, 1).astype('float32')}
+             for _ in range(n_steps - 1)]
+    tail = batch - 11 if ragged_tail else batch
+    feeds.append({'x': rng.randn(tail, 4).astype('float32'),
+                  'y': rng.randn(tail, 1).astype('float32')})
+    return feeds
+
+
+def _scope_arrays(scope):
+    return {n: np.asarray(scope.raw(n)) for n in scope.keys()
+            if scope.raw(n) is not None and
+            hasattr(scope.raw(n), 'shape')}
+
+
+def _run_sequential(feeds):
+    main, start, loss = _build_train_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(start)
+        losses = [np.asarray(exe.run(main, feed=f, fetch_list=[loss])[0])
+                  for f in feeds]
+    return losses, _scope_arrays(scope)
+
+
+# ---- chained-vs-sequential bit-exactness ---------------------------------
+def test_run_chained_bitexact_vs_sequential():
+    """≥3 dispatches incl. a ragged tail: losses, params, Adam moment
+    accumulators and the PRNG key all match the step-by-step run BIT
+    for bit (dropout exercises the RNG thread-through)."""
+    feeds = _feeds(n_steps=8, ragged_tail=True)
+    seq_losses, seq_state = _run_sequential(feeds)
+
+    main, start, loss = _build_train_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(start)
+        ch_losses = []
+        for i in range(0, len(feeds), 3):     # dispatches: 3 + 3 + 2
+            for row in exe.run_chained(main, feed_list=feeds[i:i + 3],
+                                       fetch_list=[loss]):
+                ch_losses.append(np.asarray(row[0]))
+    ch_state = _scope_arrays(scope)
+
+    assert len(seq_losses) == len(ch_losses) == len(feeds)
+    for i, (a, b) in enumerate(zip(seq_losses, ch_losses)):
+        assert np.array_equal(a, b), 'loss diverged at step %d' % i
+    assert set(seq_state) == set(ch_state)
+    # params, fc biases, Adam moments/beta-pows, RNG key: everything
+    # persistable must be identical — and the Adam accumulators prove
+    # optimizer state threaded through the scan carry correctly
+    assert any('moment' in n for n in seq_state), seq_state.keys()
+    for n in seq_state:
+        assert np.array_equal(seq_state[n], ch_state[n]), n
+
+
+def test_run_chained_compile_count():
+    """One chained compile serves every full chunk; the ragged tail
+    falls back to sequential single-step runs (documented fallback)."""
+    feeds = _feeds(n_steps=7, ragged_tail=False)
+    main, start, loss = _build_train_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(start)
+        exe.reset_cache_info()
+        for i in range(0, 6, 3):
+            exe.run_chained(main, feed_list=feeds[i:i + 3],
+                            fetch_list=[loss])
+        info = exe.cache_info()
+        assert info.misses == 1 and info.hits == 1
+        # tail chunk of 1 delegates to run(): a fresh 1-step compile
+        exe.run_chained(main, feed_list=feeds[6:], fetch_list=[loss])
+        assert exe.cache_info().misses == 2
+
+
+def test_run_chained_fallback_guard_and_async():
+    """NaN-guard mode must fall back to sequential runs (checkify can't
+    thread the scan) with identical results; async_fetch returns lazy
+    device values that materialize to the same numbers."""
+    from paddle_tpu import debugging
+    feeds = _feeds(n_steps=3, ragged_tail=False)
+    seq_losses, _ = _run_sequential(feeds)
+
+    main, start, loss = _build_train_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(start)
+        with debugging.nan_guard():
+            rows = exe.run_chained(main, feed_list=feeds,
+                                   fetch_list=[loss])
+        for a, row in zip(seq_losses, rows):
+            assert np.array_equal(a, np.asarray(row[0]))
+
+    main, start, loss = _build_train_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(start)
+        rows = exe.run_chained(main, feed_list=feeds, fetch_list=[loss],
+                               async_fetch=True)
+        assert all(isinstance(r[0], jax.Array) for r in rows)
+        for a, row in zip(seq_losses, rows):
+            assert np.array_equal(a, np.asarray(row[0]))
+
+
+def test_run_async_fetch_is_lazy_and_equal():
+    feeds = _feeds(n_steps=2, ragged_tail=False)
+    main, start, loss = _build_train_program(dropout=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(start)
+        lazy, = exe.run(main, feed=feeds[0], fetch_list=[loss],
+                        async_fetch=True)
+        assert isinstance(lazy, jax.Array)
+
+    main, start, loss = _build_train_program(dropout=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(start)
+        sync, = exe.run(main, feed=feeds[0], fetch_list=[loss])
+    assert np.array_equal(np.asarray(lazy), sync)
+
+
+# ---- Trainer product path ------------------------------------------------
+def _trainer_reader(n=70, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, 4).astype('float32')
+    ys = (xs @ np.array([1., -2., 3., .5], np.float32))[:, None] + 0.1
+
+    def r():
+        for i in range(0, n, batch):
+            yield list(zip(xs[i:i + batch], ys[i:i + batch]))
+    return r
+
+
+def _trainer_train_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    h = fluid.layers.fc(input=x, size=8, act='relu',
+                        param_attr=fluid.ParamAttr(name='pl_w1'))
+    h = fluid.layers.dropout(h, dropout_prob=0.2)
+    pred = fluid.layers.fc(input=h, size=1,
+                           param_attr=fluid.ParamAttr(name='pl_w2'))
+    return fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+
+
+def _train_once(**train_kw):
+    losses, events = [], {'begin': 0, 'end': 0, 'epochs': 0}
+
+    def handler(ev):
+        if isinstance(ev, fluid.BeginStepEvent):
+            events['begin'] += 1
+        elif isinstance(ev, fluid.EndStepEvent):
+            events['end'] += 1
+            if ev.metrics:
+                losses.append(np.asarray(ev.metrics[0]).copy())
+        elif isinstance(ev, fluid.EndEpochEvent):
+            events['epochs'] += 1
+
+    tr = fluid.Trainer(train_func=_trainer_train_func,
+                       optimizer=fluid.optimizer.Adam(learning_rate=0.01),
+                       place=fluid.CPUPlace())
+    tr.train(num_epochs=3, event_handler=handler,
+             reader=_trainer_reader(), feed_order=['x', 'y'], **train_kw)
+    state = {n: np.asarray(tr.scope.raw(n)) for n in ('pl_w1', 'pl_w2')}
+    state['rng'] = np.asarray(tr.scope.raw('__rng__'))
+    return losses, state, events
+
+
+def test_trainer_pipelined_bitexact():
+    """The acceptance contract: `train(steps_per_dispatch=K,
+    prefetch=N)` (+ deferred sync) is bit-exact vs the default loop —
+    same per-step losses, same params, same RNG — across epochs whose
+    last batch is ragged (70 % 16 != 0)."""
+    base_losses, base_state, base_ev = _train_once()
+    pipe_losses, pipe_state, pipe_ev = _train_once(
+        prefetch=2, steps_per_dispatch=3, sync_interval=2)
+    assert base_ev == pipe_ev
+    assert len(base_losses) == len(pipe_losses)
+    for i, (a, b) in enumerate(zip(base_losses, pipe_losses)):
+        assert np.array_equal(a, b), 'loss diverged at step %d' % i
+    for n in base_state:
+        assert np.array_equal(base_state[n], pipe_state[n]), n
+
+
+def test_trainer_pipeline_metrics_and_journal(tmp_path):
+    """step_end journal records carry feed_wait/dispatch_s (+ chain for
+    chained chunks); the host-wait histogram fills; and the new
+    obs_report `--require pipeline` gate passes on such a journal and
+    fails on one without pipeline fields."""
+    path = str(tmp_path / 'run.jsonl')
+    reg = obs.default_registry()
+    host_wait = reg.histogram('trainer_host_wait_seconds')
+    dispatch = reg.histogram('trainer_dispatch_seconds')
+    w0, d0 = host_wait.count, dispatch.count
+    with obs.journal(path):
+        _train_once(prefetch=2, steps_per_dispatch=3)
+    assert host_wait.count > w0
+    assert dispatch.count > d0
+    records, malformed = obs.read_journal(path)
+    assert malformed == 0
+    steps = [r for r in records if r['ev'] == 'step_end']
+    assert steps and all('feed_wait' in r and 'dispatch_s' in r
+                         for r in steps)
+    assert any(r.get('chain', 0) > 1 for r in steps)
+    assert obs_report.check_journal(path, require='pipeline') == []
+    # a journal whose steps lack pipeline fields must NOT pass the gate
+    bare = str(tmp_path / 'bare.jsonl')
+    with open(bare, 'w') as f:
+        f.write('{"ev":"run_begin","run":"x","t":0.0,"schema":1}\n')
+        f.write('{"ev":"step_end","run":"x","t":0.1,"dur_s":0.1}\n')
+    assert obs_report.check_journal(bare, require='pipeline') != []
+    assert obs_report.check_journal(bare, require='step') == []
+    # and the summary/render surface the host-bound fraction
+    summary = obs_report.summarize(records)
+    assert summary['pipeline']['steps_with_feed_wait'] == len(steps)
+    assert summary['pipeline']['chained_steps'] > 0
+    assert 'pipeline' in obs_report.render(summary)
+
+
+def test_trainer_parallel_path_clamps_pipelining_knobs():
+    """parallel=True (ParallelExecutor): steps_per_dispatch clamps to 1
+    and prefetch must NOT device-commit feeds (pjit shards host numpy
+    over the mesh — a single-device commit fights the NamedSharding);
+    training still runs and converges."""
+    losses = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndStepEvent) and ev.metrics:
+            losses.append(float(np.asarray(ev.metrics[0]).ravel()[0]))
+
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+
+    tr = fluid.Trainer(train_func=train_func, parallel=True,
+                       optimizer=fluid.optimizer.SGD(learning_rate=0.05))
+    tr.train(num_epochs=3, event_handler=handler,
+             reader=_trainer_reader(n=64, batch=32),
+             feed_order=['x', 'y'], prefetch=2, steps_per_dispatch=4,
+             sync_interval=4)
+    assert losses and losses[-1] < losses[0]
+
+
+def test_trainer_anomaly_guard_still_observes_chained():
+    """A guard with skip_batch policy sees every loss even under
+    chaining (sync_interval is forced to 1; losses stay concrete)."""
+    from paddle_tpu.resilience import AnomalyGuard
+    guard = AnomalyGuard(policy='skip_batch', check_feeds=True)
+    losses, _, ev = _train_once(steps_per_dispatch=3,
+                                sync_interval=4, anomaly_guard=guard)
+    assert ev['end'] == ev['begin']
+    assert losses and all(np.isfinite(l).all() for l in losses)
+
+
+# ---- prefetch pipeline ---------------------------------------------------
+def test_prefetch_ordering_and_transform_thread():
+    """Order preserved end-to-end; the transform runs on the worker
+    thread (that is what buys the overlap)."""
+    main_thread = threading.current_thread()
+    seen_threads = set()
+
+    def transform(x):
+        seen_threads.add(threading.current_thread())
+        return x * 2
+
+    pipe = PrefetchPipeline(iter(range(100)), transform=transform,
+                            depth=4)
+    assert list(pipe) == [2 * i for i in range(100)]
+    assert main_thread not in seen_threads
+
+
+def test_prefetch_exception_propagates_at_break_point():
+    class Boom(RuntimeError):
+        pass
+
+    def src():
+        yield 1
+        yield 2
+        raise Boom('reader died')
+
+    pipe = PrefetchPipeline(src, depth=2)
+    it = iter(pipe)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(Boom, match='reader died'):
+        next(it)
+
+
+def test_prefetch_shutdown_on_abandon():
+    """Break mid-stream: the worker must stop pulling (bounded queue +
+    stop flag), not drain an endless source forever."""
+    pulled = []
+
+    def endless():
+        i = 0
+        while True:
+            pulled.append(i)
+            yield i
+            i += 1
+
+    pipe = PrefetchPipeline(endless, depth=2)
+    it = iter(pipe)
+    for _ in range(3):
+        next(it)
+    it.close()          # generator close -> pipeline close
+    pipe.close()
+    deadline = time.monotonic() + 5.0
+    while pipe._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not pipe._thread.is_alive()
+    n = len(pulled)
+    time.sleep(0.05)
+    assert len(pulled) == n     # no further pulls after shutdown
+    assert n <= 3 + 2 + 2       # consumed + queue depth + in-flight
+    with pytest.raises(RuntimeError, match='single-use'):
+        iter(pipe)
+
+
+def test_prefetch_feeds_stages_on_device():
+    feeder = DataFeeder(
+        feed_list=_feed_vars_for_parity(), place=fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    data = [[(rng.randn(4).astype('float32'),
+              rng.randn(1).astype('float32')) for _ in range(8)]
+            for _ in range(3)]
+    it = prefetch_feeds(lambda: iter(data), feeder, depth=2,
+                        place=fluid.CPUPlace())
+    out = list(it)
+    assert len(out) == 3
+    for n, feed in out:
+        assert n == 8
+        assert all(isinstance(v, jax.Array) for v in feed.values())
+
+
+# ---- double_buffer(place=) -----------------------------------------------
+def test_double_buffer_place_honored():
+    """double_buffer(place=...) used to silently ignore the place; the
+    staged batches must now arrive as device arrays."""
+    from paddle_tpu import reader_io
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        reader = fluid.layers.io.random_data_generator(
+            0., 1., shapes=[(4,), (1,)], lod_levels=[0, 0])
+        reader.source.n_samples = 12
+        reader = fluid.layers.io.batch(reader, 4)
+        reader = fluid.layers.io.double_buffer(
+            reader, place=fluid.CPUPlace())
+    batches = list(reader_io.iterate_reader(reader))
+    assert len(batches) == 3
+    for batch in batches:
+        assert all(isinstance(a, jax.Array) for a in batch)
+        assert batch[0].shape == (4, 4)
+    # and without a place the batches stay host-side numpy
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        r2 = fluid.layers.io.random_data_generator(
+            0., 1., shapes=[(4,)], lod_levels=[0])
+        r2.source.n_samples = 4
+        r2 = fluid.layers.io.batch(r2, 4)
+        r2 = fluid.layers.io.double_buffer(r2)
+    batches = list(reader_io.iterate_reader(r2))
+    assert all(isinstance(a, np.ndarray) for b in batches for a in b)
+
+
+# ---- DataFeeder fast path ------------------------------------------------
+def _feed_vars_for_parity():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    return [x, y]
+
+
+def test_data_feeder_fast_path_parity():
+    feeder = DataFeeder(feed_list=_feed_vars_for_parity(),
+                        place=fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    cases = [
+        # rows of (vector, vector)
+        [(rng.randn(4).astype('float32'),
+          rng.randn(1).astype('float32')) for _ in range(6)],
+        # scalar labels: the [1]-shape column must gain the axis
+        [(rng.randn(4).astype('float32'), float(i)) for i in range(5)],
+        # flat rows that reshape into the declared trailing shape
+        [(list(range(4)), [0.5]) for _ in range(3)],
+    ]
+    for data in cases:
+        fast = feeder.feed(data)
+        slow = feeder.feed(data, _force_slow=True)
+        assert set(fast) == set(slow)
+        for name in slow:
+            assert fast[name].dtype == slow[name].dtype
+            assert np.array_equal(fast[name], slow[name]), name
+
+    # reshape case: 784-flat rows against a [1, 28, 28] slot
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        img = fluid.layers.data(name='img', shape=[1, 28, 28],
+                                dtype='float32')
+    f2 = DataFeeder(feed_list=[img], place=fluid.CPUPlace())
+    data = [(rng.randn(784).astype('float32'),) for _ in range(4)]
+    fast, slow = f2.feed(data), f2.feed(data, _force_slow=True)
+    assert fast['img'].shape == slow['img'].shape == (4, 1, 28, 28)
+    assert np.array_equal(fast['img'], slow['img'])
+    # pre-batched single ndarray: the zero-per-row-work path
+    arr = rng.randn(4, 784).astype('float32')
+    out = f2.feed(arr)
+    assert out['img'].shape == (4, 1, 28, 28)
+    assert np.array_equal(out['img'], arr.reshape(4, 1, 28, 28))
+
+
+def test_data_feeder_fast_path_declines_lod_and_mismatch():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        words = fluid.layers.data(name='words', shape=[1],
+                                  dtype='int64', lod_level=1)
+    f = DataFeeder(feed_list=[words], place=fluid.CPUPlace())
+    assert f._feed_dense_fast([([1, 2, 3],), ([4],)]) is None
+    feeder = DataFeeder(feed_list=_feed_vars_for_parity(),
+                        place=fluid.CPUPlace())
+    # wrong field count must still raise the classic assert
+    with pytest.raises(AssertionError):
+        feeder.feed([(np.zeros(4, 'float32'),)])
+
+
+def test_data_feeder_fast_path_engages():
+    feeder = DataFeeder(feed_list=_feed_vars_for_parity(),
+                        place=fluid.CPUPlace())
+    data = [(np.zeros(4, 'float32'), np.zeros(1, 'float32'))
+            for _ in range(4)]
+    assert feeder._feed_dense_fast(data) is not None
+
+
+# ---- fetch copy elision --------------------------------------------------
+def test_to_f32_fetch_stays_on_host_for_numpy():
+    """A host numpy fetch must not round-trip through the device: the
+    f32 result is numpy, and an already-f32 array passes IDENTICALLY
+    (no copy at all)."""
+    a64 = np.arange(6, dtype='float64').reshape(2, 3)
+    out = exe_mod._to_f32_fetch(a64)
+    assert isinstance(out, np.ndarray) and out.dtype == np.float32
+    a32 = a64.astype('float32')
+    assert exe_mod._to_f32_fetch(a32) is a32
+    ai = np.arange(3, dtype='int32')
+    assert exe_mod._to_f32_fetch(ai) is ai
+    assert exe_mod.as_numpy(a32) is a32

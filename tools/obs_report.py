@@ -14,16 +14,17 @@ journal file landed, with no jax/paddle_tpu install.
 ``--smoke`` exits nonzero when the journal is empty, contains malformed
 lines, or lacks the required records (``--require step`` by default —
 a training journal must hold step records; ``--require serving`` for a
-serving soak; ``--require any`` for presence only).
-``tools/serve_bench.py --smoke`` runs this gate over the journal its
-load run writes.
+serving soak; ``--require pipeline`` for a pipelined-trainer run —
+step records must carry the ``feed_wait`` host-wait field; ``--require
+any`` for presence only). ``tools/serve_bench.py --smoke`` runs this
+gate over the journal its load run writes.
 """
 import argparse
 import json
 import sys
 
 REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
-               'any': None}
+               'pipeline': 'step_end', 'any': None}
 
 
 def load_journal(path):
@@ -49,6 +50,25 @@ def load_journal(path):
 
 def _mean(xs):
     return sum(xs) / len(xs) if xs else 0.0
+
+
+def _pipeline_summary(steps, duration):
+    """Input-pipeline SLI (PERF.md "Dispatch pipelining"): how much of
+    the run the trainer spent BLOCKED on host feed work (feed_wait) vs
+    dispatching compute, and how well chaining amortized dispatches."""
+    waits = [r['feed_wait'] for r in steps if 'feed_wait' in r]
+    dispatches = [r['dispatch_s'] for r in steps if 'dispatch_s' in r]
+    chained = [r for r in steps if r.get('chain', 0) > 1]
+    return {
+        'steps_with_feed_wait': len(waits),
+        'host_wait_total_s': sum(waits),
+        'host_wait_mean_s': _mean(waits),
+        'host_wait_fraction': (sum(waits) / duration) if duration
+        else 0.0,
+        'dispatch_total_s': sum(dispatches),
+        'chained_steps': len(chained),
+        'mean_chain': _mean([r['chain'] for r in chained]),
+    }
 
 
 def summarize(records, malformed=0):
@@ -118,6 +138,7 @@ def summarize(records, malformed=0):
             'fallbacks': len(by_ev.get('checkpoint_fallback', ())),
         },
         'anomalies': len(by_ev.get('anomaly', ())),
+        'pipeline': _pipeline_summary(steps, duration),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -147,6 +168,17 @@ def render(summary, top=10):
         if st['first_loss'] is not None:
             lines.append('loss:     %.6g -> %.6g'
                          % (st['first_loss'], st['last_loss']))
+    pl = s.get('pipeline') or {}
+    if pl.get('steps_with_feed_wait'):
+        line = ('pipeline: host wait %.3fs total (%.1f%% of wall, '
+                'mean %.2fms/step)'
+                % (pl['host_wait_total_s'],
+                   100.0 * pl['host_wait_fraction'],
+                   pl['host_wait_mean_s'] * 1e3))
+        if pl['chained_steps']:
+            line += (' | %d steps chained (avg %.1f steps/dispatch)'
+                     % (pl['chained_steps'], pl['mean_chain']))
+        lines.append(line)
     ex = s['executor']
     if ex['runs']:
         lookups = ex['cache_hits'] + ex['cache_misses']
@@ -210,6 +242,14 @@ def check_journal(path, require='step'):
                 if r['ev'] == need and 'skipped' not in r)
         if n == 0:
             problems.append('journal contains zero %s records' % need)
+        elif require == 'pipeline':
+            n = sum(1 for r in records if r['ev'] == need
+                    and 'skipped' not in r and 'feed_wait' in r)
+            if n == 0:
+                problems.append(
+                    'journal contains zero step_end records with '
+                    'pipeline fields (feed_wait) — was the run made '
+                    'with a pre-pipelining trainer?')
     return problems
 
 
