@@ -65,6 +65,7 @@ from .parallel.transpiler import (DistributeTranspiler,  # noqa
                                   SimpleDistributeTranspiler,
                                   memory_optimize, release_memory)
 from . import transpiler  # noqa
+from . import compiler  # noqa
 from . import recordio_writer  # noqa
 from . import contrib  # noqa
 from . import resilience  # noqa

@@ -1,0 +1,596 @@
+"""The canonical program-level passes.
+
+Pipeline order (``default_pipeline``)::
+
+    constant_fold -> dead_op_elim -> elementwise_fuse -> buffer_reuse
+
+plus ``bn_fold`` at the head for inference programs
+(``inference_pipeline`` / the legacy ``InferenceTranspiler`` facade).
+
+All default passes are exact rewrites: they replay the very same
+registered kernels, so optimized-vs-raw outputs are bit-identical
+(pinned by tests/test_compiler.py). ``bn_fold`` re-associates the BN
+affine into conv/fc weights and documents <= 1e-5 drift.
+"""
+import numpy as np
+
+from ..framework import Block, Operator
+from ..core.registry import SIDE_EFFECT_OPS, get_kernel, register_kernel
+from ..core.lowering import (BlockRunner, OpCtx, RNG_KEY, _op_reads,
+                             _op_writes)
+from .pass_base import Pass, PassResult, register_pass
+
+__all__ = ['DeadOpElimination', 'ConstantFolding', 'ElementwiseFusion',
+           'BufferReuse', 'BatchNormFolding', 'DEFAULT_PASSES',
+           'INFERENCE_PASSES', 'RNG_OPS', 'FUSED_ELEMENTWISE_OP']
+
+# Ops that consume the threaded PRNG key: removing one would shift the
+# RNG stream of every later stochastic op, silently changing numerics —
+# dead-op elimination must keep them even when their outputs are dead.
+RNG_OPS = frozenset({
+    'dropout', 'gaussian_random', 'gaussian_random_batch_size_like',
+    'truncated_gaussian_random', 'uniform_random',
+    'uniform_random_batch_size_like', 'nce', 'sampling_id',
+})
+
+# Ops the dead-op pass must never drop regardless of liveness.
+_ALWAYS_KEEP = frozenset({'feed', 'fetch'})
+
+
+def _has_sub_block(op):
+    return any(isinstance(v, Block) for v in op.attrs.values())
+
+
+def _hidden_reads(op):
+    """Names consumed through ATTRS, invisible to ``_op_reads``: the
+    gradient markers' cotangent sources and sparse-lookup ids. Every
+    liveness-style analysis here must treat them as reads."""
+    if op.type == 'gradient_marker':
+        return [n for n in (op.attrs.get('target_grads') or ()) if n]
+    if op.type == 'backward_marker':
+        return [p[0] for pairs in (op.attrs.get('sparse') or {}).values()
+                for p in pairs]
+    return []
+
+
+def _program_has_sub_blocks(program):
+    return len(program.blocks) > 1 or any(
+        _has_sub_block(op) for op in program.global_block().ops)
+
+
+@register_pass
+class DeadOpElimination(Pass):
+    """Remove global-block ops whose outputs reach neither a protected
+    (fetch) name, a persistable var, nor a side-effecting/kept op.
+
+    Parity: the executor's prune-before-run, generalized — it also runs
+    on training programs, where it drops fetch-dead metric branches
+    (accuracy heads nobody fetched this run) that the reference
+    interpreter would have executed anyway. Conservative keeps: side
+    effects, sub-block carriers, RNG consumers (stream stability),
+    feed/fetch ops, persistable writers."""
+
+    name = 'dead_op_elim'
+
+    def run(self, program, ctx):
+        res = PassResult(self.name)
+        if not ctx.protected:
+            # no fetch information: every leaf could be the caller's
+            # target, so there is nothing provably dead
+            res.note = 'no protected names; skipped'
+            return res
+        block = program.global_block()
+        ops = block.ops
+        live = set(ctx.protected)
+        keep = [False] * len(ops)
+        for i in reversed(range(len(ops))):
+            op = ops[i]
+            forced = (op.type in SIDE_EFFECT_OPS
+                      or op.type in _ALWAYS_KEEP
+                      or op.type in RNG_OPS
+                      or _has_sub_block(op)
+                      or not op.output_arg_names)
+            if not forced:
+                for nm in op.output_arg_names:
+                    var = block._find_var_recursive(nm)
+                    if var is not None and var.persistable:
+                        forced = True
+                        break
+            if forced or any(nm in live for nm in op.output_arg_names):
+                keep[i] = True
+                live.update(_op_reads(op))
+                live.update(_hidden_reads(op))
+        removed = keep.count(False)
+        if removed:
+            block.ops = [op for i, op in enumerate(ops) if keep[i]]
+            program._bump_version()
+        res.changed = bool(removed)
+        res.ops_removed = removed
+        return res
+
+
+# Pure, deterministic, dense-safe op types constant folding may
+# evaluate at pass time. RNG ops are excluded by construction (and
+# would fail the eval anyway: no PRNG key in the fold environment).
+_FOLDABLE = frozenset({
+    'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'elementwise_max', 'elementwise_min',
+    'elementwise_pow', 'scale', 'cast', 'concat', 'sum', 'minus',
+    'square', 'sqrt', 'exp', 'log', 'abs', 'relu', 'tanh', 'sigmoid',
+    'softmax', 'transpose', 'reverse', 'clip', 'pow', 'mean',
+    'fill_zeros_like', 'assign', 'one_hot', 'ceil', 'floor', 'round',
+    'reciprocal', 'softplus', 'softsign', 'reshape', 'split',
+})
+
+_CONST_PRODUCERS = frozenset({'fill_constant', 'assign_value'})
+
+# Don't bake arrays bigger than this into the program (attr bloat +
+# fingerprint hashing cost outweigh the folded flops).
+_MAX_FOLD_ELEMS = 1 << 16
+
+
+@register_pass
+class ConstantFolding(Pass):
+    """Evaluate compile-time-constant subgraphs once, at pass time.
+
+    Op outputs reachable only from ``fill_constant``/``assign_value``
+    producers are computed by running the registered kernels eagerly;
+    consumers outside the constant region read a baked ``assign_value``
+    instead. Interior ops of the folded region are dropped here; the
+    orphaned producers fall to the following dead-op pass."""
+
+    name = 'constant_fold'
+
+    def run(self, program, ctx):
+        res = PassResult(self.name)
+        block = program.global_block()
+        ops = block.ops
+        const_env = {}     # name -> (np value, producer idx, foldable?)
+        folded = set()     # indices of evaluated FOLDABLE ops
+        need_mat = {}      # producer idx -> set(names to materialize)
+
+        def _note_reads(op):
+            for nm in list(_op_reads(op)) + _hidden_reads(op):
+                hit = const_env.get(nm)
+                if hit is not None and hit[2]:
+                    need_mat.setdefault(hit[1], set()).add(nm)
+
+        for i, op in enumerate(ops):
+            if op.type in _CONST_PRODUCERS and not _has_sub_block(op):
+                vals = self._eval(block, op, const_env)
+                if vals is not None:
+                    for nm, v in vals.items():
+                        const_env[nm] = (v, i, False)
+                    continue
+            writes_persistable = False
+            for nm in op.output_arg_names:
+                var = block._find_var_recursive(nm)
+                if var is not None and var.persistable:
+                    writes_persistable = True
+            if (op.type in _FOLDABLE and not _has_sub_block(op)
+                    and not writes_persistable and op.input_arg_names
+                    and all(n in const_env
+                            for n in op.input_arg_names)):
+                vals = self._eval(block, op, const_env)
+                if vals is not None:
+                    for nm, v in vals.items():
+                        const_env[nm] = (v, i, True)
+                    folded.add(i)
+                    continue
+            # not folded: its reads of constants must materialize, and
+            # its writes (incl. nested) shadow any same-named constant
+            _note_reads(op)
+            for nm in _op_writes(op):
+                const_env.pop(nm, None)
+        for nm in ctx.protected:
+            hit = const_env.get(nm)
+            if hit is not None and hit[2]:
+                need_mat.setdefault(hit[1], set()).add(nm)
+
+        if not folded:
+            return res
+        new_ops = []
+        for i, op in enumerate(ops):
+            if i not in folded:
+                new_ops.append(op)
+                continue
+            for nm in sorted(need_mat.get(i, ())):
+                val = const_env[nm][0]
+                new_ops.append(Operator(
+                    block, 'assign_value', inputs={},
+                    outputs={'Out': [nm]},
+                    attrs={'shape': list(val.shape),
+                           'dtype': str(val.dtype),
+                           'values': val}))
+        res.ops_folded = len(folded)
+        res.ops_removed = len(ops) - len(new_ops)
+        res.changed = True
+        block.ops = new_ops
+        program._bump_version()
+        return res
+
+    @staticmethod
+    def _eval(block, op, const_env):
+        """Run ``op``'s registered kernel on concrete values; None on
+        any failure (dynamic shape, unexpected structure, too big)."""
+        try:
+            env = {n: np.asarray(const_env[n][0])
+                   for n in op.input_arg_names}
+            get_kernel(op.type)(OpCtx(op, env, BlockRunner(block)))
+            out = {}
+            for nm in op.output_arg_names:
+                if nm not in env:
+                    return None
+                v = np.asarray(env[nm])
+                if v.size > _MAX_FOLD_ELEMS:
+                    return None
+                out[nm] = v
+            return out
+        except Exception:
+            return None
+
+
+# Pure elementwise/activation op types: no RNG, no reductions over the
+# batch, no sequence re-shaping — a chain of these replayed in order is
+# the exact computation of the original ops.
+_ELEMENTWISE = frozenset({
+    'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'elementwise_max', 'elementwise_min',
+    'elementwise_pow', 'scale', 'clip', 'relu', 'sigmoid', 'tanh',
+    'exp', 'log', 'sqrt', 'abs', 'square', 'softplus', 'softsign',
+    'ceil', 'floor', 'round', 'reciprocal', 'logsigmoid',
+    'tanh_shrink', 'brelu', 'leaky_relu', 'soft_relu', 'elu', 'relu6',
+    'pow', 'stanh', 'hard_shrink', 'softshrink', 'thresholded_relu',
+    'hard_sigmoid', 'swish',
+})
+
+FUSED_ELEMENTWISE_OP = 'fused_elementwise'
+
+
+def _attrs_fusable(attrs):
+    for v in attrs.values():
+        if not isinstance(v, (int, float, bool, str, bytes, type(None),
+                              list, tuple)):
+            return False
+        if isinstance(v, (list, tuple)) and not all(
+                isinstance(e, (int, float, bool, str)) for e in v):
+            return False
+    return True
+
+
+@register_kernel(FUSED_ELEMENTWISE_OP)
+def _fused_elementwise_kernel(ctx):
+    """Lower one fused region as ONE kernel: the captured sub-ops
+    replay inside a single named scope, so the whole chain lands in one
+    HLO region (XLA fuses it into one loop — the introspection hook the
+    acceptance test asserts on). Gradients flow through the replay
+    exactly as through the original ops."""
+    import jax
+    ops = ctx.op.__dict__.get('_materialized')
+    if ops is None:
+        ops = [Operator(ctx.runner.block, t, inputs=dict(i),
+                        outputs=dict(o), attrs=dict(a))
+               for t, i, o, a in ctx.attr('sub_ops')]
+        ctx.op.__dict__['_materialized'] = ops
+    with jax.named_scope(FUSED_ELEMENTWISE_OP):
+        ctx.runner.run_ops(ops, ctx.env)
+
+
+@register_pass
+class ElementwiseFusion(Pass):
+    """Merge single-consumer chains of pure elementwise/activation ops
+    into one ``fused_elementwise`` op that lowers as a single kernel.
+
+    Chain link rule: op_i's ``Out`` is read by exactly ONE op anywhere
+    in the program, that reader is a later elementwise op in the global
+    block, and the intermediate is neither protected, persistable, nor
+    hazarded (no op between the members writes a name the members read
+    or write). The fused op sits at the LAST member's position — every
+    external input is already produced there, and no dropped
+    intermediate had any other reader."""
+
+    name = 'elementwise_fuse'
+
+    def run(self, program, ctx):
+        res = PassResult(self.name)
+        block = program.global_block()
+        ops = block.ops
+        # readers across ALL blocks (a sub-block read makes an
+        # intermediate external, breaking the chain)
+        read_count = {}
+        for b in program.blocks:
+            for op in b.ops:
+                for nm in list(op.input_arg_names) + _hidden_reads(op):
+                    read_count[nm] = read_count.get(nm, 0) + 1
+        global_reader = {}
+        for j, op in enumerate(ops):
+            for nm in op.input_arg_names:
+                global_reader.setdefault(nm, []).append(j)
+
+        def _sole_out(op):
+            outs = op.output_arg_names
+            if len(outs) != 1 or list(op.outputs) != ['Out']:
+                return None
+            return outs[0]
+
+        used = set()
+        chains = []
+        for i, op in enumerate(ops):
+            if i in used or op.type not in _ELEMENTWISE \
+                    or not _attrs_fusable(op.attrs):
+                continue
+            chain = [i]
+            hazard = set(_op_reads(op)) | set(_op_writes(op))
+            cur = i
+            while True:
+                out = _sole_out(ops[cur])
+                if out is None or read_count.get(out, 0) != 1:
+                    break
+                readers = global_reader.get(out, [])
+                if len(readers) != 1 or readers[0] <= cur:
+                    break
+                j = readers[0]
+                nxt = ops[j]
+                if nxt.type not in _ELEMENTWISE or j in used \
+                        or not _attrs_fusable(nxt.attrs):
+                    break
+                if out in ctx.protected:
+                    break
+                var = block._find_var_recursive(out)
+                if var is not None and var.persistable:
+                    break
+                # WAR/WAW hazard: an interloper writing anything the
+                # chain touches would see/change the wrong value once
+                # the members move to j's position
+                bad = False
+                for k in range(cur + 1, j):
+                    if set(_op_writes(ops[k])) & hazard:
+                        bad = True
+                        break
+                if bad:
+                    break
+                hazard |= set(_op_reads(nxt)) | set(_op_writes(nxt))
+                chain.append(j)
+                cur = j
+            if len(chain) >= 2:
+                chains.append(chain)
+                used.update(chain)
+
+        if not chains:
+            return res
+        drop, insert_at = set(), {}
+        for chain in chains:
+            members = [ops[k] for k in chain]
+            produced = set()
+            ext_inputs = []
+            for m in members:
+                for nm in m.input_arg_names:
+                    if nm not in produced and nm not in ext_inputs:
+                        ext_inputs.append(nm)
+                produced.update(m.output_arg_names)
+            final_out = members[-1].outputs['Out'][0]
+            sub_ops = [(m.type, {s: list(v) for s, v in m.inputs.items()},
+                        {s: list(v) for s, v in m.outputs.items()},
+                        {k: (list(v) if isinstance(v, tuple) else v)
+                         for k, v in m.attrs.items()})
+                       for m in members]
+            fused = Operator(
+                block, FUSED_ELEMENTWISE_OP,
+                inputs={'X': ext_inputs},
+                outputs={'Out': [final_out]},
+                attrs={'sub_ops': sub_ops,
+                       'fused_types': [m.type for m in members],
+                       'fused_count': len(members)})
+            insert_at[chain[-1]] = fused
+            drop.update(chain)
+            res.ops_fused += len(members)
+        new_ops = []
+        for k, op in enumerate(ops):
+            if k in insert_at:
+                new_ops.append(insert_at[k])
+            elif k not in drop:
+                new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+        res.changed = True
+        res.ops_removed = len(ops) - len(new_ops)
+        return res
+
+
+@register_pass
+class BufferReuse(Pass):
+    """Liveness-based buffer-release annotations lowering honors.
+
+    For every non-persistable name, find its LAST reader in the global
+    block and annotate that op with ``__release__`` so
+    ``BlockRunner.run_ops`` drops the environment reference once the op
+    completes — the value's buffer becomes reusable instead of living
+    to the end of the block (the TPU-meaningful successor of the
+    reference ``memory_optimization_transpiler``'s in-place var reuse;
+    in eager/dynamic mode this is a direct peak-memory win, under jit
+    it shortens XLA's computed live ranges for donated temporaries).
+    Fetch and persistable-state names are additionally guarded at
+    lowering time (``BlockRunner.keep``), so an annotation can never
+    starve a fetch the pass didn't know about."""
+
+    name = 'buffer_reuse'
+
+    def __init__(self, skip=None):
+        self.skip = frozenset(skip or ())
+
+    def run(self, program, ctx):
+        res = PassResult(self.name)
+        if _program_has_sub_blocks(program):
+            # control-flow bodies re-read parent names per iteration;
+            # a static last-read index over the flat op list would lie
+            res.note = 'sub-blocks present; skipped'
+            return res
+        if any(op.type == 'gradient_marker'
+               for op in program.global_block().ops):
+            # calc_gradient's marker snapshots the environment and
+            # replays earlier ops from it — names a static liveness
+            # would call dead are still read through the snapshot
+            res.note = 'gradient_marker present; skipped'
+            return res
+        block = program.global_block()
+        ops = block.ops
+        last_read = {}
+        for i, op in enumerate(ops):
+            for nm in list(_op_reads(op)) + _hidden_reads(op):
+                last_read[nm] = i
+        skip = set(ctx.protected) | self.skip | {RNG_KEY}
+        releases = {}
+        for nm, i in last_read.items():
+            if nm in skip or nm in _op_writes(ops[i]):
+                continue
+            var = block._find_var_recursive(nm)
+            if var is not None and var.persistable:
+                continue
+            releases.setdefault(i, []).append(nm)
+        changed = 0
+        for i, op in enumerate(ops):
+            want = tuple(sorted(releases.get(i, ())))
+            have = tuple(op.attrs.get('__release__', ()))
+            if want != have:
+                if want:
+                    op.attrs['__release__'] = want
+                else:
+                    op.attrs.pop('__release__', None)
+                changed += 1
+            res.vars_released += len(want)
+        if changed:
+            program._bump_version()
+        res.changed = bool(changed)
+        return res
+
+
+@register_pass
+class BatchNormFolding(Pass):
+    """Inference BN folding into the preceding conv/fc weights.
+
+    Parity: inference_transpiler.py::_fuse_conv_bn / _fuse_param. For
+    every ``conv2d``/``depthwise_conv2d``/``mul`` whose single consumer
+    is a ``batch_norm`` and whose weights are resident in the scope::
+
+        w' = w * scale / sqrt(var + eps)          (per output channel)
+        b' = bias - mean * scale / sqrt(var + eps)
+
+    the BN op is REMOVED and an ``elementwise_add(axis=1)`` with the
+    folded bias takes over BN's output name. Remaining BN/dropout ops
+    flip to test mode. Not semantics-preserving in the bit-exact sense:
+    the re-associated affine drifts <= 1e-5 (tolerance policy pinned in
+    tests/test_compiler.py)."""
+
+    name = 'bn_fold'
+    preserves_semantics = False
+
+    def run(self, program, ctx):
+        res = PassResult(self.name)
+        scope = ctx.scope
+        if scope is None:
+            from ..executor import global_scope
+            scope = global_scope()
+        res.ops_folded = self._fuse_bn(program, scope)
+        res.changed = bool(res.ops_folded)
+        if self._mark_test_mode(program):
+            res.changed = True
+        return res
+
+    @staticmethod
+    def _consumers(program, name):
+        return [op for b in program.blocks for op in b.ops
+                if name in op.input_arg_names]
+
+    def _fuse_bn(self, program, scope):
+        block = program.global_block()
+        # a weight with ANY other consumer cannot be rewritten in
+        # place: each use would need its own scaled copy
+        weight_uses = {}
+        for b in program.blocks:
+            for op in b.ops:
+                for name in op.input_arg_names:
+                    weight_uses[name] = weight_uses.get(name, 0) + 1
+        folded = 0
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type in ('conv2d', 'depthwise_conv2d'):
+                out_slot, w_slot = 'Output', 'Filter'
+            elif op.type == 'mul':
+                out_slot, w_slot = 'Out', 'Y'
+            else:
+                i += 1
+                continue
+            out_name = op.outputs[out_slot][0]
+            consumers = self._consumers(program, out_name)
+            if len(consumers) != 1 or consumers[0].type != 'batch_norm':
+                i += 1
+                continue
+            bn = consumers[0]
+            w_name = op.inputs[w_slot][0]
+            w_var = block._find_var_recursive(w_name)
+            if weight_uses.get(w_name, 0) > 1 or w_var is None \
+                    or not getattr(w_var, 'persistable', False):
+                i += 1
+                continue
+            vals, ok = {}, True
+            for slot in ('Scale', 'Bias', 'Mean', 'Variance'):
+                v = scope.raw(bn.inputs[slot][0])
+                if v is None:
+                    ok = False
+                    break
+                vals[slot] = np.asarray(v, np.float32)
+            w_val = scope.raw(w_name)
+            if not ok or w_val is None:
+                i += 1
+                continue
+            w_val = np.asarray(w_val, np.float32)
+            eps = float(bn.attrs.get('epsilon', 1e-5))
+            alpha = vals['Scale'] / np.sqrt(vals['Variance'] + eps)
+            if op.type == 'mul':
+                if w_val.ndim != 2 or w_val.shape[1] != alpha.shape[0]:
+                    i += 1
+                    continue
+                new_w = w_val * alpha[None, :]
+            else:
+                new_w = w_val * alpha[:, None, None, None]
+            new_b = vals['Bias'] - vals['Mean'] * alpha
+
+            bias_var = block.create_var(
+                name=w_name + '.bn_fold_bias', shape=list(new_b.shape),
+                dtype='float32', persistable=True)
+            scope.set_var(w_name, new_w.astype(w_val.dtype))
+            scope.set_var(bias_var.name, new_b.astype(np.float32))
+
+            bn_idx = block.ops.index(bn)
+            bn_out = bn.outputs['Y'][0]
+            block.remove_op(bn_idx)
+            block.insert_op(bn_idx, type='elementwise_add',
+                            inputs={'X': [out_name],
+                                    'Y': [bias_var.name]},
+                            outputs={'Out': [bn_out]},
+                            attrs={'axis': 1})
+            folded += 1
+            i += 1
+        if folded:
+            program._bump_version()
+        return folded
+
+    @staticmethod
+    def _mark_test_mode(program):
+        changed = False
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in ('batch_norm', 'dropout') and \
+                        op.attrs.get('is_test') is not True:
+                    op.attrs['is_test'] = True
+                    changed = True
+        if changed:
+            program._bump_version()
+        return changed
+
+
+# Canonical pipelines (see __init__.py for the config surface).
+DEFAULT_PASSES = ('constant_fold', 'dead_op_elim', 'elementwise_fuse',
+                  'buffer_reuse')
+INFERENCE_PASSES = ('bn_fold',) + DEFAULT_PASSES

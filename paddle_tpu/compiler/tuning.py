@@ -1,0 +1,317 @@
+"""Per-shape autotuning with an on-disk cache.
+
+BENCH_FULL measured NCHW-vs-NHWC conv layout at ~5% and flash-attention
+tile choice at ~5% (dtype-dependent) — per (program, shape, backend)
+decisions no static default gets right everywhere. The
+:class:`Autotuner` times candidate configs through the real Executor
+path and persists the winner in a :class:`TuningCache` keyed by
+``(program fingerprint, shape signature, backend)``:
+
+- ``Executor`` consults the cache at compile time (miss path) and bakes
+  the winning entry into the traced program; the entry token joins the
+  jit-cache key, so a new tuning result can never serve a stale
+  compiled program.
+- ``ModelServer.warmup()`` preloads the cache from disk before
+  pre-compiling buckets, so a fresh serving process cold-starts with
+  the tuned configs instead of re-searching (COMPILER.md).
+
+Cache file: ``$PADDLE_TPU_TUNING_CACHE`` or
+``~/.cache/paddle_tpu/tuning_cache.json`` (atomic tmp->rename writes).
+"""
+import contextlib
+import hashlib
+import json
+import os
+import threading
+import time
+
+from .. import observability as _obs
+
+__all__ = ['TuningCache', 'Autotuner', 'default_cache',
+           'set_default_cache', 'shape_signature', 'backend',
+           'apply_entry', 'wrap_jitted', 'flash_blocks']
+
+SCHEMA = 1
+
+# Tunable knobs an entry may carry; apply_entry() knows how to install
+# each one for the duration of a traced call.
+KNOWN_KNOBS = ('conv_layout', 'flash_block_q', 'flash_block_k')
+
+# Flash tile override consulted by the flash_attention op kernel
+# (ops/misc_ops.py); None -> the kernel's dtype-aware defaults.
+_FLASH_OVERRIDE = [None]
+
+
+def flash_blocks():
+    ov = _FLASH_OVERRIDE[0]
+    return ov if ov is not None else (None, None)
+
+
+def backend():
+    import jax
+    return jax.default_backend()
+
+
+def shape_signature(feed_sig):
+    """Stable short token for a prepared-feed spec tuple (the
+    ``(name, (shape, dtype))`` pairs Executor keys its cache by)."""
+    return hashlib.sha1(repr(feed_sig).encode()).hexdigest()[:16]
+
+
+def entry_token(entry):
+    if not entry:
+        return '-'
+    return hashlib.sha1(json.dumps(entry, sort_keys=True,
+                                   default=str).encode()).hexdigest()[:12]
+
+
+def _default_path():
+    return os.environ.get('PADDLE_TPU_TUNING_CACHE') or os.path.join(
+        os.path.expanduser('~'), '.cache', 'paddle_tpu',
+        'tuning_cache.json')
+
+
+class TuningCache(object):
+    """Thread-safe (program fp, shape sig, backend) -> entry store with
+    on-disk persistence and hit/miss telemetry
+    (``tuning_cache_{hits,misses}_total``)."""
+
+    def __init__(self, path=None):
+        self.path = path or _default_path()
+        self._entries = {}
+        self._lock = threading.RLock()
+        self._loaded = False
+        reg = _obs.default_registry()
+        self._m_hits = reg.counter(
+            'tuning_cache_hits_total',
+            'compile-time tuning-cache lookups that found an entry')
+        self._m_misses = reg.counter(
+            'tuning_cache_misses_total',
+            'compile-time tuning-cache lookups that found nothing')
+
+    @staticmethod
+    def key(program_fp, shape_sig, back):
+        return '%s|%s|%s' % (program_fp, shape_sig, back)
+
+    # ---- persistence -----------------------------------------------------
+    def preload(self):
+        """Load the on-disk cache (idempotent; merges over in-memory
+        entries without clobbering newer puts). Returns the number of
+        entries now resident. Serving warmup calls this so cold-start
+        compiles run under tuned configs."""
+        with self._lock:
+            n_before = len(self._entries)
+            try:
+                with open(self.path) as f:
+                    data = json.load(f)
+                if data.get('schema') == SCHEMA:
+                    for k, v in data.get('entries', {}).items():
+                        self._entries.setdefault(k, v)
+            except (OSError, ValueError):
+                pass
+            self._loaded = True
+            n = len(self._entries)
+        _obs.emit('tuning_preload', path=self.path, entries=n,
+                  loaded=n - n_before)
+        return n
+
+    def save(self):
+        with self._lock:
+            payload = {'schema': SCHEMA, 'entries': dict(self._entries)}
+        d = os.path.dirname(os.path.abspath(self.path))
+        try:
+            os.makedirs(d)
+        except OSError:
+            pass
+        tmp = self.path + '.tmp.%d' % os.getpid()
+        with open(tmp, 'w') as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # ---- lookup / store --------------------------------------------------
+    def _ensure_loaded(self):
+        if not self._loaded:
+            self.preload()
+
+    def lookup(self, program_fp, shape_sig, back, count=True):
+        """The tuned entry dict, or None. ``count=False`` is the quiet
+        form used per-run for cache-key tokens (metrics track COMPILES,
+        not every step)."""
+        self._ensure_loaded()
+        with self._lock:
+            hit = self._entries.get(self.key(program_fp, shape_sig,
+                                             back))
+        if count:
+            (self._m_hits if hit else self._m_misses).inc()
+            _obs.emit('tuning_lookup', fp=program_fp, hit=bool(hit))
+        return dict(hit['entry']) if hit else None
+
+    def token(self, program_fp, shape_sig, back):
+        """Short stable token of the entry (or '-') for jit-cache keys:
+        a tuning-cache update changes the token, forcing exactly the
+        affected program to recompile."""
+        self._ensure_loaded()
+        with self._lock:
+            hit = self._entries.get(self.key(program_fp, shape_sig,
+                                             back))
+        return entry_token(hit['entry']) if hit else '-'
+
+    def put(self, program_fp, shape_sig, back, entry, measured_ms=None,
+            persist=True):
+        rec = {'entry': dict(entry), 'measured_ms': measured_ms,
+               'backend': back, 'stored_at': time.time()}
+        with self._lock:
+            self._entries[self.key(program_fp, shape_sig, back)] = rec
+        if persist:
+            try:
+                self.save()
+            except OSError:
+                pass
+        _obs.emit('tuning_put', fp=program_fp, backend=back,
+                  entry=dict(entry), measured_ms=measured_ms)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._loaded = True
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+
+_DEFAULT = [None]
+
+
+def default_cache():
+    if _DEFAULT[0] is None:
+        _DEFAULT[0] = TuningCache()
+    return _DEFAULT[0]
+
+
+def set_default_cache(cache):
+    """Install (or with None, reset) the process default — tests and
+    benchmarks point it at a temp path."""
+    prev = _DEFAULT[0]
+    _DEFAULT[0] = cache
+    return prev
+
+
+@contextlib.contextmanager
+def apply_entry(entry):
+    """Install a tuning entry's knobs for the duration of a call (the
+    executor wraps the jitted fn with this, so the knobs are live at
+    trace time and every re-execution)."""
+    if not entry:
+        yield
+        return
+    from ..core import amp
+    prev_layout = amp._STATE.get('conv_layout')
+    prev_flash = _FLASH_OVERRIDE[0]
+    try:
+        if entry.get('conv_layout'):
+            amp.set_conv_layout(entry['conv_layout'])
+        if entry.get('flash_block_q') or entry.get('flash_block_k'):
+            _FLASH_OVERRIDE[0] = (entry.get('flash_block_q'),
+                                  entry.get('flash_block_k'))
+        yield
+    finally:
+        amp._STATE['conv_layout'] = prev_layout
+        _FLASH_OVERRIDE[0] = prev_flash
+
+
+def wrap_jitted(fn, entry):
+    """Wrap a compiled callable so every invocation (including the
+    first, compiling one) runs under the entry's knobs."""
+    if not entry:
+        return fn
+
+    def wrapped(*args, **kwargs):
+        with apply_entry(entry):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def _block_op_types(program):
+    types = set()
+    for b in program.blocks:
+        for op in b.ops:
+            types.add(op.type)
+    return types
+
+
+class Autotuner(object):
+    """Small per-shape search over the knobs that measurably matter:
+    conv layout (NCHW/NHWC) and flash-attention tile sizes. Each
+    candidate is timed through a private Executor (so the caller's
+    program cache stays untouched) and the winner lands in the
+    :class:`TuningCache` for every later compile of the same
+    (program, shape, backend)."""
+
+    def __init__(self, place=None, cache=None, warmup=1, steps=3):
+        self.place = place
+        self.cache = cache or default_cache()
+        self.warmup = warmup
+        self.steps = steps
+
+    def candidates(self, program):
+        types = _block_op_types(program)
+        cands = [{}]
+        if types & {'conv2d', 'depthwise_conv2d', 'conv3d'}:
+            cands.append({'conv_layout': 'NHWC'})
+            cands.append({'conv_layout': 'NCHW'})
+        if 'flash_attention' in types:
+            for bq, bk in ((512, 512), (512, 1024), (1024, 1024)):
+                cands.append({'flash_block_q': bq, 'flash_block_k': bk})
+        # dedupe, keep order
+        seen, out = set(), []
+        for c in cands:
+            t = entry_token(c)
+            if t not in seen:
+                seen.add(t)
+                out.append(c)
+        return out
+
+    def tune(self, program, feed, fetch_list, scope=None, persist=True):
+        """Measure every candidate; persist and return
+        ``(best_entry, report)``. ``report`` maps entry tokens to
+        mean step milliseconds."""
+        from ..executor import Executor, Scope, _spec
+        report = {}
+        best, best_ms = None, None
+        prepared_sig = None
+        for cand in self.candidates(program):
+            exe = Executor(self.place)
+            cscope = scope if scope is not None else Scope()
+            with apply_entry(cand):
+                if prepared_sig is None:
+                    pf = exe._prepare_feed(program, dict(feed))
+                    prepared_sig = tuple(sorted(
+                        (n, _spec(v)) for n, v in pf.items()))
+                try:
+                    for _ in range(self.warmup):
+                        exe.run(program, feed=dict(feed),
+                                fetch_list=fetch_list, scope=cscope)
+                    t0 = time.perf_counter()
+                    for _ in range(self.steps):
+                        exe.run(program, feed=dict(feed),
+                                fetch_list=fetch_list, scope=cscope)
+                    ms = (time.perf_counter() - t0) / self.steps * 1e3
+                except Exception:
+                    continue      # candidate invalid on this backend
+            report[entry_token(cand) if cand else 'baseline'] = \
+                round(ms, 3)
+            if best_ms is None or ms < best_ms:
+                best, best_ms = cand, ms
+        if best is not None and best:
+            self.cache.put(program.fingerprint(),
+                           shape_signature(prepared_sig), backend(),
+                           best, measured_ms=round(best_ms, 3),
+                           persist=persist)
+        _obs.emit('tuning_search', fp=program.fingerprint(),
+                  candidates=len(report), best=dict(best or {}),
+                  best_ms=round(best_ms, 3) if best_ms else None)
+        return best or {}, report

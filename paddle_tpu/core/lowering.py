@@ -147,12 +147,20 @@ class BlockRunner(object):
     ``dynamic`` marks the eager dynamic-program mode (executor runs the
     whole block unjitted with host control flow — beam decode); kernels
     branch on it for representations that cannot thread a lax loop
-    (list-backed tensor arrays, packed-LoD rows)."""
+    (list-backed tensor arrays, packed-LoD rows).
 
-    def __init__(self, block, grad_mode=False, dynamic=False):
+    ``keep`` guards the compiler's liveness annotations: the
+    buffer_reuse pass marks each op with the names whose LAST reader it
+    is (``__release__`` attr) and run_ops drops those environment
+    references once the op completes — unless the name is in ``keep``
+    (fetches, persistable state, the PRNG key), which the pass could
+    not know statically."""
+
+    def __init__(self, block, grad_mode=False, dynamic=False, keep=None):
         self.block = block
         self.grad_mode = grad_mode
         self.dynamic = dynamic
+        self.keep = keep if keep is not None else frozenset()
 
     def run_ops(self, ops, env):
         from ..debugging import nan_checks_enabled
@@ -212,6 +220,15 @@ class BlockRunner(object):
                     spec = getattr(var, 'sharding', None)
                     if spec and name in env:
                         env[name] = _constrain(env[name], spec, mesh)
+            rel = op.attrs.get('__release__')
+            if rel:
+                # compiler buffer_reuse annotation: this op was the
+                # last reader — drop the reference so the buffer is
+                # reusable (eager mode frees it now; under jit XLA's
+                # live range ends here instead of at block end)
+                for name in rel:
+                    if name not in self.keep:
+                        env.pop(name, None)
         return env
 
 
@@ -360,7 +377,8 @@ def _register_gradient_marker():
         def g(input_vals):
             genv = dict(base_env)
             genv.update(input_vals)
-            runner = BlockRunner(block, grad_mode=True, dynamic=dynamic)
+            runner = BlockRunner(block, grad_mode=True, dynamic=dynamic,
+                                 keep=frozenset(target_names))
             for o in path:
                 runner.run_ops([o], genv)
                 for n in o.output_arg_names:
@@ -398,7 +416,7 @@ def _register_gradient_marker():
 _register_gradient_marker()
 
 
-def _run_remat_segments(block, ops, env, grad_mode):
+def _run_remat_segments(block, ops, env, grad_mode, keep=None):
     """memory_optimize() path: execute the forward as ~sqrt(N) segments,
     each under jax.checkpoint, so backward keeps only segment-boundary
     activations and recomputes inside segments (classic sqrt-N remat).
@@ -435,7 +453,7 @@ def _run_remat_segments(block, ops, env, grad_mode):
         def seg(vals, _chunk=tuple(chunk), _reads=tuple(reads),
                 _writes=tuple(writes)):
             senv = dict(zip(_reads, vals))
-            BlockRunner(block, grad_mode=grad_mode).run_ops(
+            BlockRunner(block, grad_mode=grad_mode, keep=keep).run_ops(
                 list(_chunk), senv)
             return tuple(senv.get(n) for n in _writes)
 
@@ -612,6 +630,11 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
             ops = pre + ops[marker_idx:]
             marker_idx = len(pre)
 
+    # names the compiler's release annotations must never drop from the
+    # environment: the epilogue below still reads them
+    keep = (frozenset(fetch_names) | frozenset(state_out_names)
+            | frozenset(static_env or ()) | {RNG_KEY})
+
     def fn(feeds, state):
         env = {}
         if static_env:
@@ -619,7 +642,8 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
         env.update(state)
         env.update(feeds)
         if marker_idx < 0:
-            BlockRunner(block, dynamic=dynamic).run_ops(ops, env)
+            BlockRunner(block, dynamic=dynamic, keep=keep).run_ops(
+                ops, env)
         else:
             marker = ops[marker_idx]
             param_names = [p for p in marker.attrs['params']]
@@ -651,6 +675,12 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
 
             remat = bool(getattr(program, '_remat', False))
 
+            # sparse lookup ids are read through marker ATTRS (invisible
+            # to the liveness pass) — pin them alongside the loss
+            gkeep = keep | {loss_name} | {
+                p[0] for pairs in (marker.attrs.get('sparse') or {}
+                                   ).values() for p in pairs}
+
             def g(param_vals):
                 genv = dict(base_env)
                 genv.update(param_vals)
@@ -658,10 +688,11 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
                     # memory_optimize() hint: sqrt-N segmented
                     # rematerialization (the TPU-meaningful analogue of
                     # the reference's liveness-based buffer reuse)
-                    _run_remat_segments(block, pre, genv, True)
+                    _run_remat_segments(block, pre, genv, True,
+                                        keep=gkeep)
                 else:
-                    BlockRunner(block, grad_mode=True,
-                                dynamic=dynamic).run_ops(pre, genv)
+                    BlockRunner(block, grad_mode=True, dynamic=dynamic,
+                                keep=gkeep).run_ops(pre, genv)
                 loss = genv[loss_name]
                 return jnp.sum(loss), genv
 
@@ -705,7 +736,8 @@ def lower_block(program, block, feed_names, fetch_names, state_in_names,
                 if scale is not None and scale != 1.0:
                     gval = gval * scale
                 env[gname] = gval
-            BlockRunner(block, dynamic=dynamic).run_ops(post, env)
+            BlockRunner(block, dynamic=dynamic, keep=keep).run_ops(
+                post, env)
 
         fetches = [env[n] for n in fetch_names]
         new_state = {}

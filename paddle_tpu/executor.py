@@ -246,13 +246,19 @@ def program_cache_key(program, feed, static_env, fetch_names, state_in,
     """The jit-cache key shared by Executor.run and ParallelExecutor.run
     — ONE builder so a new invalidation dimension can never be added to
     one executor and missed in the other (static shape-feed VALUES are
-    part of the key: a new shape value must retrace)."""
-    return (program.fingerprint(),
-            tuple(sorted((n, _spec(v)) for n, v in feed.items())),
+    part of the key: a new shape value must retrace). The compiler's
+    token (pass-pipeline config + per-shape tuning-cache entry) rides
+    in here too, so toggling optimization or landing a new tuning
+    result can never serve a stale compiled program."""
+    from . import compiler as _compiler
+    fp = program.fingerprint()
+    feed_sig = tuple(sorted((n, _spec(v)) for n, v in feed.items()))
+    return (fp, feed_sig,
             tuple(sorted((n, v.dtype.str, v.shape, v.tobytes())
                          for n, v in static_env.items())),
             tuple(fetch_names), tuple(state_in), tuple(state_out),
-            guard, lowering.MERGE_SHARED_MULS[0]) + tuple(extra)
+            guard, lowering.MERGE_SHARED_MULS[0],
+            _compiler.cache_token(fp, feed_sig)) + tuple(extra)
 
 
 def _stack_steps(*xs):
@@ -505,6 +511,34 @@ class Executor(object):
         pruned = program.prune(targets)
         return pruned
 
+    def _optimized_program(self, program, fetch_names, scope=None,
+                           dynamic=False):
+        """The compiler hook: prune to fetches (as before), then run
+        the canonical pass pipeline (paddle_tpu.compiler, COMPILER.md)
+        over a clone. Memoized per (fingerprint, pipeline signature,
+        fetch set) on the program, so steady-state runs never re-run
+        the passes. Dynamic (eager beam-decode) programs lower raw."""
+        from . import compiler as _compiler
+        pruned = self._maybe_prune(program, fetch_names)
+        if dynamic or not _compiler.enabled():
+            return pruned
+        memo = program.__dict__.setdefault('_compiler_memo', {})
+        key = (program.fingerprint(), _compiler.pipeline_signature(),
+               tuple(sorted(fetch_names)))
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        try:
+            opt, _results = _compiler.optimize(
+                pruned, fetch_names=fetch_names, scope=scope,
+                clone=pruned is program)
+        except Exception:
+            # an optimizer bug must degrade to raw lowering, never take
+            # the step down with it
+            opt = pruned
+        memo[key] = opt
+        return opt
+
     def _pull_program_readers(self, program, feed, scope=None,
                               consume=True, fetch_names=None):
         """Program readers (open_recordio_file / random_data_generator
@@ -654,6 +688,20 @@ class Executor(object):
             static_env[n] = np.asarray(as_numpy(feed.pop(n)))
         return static_env
 
+    def _apply_tuning(self, key, jitted):
+        """Compile-time tuning-cache consultation (COMPILER.md): when a
+        persisted entry exists for this (program, shape, backend), the
+        compiled callable runs under its knobs — the first (tracing)
+        call bakes them in, and the entry's token is already part of
+        ``key`` via program_cache_key."""
+        from . import compiler as _compiler
+        if not _compiler.enabled():
+            return jitted
+        entry = _compiler.tuning.default_cache().lookup(
+            key[0], _compiler.tuning.shape_signature(key[1]),
+            _compiler.tuning.backend())
+        return _compiler.tuning.wrap_jitted(jitted, entry)
+
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name='feed', fetch_var_name='fetch', scope=None,
             return_numpy=True, use_program_cache=True,
@@ -696,7 +744,8 @@ class Executor(object):
             if entry is None:
                 self._cache_misses += 1
                 _obs.emit('compile_begin', fp=key[0])
-                lower_prog = self._maybe_prune(program, fetch_names)
+                lower_prog = self._optimized_program(
+                    program, fetch_names, scope=scope, dynamic=dynamic)
                 fn = lower_block(lower_prog, lower_prog.global_block(),
                                  sorted(feed.keys()), fetch_names,
                                  state_in_names, state_out_names,
@@ -714,6 +763,7 @@ class Executor(object):
                     jitted = jax.jit(checkify.checkify(fn))
                 else:
                     jitted = jax.jit(fn, donate_argnums=(1,))
+                jitted = self._apply_tuning(key, jitted)
                 self._cache[key] = jitted
             else:
                 self._cache_hits += 1
@@ -867,13 +917,16 @@ class Executor(object):
             if entry is None:
                 self._cache_misses += 1
                 _obs.emit('compile_begin', fp=key[0], chain=k)
-                lower_prog = self._maybe_prune(program, fetch_names)
+                lower_prog = self._optimized_program(program,
+                                                     fetch_names,
+                                                     scope=scope)
                 fn = lowering.lower_block_chained(
                     lower_prog, lower_prog.global_block(),
                     sorted(prepped[0].keys()), fetch_names,
                     state_in_names, state_out_names,
                     static_env=static_envs[0])
                 jitted = jax.jit(fn, donate_argnums=(1,))
+                jitted = self._apply_tuning(key, jitted)
                 self._cache[key] = jitted
             else:
                 self._cache_hits += 1

@@ -32,6 +32,7 @@ from .clip import (ErrorClipByValue, GradientClipByValue,  # noqa
 from .initializer import init_on_cpu  # noqa
 from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,  # noqa
                       BeginStepEvent, EndStepEvent, CheckpointConfig)
+from . import compiler  # noqa
 from . import resilience  # noqa
 from .resilience import AnomalyGuard, AnomalyError  # noqa
 from .inferencer import Inferencer  # noqa

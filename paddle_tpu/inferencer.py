@@ -21,10 +21,17 @@ __all__ = ['Inferencer']
 
 class Inferencer(object):
     def __init__(self, infer_func, param_path, place=None, parallel=False,
-                 bucket_batches=True, bucket_policy=None):
+                 bucket_batches=True, bucket_policy=None,
+                 optimize_for_inference=False):
         """``bucket_batches=False`` restores the raw one-compile-per-
         batch-size behavior; ``bucket_policy`` overrides the default
-        power-of-two :class:`~paddle_tpu.serving.BucketPolicy`."""
+        power-of-two :class:`~paddle_tpu.serving.BucketPolicy`.
+
+        ``optimize_for_inference=True`` runs the compiler's inference
+        pipeline (COMPILER.md) over the loaded program in place — BN
+        folding into the conv/fc weights just loaded from
+        ``param_path`` (<= 1e-5 drift) plus the exact canonical passes.
+        Opt-in because the fold rewrites the scope's weights."""
         self.param_path = param_path
         self.scope = executor.Scope()
         self.parallel = parallel
@@ -49,6 +56,12 @@ class Inferencer(object):
 
         with self._prog_and_scope_guard():
             io.load_params(executor.Executor(self.place), param_path)
+
+        if optimize_for_inference:
+            from . import compiler as _compiler
+            _compiler.optimize_inference(
+                self.inference_program, scope=self.scope,
+                fetch_names=[self.predict_var.name], clone=False)
 
         if parallel:
             from .parallel.parallel_executor import ParallelExecutor

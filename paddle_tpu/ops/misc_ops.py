@@ -459,6 +459,12 @@ def _flash_attention_op(ctx):
     qh = q.reshape(B, T, heads, dh)
     kh = k.reshape(B, T, heads, dh)
     vh = v.reshape(B, T, heads, dh)
+    # autotuned tile sizes, when the compiler's tuning cache holds an
+    # entry for this (program, shape, backend); (None, None) otherwise
+    # keeps the kernel's dtype-aware defaults
+    from ..compiler import tuning as _ctuning
+    bq, bk = _ctuning.flash_blocks()
     # NB: flash_attention applies the 1/sqrt(dh) logit scale itself
-    out = flash_attention(qh, kh, vh, causal=causal)
+    out = flash_attention(qh, kh, vh, causal=causal,
+                          block_q=bq, block_k=bk)
     ctx.set_output('Out', out.reshape(B, T, D))

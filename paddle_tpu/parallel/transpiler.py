@@ -10,10 +10,11 @@ Parity: python/paddle/fluid/transpiler/* —
   jax.distributed.initialize. The transpile() API is kept so reference
   scripts run unchanged; get_pserver_program returns a no-op heartbeat
   program and documents the mapping.
-- memory_optimization_transpiler: XLA already does liveness-based buffer
-  reuse; the shim keeps the API and records remat hints.
-- inference_transpiler: folds batch_norm into the preceding conv/fc at the
-  IR level (same rewrite as the reference's fuse pass).
+- memory_optimization_transpiler: facade over the compiler's
+  ``buffer_reuse`` liveness pass plus the remat hint (COMPILER.md).
+- inference_transpiler: facade over the compiler's ``bn_fold`` pass —
+  folds batch_norm into the preceding conv/fc at the IR level (same
+  rewrite as the reference's fuse pass).
 """
 import os
 
@@ -166,22 +167,29 @@ SimpleDistributeTranspiler = DistributeTranspilerSimple
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level=0):
-    """Parity: memory_optimization_transpiler.memory_optimize.
+    """Parity: memory_optimization_transpiler.memory_optimize — now a
+    facade over the compiler's ``buffer_reuse`` pass (COMPILER.md).
 
-    Buffer liveness/reuse is XLA's job and persistable state is already
-    donated by the Executor; what the TPU stack CAN still trade is
-    activation memory for recompute. This marks the program for
-    rematerialization: the lowering wraps the forward segment of a
-    training step in ``jax.checkpoint``, so the backward pass
-    recomputes activations instead of keeping them live — the moral
-    equivalent of the reference's in-place variable reuse, aimed at the
-    memory that actually dominates on TPU."""
+    Two layers: (1) the liveness pass annotates every op with the names
+    whose last reader it is (``__release__``), and lowering drops those
+    environment references as the block executes — the reference's
+    in-place variable reuse, with fetch/state names guarded at lowering
+    time; (2) the program is marked for rematerialization: the forward
+    segment of a training step runs under ``jax.checkpoint`` in sqrt-N
+    segments, trading recompute for the activation memory that actually
+    dominates on TPU."""
+    from ..compiler.pass_base import PassContext
+    from ..compiler.passes import BufferReuse
     input_program._remat = True
+    res = BufferReuse(skip=skip_opt_set).run(
+        input_program, PassContext(protected=frozenset(skip_opt_set
+                                                       or ())))
     input_program._bump_version()
     if print_log:
-        print("[paddle_tpu] memory_optimize: forward segment marked for "
-              "rematerialization (jax.checkpoint); buffer reuse is "
-              "XLA's, persistable state donated by the executor.")
+        print("[paddle_tpu] memory_optimize: %d buffer-release "
+              "annotations (compiler buffer_reuse pass) + forward "
+              "segment marked for rematerialization (jax.checkpoint)."
+              % res.vars_released)
     return input_program
 
 
@@ -190,98 +198,25 @@ def release_memory(input_program, skip_opt_set=None):
 
 
 class InferenceTranspiler(object):
-    """Parity: inference_transpiler.py (conv+bn fold).
+    """Parity: inference_transpiler.py (conv+bn fold) — now a facade
+    over the compiler's ``bn_fold`` pass (COMPILER.md).
 
-    The reference rewrites conv weights in place so inference programs
-    drop their batch_norm ops entirely
-    (python/paddle/fluid/transpiler/inference_transpiler.py::
-    _fuse_conv_bn / _fuse_param). Same rewrite here, at the Program IR
-    level: for every conv2d whose single consumer is a batch_norm,
+    For every conv2d/depthwise_conv2d/mul whose single consumer is a
+    batch_norm and whose weights are resident in the scope,
 
         w' = w * scale / sqrt(var + eps)        (per output channel)
         b' = bias - mean * scale / sqrt(var + eps)
 
     the BN op is REMOVED and an elementwise_add(axis=1) with the new
-    bias takes over BN's output name. Remaining BN/dropout ops are
-    flipped to test mode.
+    bias takes over BN's output name; remaining BN/dropout ops flip to
+    test mode. Same in-place contract and signature as the reference;
+    the rewrite itself lives in ``compiler.passes.BatchNormFolding``.
     """
 
     def transpile(self, program, place=None, scope=None):
+        from ..compiler.pass_base import PassContext
+        from ..compiler.passes import BatchNormFolding
         from ..executor import global_scope
-        scope = scope or global_scope()
-        self._fuse_conv_bn(program, scope)
-        self._mark_test_mode(program)
+        BatchNormFolding().run(program,
+                               PassContext(scope=scope or global_scope()))
         return program
-
-    def _consumers(self, program, name):
-        return [op for b in program.blocks for op in b.ops
-                if name in op.input_arg_names]
-
-    def _fuse_conv_bn(self, program, scope):
-        import numpy as np
-        block = program.global_block()
-        # a filter with ANY other consumer (another conv, a sub-block op,
-        # a fetch helper) cannot be rewritten in place: each use would
-        # need its own scaled copy
-        filter_uses = {}
-        for b in program.blocks:
-            for op in b.ops:
-                for name in op.input_arg_names:
-                    filter_uses[name] = filter_uses.get(name, 0) + 1
-        i = 0
-        while i < len(block.ops):
-            op = block.ops[i]
-            if op.type not in ('conv2d', 'depthwise_conv2d'):
-                i += 1
-                continue
-            out_name = op.outputs['Output'][0]
-            consumers = self._consumers(program, out_name)
-            if len(consumers) != 1 or consumers[0].type != 'batch_norm':
-                i += 1
-                continue
-            bn = consumers[0]
-            w_name = op.inputs['Filter'][0]
-            if filter_uses.get(w_name, 0) > 1:
-                i += 1
-                continue
-            vals = {}
-            ok = True
-            for slot in ('Scale', 'Bias', 'Mean', 'Variance'):
-                v = scope.raw(bn.inputs[slot][0])
-                if v is None:
-                    ok = False
-                    break
-                vals[slot] = np.asarray(v, np.float32)
-            w_val = scope.raw(w_name)
-            if not ok or w_val is None:
-                i += 1
-                continue
-            w_val = np.asarray(w_val, np.float32)
-            eps = float(bn.attrs.get('epsilon', 1e-5))
-            alpha = vals['Scale'] / np.sqrt(vals['Variance'] + eps)
-            new_w = w_val * alpha[:, None, None, None]
-            new_b = vals['Bias'] - vals['Mean'] * alpha
-
-            bias_var = block.create_var(
-                name=w_name + '.bn_fold_bias', shape=list(new_b.shape),
-                dtype='float32', persistable=True)
-            scope.set_var(w_name, new_w.astype(w_val.dtype))
-            scope.set_var(bias_var.name, new_b.astype(np.float32))
-
-            bn_idx = block.ops.index(bn)
-            bn_out = bn.outputs['Y'][0]
-            block.remove_op(bn_idx)
-            block.insert_op(bn_idx, type='elementwise_add',
-                            inputs={'X': [out_name],
-                                    'Y': [bias_var.name]},
-                            outputs={'Out': [bn_out]},
-                            attrs={'axis': 1})
-            i += 1
-        program._bump_version()
-
-    def _mark_test_mode(self, program):
-        for block in program.blocks:
-            for op in block.ops:
-                if op.type in ('batch_norm', 'dropout'):
-                    op.attrs['is_test'] = True
-        program._bump_version()

@@ -341,7 +341,16 @@ class ModelServer(object):
         """Pre-compile every shape bucket (one synthetic request per
         bucket through the public path) so live traffic never pays a
         compile. Returns ``{model: [bucket sizes warmed]}``; models
-        whose feed shapes are dynamic (unsynthesizable) are skipped."""
+        whose feed shapes are dynamic (unsynthesizable) are skipped.
+
+        Before the first bucket compiles, the on-disk tuning cache
+        (COMPILER.md) is preloaded, so every warmup compile — and every
+        later live compile — runs under the autotuned per-shape configs
+        instead of re-deriving defaults: fast cold-start is the whole
+        point of paying the tuning search offline."""
+        from ..compiler import tuning as _ctuning
+        t0 = time.monotonic()
+        tuned = _ctuning.default_cache().preload()
         names = [model_name] if model_name is not None else self.models()
         warmed = {}
         with _prof.serving_span('serving/warmup'):
@@ -361,7 +370,13 @@ class ModelServer(object):
                     warmed[name].append(bucket)
             for req in pending:
                 req.result(timeout=timeout)
-        return {k: v for k, v in warmed.items() if v}
+        warmed = {k: v for k, v in warmed.items() if v}
+        _obs.emit('serving_warmup',
+                  models=len(warmed),
+                  buckets=sum(len(v) for v in warmed.values()),
+                  tuning_entries=tuned,
+                  dur_s=round(time.monotonic() - t0, 6))
+        return warmed
 
     # ---- ops control -----------------------------------------------------
     def pause(self, model_name=None):

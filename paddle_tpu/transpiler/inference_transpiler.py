@@ -1,4 +1,9 @@
-"""Parity: python/paddle/fluid/transpiler/inference_transpiler.py."""
+"""Parity: python/paddle/fluid/transpiler/inference_transpiler.py.
+
+The legacy entry point now routes through the compiler's ``bn_fold``
+pass (paddle_tpu.compiler.passes.BatchNormFolding, COMPILER.md) with
+the same in-place transpile(program, place, scope) signature.
+"""
 from ..parallel.transpiler import InferenceTranspiler  # noqa
 
 __all__ = ['InferenceTranspiler']

@@ -1,4 +1,10 @@
-"""Parity: python/paddle/fluid/transpiler/memory_optimization_transpiler.py."""
+"""Parity: python/paddle/fluid/transpiler/memory_optimization_transpiler.py.
+
+The legacy entry point now routes through the compiler's
+``buffer_reuse`` liveness pass (paddle_tpu.compiler.passes.BufferReuse,
+COMPILER.md) plus the rematerialization hint, with the same
+memory_optimize(program, skip_opt_set, print_log, level) signature.
+"""
 from ..parallel.transpiler import memory_optimize, release_memory  # noqa
 
 __all__ = ['memory_optimize', 'release_memory']

@@ -59,3 +59,8 @@ def pytest_configure(config):
         'pipeline: tests of the pipelined training hot loop — async '
         'prefetch, K-step chained dispatch, non-blocking fetch '
         '(tier-1; filter with -m "not pipeline")')
+    config.addinivalue_line(
+        'markers',
+        'compiler: tests of the paddle_tpu.compiler pass pipeline — '
+        'semantic equivalence, pass idempotence, cache keying, tuning '
+        'cache (tier-1; filter with -m "not compiler")')

@@ -16,15 +16,18 @@ lines, or lacks the required records (``--require step`` by default —
 a training journal must hold step records; ``--require serving`` for a
 serving soak; ``--require pipeline`` for a pipelined-trainer run —
 step records must carry the ``feed_wait`` host-wait field; ``--require
-any`` for presence only). ``tools/serve_bench.py --smoke`` runs this
-gate over the journal its load run writes.
+compiler`` for a run that must have gone through the compiler pass
+pipeline (``compile_pass`` records); ``--require any`` for presence
+only). ``tools/serve_bench.py --smoke`` runs this gate over the
+journal its load run writes.
 """
 import argparse
 import json
 import sys
 
 REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
-               'pipeline': 'step_end', 'any': None}
+               'pipeline': 'step_end', 'compiler': 'compile_pass',
+               'any': None}
 
 
 def load_journal(path):
@@ -68,6 +71,41 @@ def _pipeline_summary(steps, duration):
         'dispatch_total_s': sum(dispatches),
         'chained_steps': len(chained),
         'mean_chain': _mean([r['chain'] for r in chained]),
+    }
+
+
+def _compiler_summary(by_ev):
+    """Compiler SLI (COMPILER.md): per-pass wall + rewrite counts from
+    ``compile_pass`` events, tuning-cache behavior from
+    ``tuning_lookup``/``tuning_preload``/``tuning_put``."""
+    passes = {}
+    for r in by_ev.get('compile_pass', ()):
+        p = passes.setdefault(r.get('pass', '?'), {
+            'runs': 0, 'total_s': 0.0, 'removed': 0, 'fused': 0,
+            'released': 0})
+        p['runs'] += 1
+        p['total_s'] += r.get('dur_s', 0.0)
+        p['removed'] += r.get('removed', 0)
+        p['fused'] += r.get('fused', 0)
+        p['released'] += r.get('released', 0)
+    lookups = by_ev.get('tuning_lookup', ())
+    hits = sum(1 for r in lookups if r.get('hit'))
+    return {
+        'passes': passes,
+        'pass_wall_s': sum(p['total_s'] for p in passes.values()),
+        'ops_eliminated': sum(p['removed'] for p in passes.values()),
+        'ops_fused': sum(p['fused'] for p in passes.values()),
+        'tuning': {
+            'lookups': len(lookups),
+            'hits': hits,
+            'misses': len(lookups) - hits,
+            'hit_rate': hits / len(lookups) if lookups else 0.0,
+            'preloads': len(by_ev.get('tuning_preload', ())),
+            'entries_preloaded': sum(
+                r.get('entries', 0)
+                for r in by_ev.get('tuning_preload', ())),
+            'puts': len(by_ev.get('tuning_put', ())),
+        },
     }
 
 
@@ -139,6 +177,7 @@ def summarize(records, malformed=0):
         },
         'anomalies': len(by_ev.get('anomaly', ())),
         'pipeline': _pipeline_summary(steps, duration),
+        'compiler': _compiler_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -179,6 +218,29 @@ def render(summary, top=10):
             line += (' | %d steps chained (avg %.1f steps/dispatch)'
                      % (pl['chained_steps'], pl['mean_chain']))
         lines.append(line)
+    co = s.get('compiler') or {}
+    if co.get('passes'):
+        lines.append(
+            'compiler: %d pass runs, %.3fs total | %d ops eliminated, '
+            '%d fused' % (
+                sum(p['runs'] for p in co['passes'].values()),
+                co['pass_wall_s'], co['ops_eliminated'],
+                co['ops_fused']))
+        for name, p in sorted(co['passes'].items(),
+                              key=lambda kv: -kv[1]['total_s']):
+            lines.append(
+                '  %-18s %3d runs  %8.3fms  removed=%d fused=%d '
+                'released=%d' % (name, p['runs'], p['total_s'] * 1e3,
+                                 p['removed'], p['fused'],
+                                 p['released']))
+        tu = co['tuning']
+        if tu['lookups'] or tu['preloads'] or tu['puts']:
+            lines.append(
+                'tuning:   %d lookups (%d hits, %.0f%% hit rate) | '
+                '%d preloads (%d entries), %d puts'
+                % (tu['lookups'], tu['hits'], 100.0 * tu['hit_rate'],
+                   tu['preloads'], tu['entries_preloaded'],
+                   tu['puts']))
     ex = s['executor']
     if ex['runs']:
         lookups = ex['cache_hits'] + ex['cache_misses']
