@@ -871,6 +871,47 @@ def bench_compiler(on_tpu):
     return out_rec
 
 
+def bench_partition(on_tpu):
+    """paddle_tpu.partition (PARTITIONING.md): the pipelined Trainer
+    loop (prefetch=2, steps_per_dispatch=4 — the PR-5 clamps are gone)
+    through ParallelExecutor at mesh=1 (Partitioner CPU fallback,
+    plain jit) vs mesh=N host CPU devices (sharded pjit), feeding the
+    MULTICHIP_r0*.json trajectory. Runs in a SUBPROCESS because the
+    host-device count (XLA_FLAGS) must be fixed before jax initializes
+    — this process already brought a backend up. On CPU the sharded
+    mesh mostly proves correctness + compile plumbing (the dp win
+    needs real chips); losses_allclose is the gate that matters."""
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'tools', 'partition_bench.py')
+    devices = 2
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    env['JAX_PLATFORMS'] = 'cpu'
+    proc = subprocess.run(
+        [sys.executable, script, '--devices', str(devices),
+         '--steps', '12'],
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError('partition_bench failed (rc=%d): %s'
+                           % (proc.returncode, proc.stderr[-500:]))
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    log('partition: mesh=1 %.1f steps/s vs mesh=%d %.1f steps/s '
+        '(%.2fx); losses_allclose=%s'
+        % (out['mesh1']['steps_per_sec'], out['devices'],
+           out['meshN']['steps_per_sec'],
+           out['speedup_meshN_vs_mesh1'], out['losses_allclose']))
+    if not out['losses_allclose']:
+        raise RuntimeError('partition bench: sharded losses diverged '
+                           'from the mesh=1 fallback: %r' % (out,))
+    # the loss trajectories served their gate; drop them from the
+    # record to keep BENCH json compact
+    for k in ('mesh1', 'meshN'):
+        out[k] = {kk: vv for kk, vv in out[k].items()
+                  if kk != 'losses'}
+    return out
+
+
 def bench_memory(on_tpu):
     """Remat memory artifact (VERDICT r2 #8): XLA compiled memory
     analysis of the fluid transformer train step with and without
@@ -1170,6 +1211,7 @@ def main():
                     ('half_inference', bench_half_inference),
                     ('input_pipeline', bench_input_pipeline),
                     ('compiler', bench_compiler),
+                    ('partition', bench_partition),
                     ('memory', bench_memory)):
         try:
             record[key] = fn(on_tpu)

@@ -44,28 +44,42 @@ class SparseRows(object):
         return 'SparseRows(%d lookups, vocab=%d)' % (len(self.items),
                                                      self.vocab)
 
-# Mesh for with_sharding_constraint on Variable.sharding-annotated values.
-# Set (only) by ParallelExecutor while tracing; the plain Executor lowers
-# identically but unconstrained.
+# Mesh (+ optional spec resolver) for with_sharding_constraint on
+# Variable.sharding-annotated values. Set by the Partitioner's
+# trace_wrap while tracing a sharded program; the CPU-fallback path
+# lowers identically but unconstrained. The resolver (when given) is
+# Partitioner.resolve_spec — logical axis names resolve through its
+# rules; without one, raw mesh-axis specs are sanitized by clean_spec.
 _SHARDING_MESH = [None]
+_SHARDING_RESOLVER = [None]
 
 
 @contextlib.contextmanager
-def sharding_mesh(mesh):
-    prev = _SHARDING_MESH[0]
+def sharding_mesh(mesh, resolver=None):
+    prev, prev_r = _SHARDING_MESH[0], _SHARDING_RESOLVER[0]
     _SHARDING_MESH[0] = mesh
+    _SHARDING_RESOLVER[0] = resolver
     try:
         yield
     finally:
         _SHARDING_MESH[0] = prev
+        _SHARDING_RESOLVER[0] = prev_r
 
 
-def _constrain(val, spec, mesh):
+def active_sharding_mesh():
+    """(mesh, resolver) of the trace in progress, or (None, None)."""
+    return _SHARDING_MESH[0], _SHARDING_RESOLVER[0]
+
+
+def _constrain(val, spec, mesh, resolver=None):
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from ..parallel.mesh import clean_spec
     if not isinstance(val, jax.Array) or not getattr(val, 'ndim', 0):
         return val
-    spec = clean_spec(spec, mesh, ndim=val.ndim)
+    if resolver is not None:
+        spec = resolver(spec, ndim=val.ndim, shape=val.shape)
+    else:
+        from ..parallel.mesh import clean_spec
+        spec = clean_spec(spec, mesh, ndim=val.ndim)
     if all(e is None for e in spec):
         return val
     return jax.lax.with_sharding_constraint(
@@ -219,7 +233,8 @@ class BlockRunner(object):
                     var = self.block._find_var_recursive(name)
                     spec = getattr(var, 'sharding', None)
                     if spec and name in env:
-                        env[name] = _constrain(env[name], spec, mesh)
+                        env[name] = _constrain(env[name], spec, mesh,
+                                               _SHARDING_RESOLVER[0])
             rel = op.attrs.get('__release__')
             if rel:
                 # compiler buffer_reuse annotation: this op was the

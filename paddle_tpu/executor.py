@@ -249,7 +249,11 @@ def program_cache_key(program, feed, static_env, fetch_names, state_in,
     part of the key: a new shape value must retrace). The compiler's
     token (pass-pipeline config + per-shape tuning-cache entry) rides
     in here too, so toggling optimization or landing a new tuning
-    result can never serve a stale compiled program."""
+    result can never serve a stale compiled program. Callers append
+    the Partitioner's cache token via ``*extra`` — (mesh shape, device
+    ids, resolved sharding signature) — so one Executor can serve the
+    same program on different meshes/shardings with exactly one
+    compile per (fingerprint, sharding, mesh) triple."""
     from . import compiler as _compiler
     fp = program.fingerprint()
     feed_sig = tuple(sorted((n, _spec(v)) for n, v in feed.items()))
@@ -326,8 +330,16 @@ def _is_dynamic_program(program):
 
 
 class Executor(object):
-    def __init__(self, place=None):
+    def __init__(self, place=None, partitioner=None):
         self.place = place or _places.TPUPlace(0)
+        # Placement owner (PARTITIONING.md): every Executor dispatches
+        # through a Partitioner. None defers to the lazy CPU-fallback
+        # partitioner for `place` (a 1-device mesh -> plain jit,
+        # bit-identical to the classic single-device executor);
+        # ParallelExecutor and a sharded ModelServer pass a real-mesh
+        # partitioner and the SAME run/run_chained code paths compile
+        # sharded programs instead.
+        self._partitioner = partitioner
         # serving worker threads share one Executor so padded batches of
         # every model land in ONE compiled-program cache; the lock makes
         # lookup+insert atomic (lower_block itself is cheap — XLA
@@ -356,6 +368,20 @@ class Executor(object):
         self._m_compile = reg.histogram(
             'executor_compile_seconds',
             'lowering + first (compiling) execution wall per cache miss')
+
+    @property
+    def partitioner(self):
+        if self._partitioner is None:
+            from .partition import Partitioner
+            self._partitioner = Partitioner.for_place(self.place)
+        return self._partitioner
+
+    def set_partitioner(self, partitioner):
+        """Swap the placement owner. Compiled programs for the old
+        mesh stay cached (their keys carry the old partition token);
+        subsequent runs compile/lookup under the new one."""
+        self._partitioner = partitioner
+        return partitioner
 
     def cache_info(self):
         """Compiled-program cache counters: a serving-layer SLI. A miss
@@ -735,12 +761,24 @@ class Executor(object):
         from . import profiler as _prof
         guard = nan_checks_enabled()
         profiling = _prof.op_profiling_enabled()
+        part = self.partitioner
+        # eager paths (per-op profiling, dynamic beam decode) cannot run
+        # a sharded whole-block program; they stay single-device
+        sharded = part.active and not (profiling or dynamic)
         key = program_cache_key(program, feed, static_env, fetch_names,
                                 state_in_names, state_out_names, guard,
-                                profiling)
+                                profiling, part.cache_token(program))
         t_lookup = time.perf_counter()
+        feeds_s = state_s = None
         with self._cache_lock:
             entry = self._cache.get(key)
+            if sharded:
+                # memoized per (fingerprint, mesh, names): the commit
+                # below needs them every sharded step without a
+                # per-step block walk
+                state_s = part.state_shardings(program, state_in_names)
+            if sharded and (entry is None or part.multiprocess):
+                feeds_s = part.feed_shardings(feed)
             if entry is None:
                 self._cache_misses += 1
                 _obs.emit('compile_begin', fp=key[0])
@@ -755,6 +793,30 @@ class Executor(object):
                     # run UN-jitted: the lowering executes op by op on the
                     # device with concrete values and host control flow.
                     jitted = fn
+                elif sharded:
+                    out_state_s = part.state_shardings(program,
+                                                       state_out_names)
+                    # fetches come back fully replicated: every process
+                    # must be able to materialize numpy, and leaving
+                    # them unspecified lets XLA pick a dp-sharded
+                    # layout that the donated (replicated) state
+                    # buffers cannot alias — a runtime INTERNAL error
+                    # on same-global-shape pairs (caught by the verify
+                    # drive on the sharded inference path)
+                    fetch_s = part.replicated
+                    fn = part.trace_wrap(fn)
+                    if guard:
+                        from jax.experimental import checkify
+                        jitted = part.partition(
+                            checkify.checkify(fn),
+                            in_shardings=(feeds_s, state_s),
+                            out_shardings=(None, (fetch_s,
+                                                  out_state_s)))
+                    else:
+                        jitted = part.partition(
+                            fn, in_shardings=(feeds_s, state_s),
+                            out_shardings=(fetch_s, out_state_s),
+                            donate_argnums=(1,))
                 elif guard:
                     # Debug mode: functionalize the per-op NaN/Inf checks.
                     # No donation — on a thrown error the scope must still
@@ -762,7 +824,7 @@ class Executor(object):
                     from jax.experimental import checkify
                     jitted = jax.jit(checkify.checkify(fn))
                 else:
-                    jitted = jax.jit(fn, donate_argnums=(1,))
+                    jitted = part.partition(fn, donate_argnums=(1,))
                 jitted = self._apply_tuning(key, jitted)
                 self._cache[key] = jitted
             else:
@@ -772,9 +834,19 @@ class Executor(object):
         (self._m_misses if was_miss else self._m_hits).inc()
 
         state = {n: scope.raw(n) for n in state_in_names}
+        if sharded and part.multiprocess:
+            feed, state = part.globalize(feed, state, feeds_s, state_s)
+        elif sharded:
+            # pjit refuses mesh-committed args whose sharding drifted
+            # from the declared in_shardings (e.g. state committed
+            # replicated before a ZeRO re-annotation): re-commit just
+            # those through the Partitioner; everything else passes
+            # untouched
+            state = part.reconcile_state(state, state_s)
 
         t_run = time.perf_counter()
-        with jax.default_device(self.place.jax_device()):
+        with part.run_context() if sharded else \
+                jax.default_device(self.place.jax_device()):
             if guard and not (profiling or dynamic):
                 err, (fetches, new_state) = jitted(feed, state)
                 err.throw()
@@ -868,8 +940,12 @@ class Executor(object):
             isinstance(v, ReaderVar) and getattr(v, 'source', None)
             is not None
             for v in program.global_block().vars.values())
+        part = self.partitioner
         if k == 1 or dynamic or nan_checks_enabled() or \
-                _prof.op_profiling_enabled() or has_reader:
+                _prof.op_profiling_enabled() or has_reader or \
+                (part.active and part.multiprocess):
+            # multi-process chaining would need per-step globalize
+            # inside the scan; sequential runs are correct and simple
             return _sequential()
 
         fetch_names = [f.name if isinstance(f, Variable) else f
@@ -910,10 +986,18 @@ class Executor(object):
 
         key = program_cache_key(program, prepped[0], static_envs[0],
                                 fetch_names, state_in_names,
-                                state_out_names, False, 'chain')
+                                state_out_names, False, 'chain',
+                                part.cache_token(program))
         t_lookup = time.perf_counter()
+        state_s = stacked_s = None
         with self._cache_lock:
             entry = self._cache.get(key)
+            if part.active:
+                # the commit below needs these every chunk (state
+                # shardings are memoized per fingerprint; the stacked
+                # feed shardings walk only the feed dict)
+                state_s = part.state_shardings(program, state_in_names)
+                stacked_s = part.stacked_feed_shardings(prepped[0])
             if entry is None:
                 self._cache_misses += 1
                 _obs.emit('compile_begin', fp=key[0], chain=k)
@@ -925,7 +1009,22 @@ class Executor(object):
                     sorted(prepped[0].keys()), fetch_names,
                     state_in_names, state_out_names,
                     static_env=static_envs[0])
-                jitted = jax.jit(fn, donate_argnums=(1,))
+                if part.active:
+                    # K-step chain over the mesh: stacked feeds shard
+                    # their per-step batch dim, the scan carry keeps
+                    # each state var's own sharding
+                    out_state_s = part.state_shardings(
+                        program, state_out_names)
+                    jitted = part.partition(
+                        part.trace_wrap(fn),
+                        in_shardings=(stacked_s, state_s),
+                        # stacked fetches replicated (prefix-broadcast
+                        # over the fetch list) for the same donation-
+                        # aliasing reason as the single-step path
+                        out_shardings=(part.replicated, out_state_s),
+                        donate_argnums=(1,))
+                else:
+                    jitted = part.partition(fn, donate_argnums=(1,))
                 jitted = self._apply_tuning(key, jitted)
                 self._cache[key] = jitted
             else:
@@ -936,15 +1035,26 @@ class Executor(object):
 
         state = {n: scope.raw(n) for n in state_in_names}
         t_run = time.perf_counter()
-        with jax.default_device(self.place.jax_device()):
-            # commit the state to the run device BEFORE the first call:
-            # prefetch-staged feeds arrive committed, while fresh
+        with part.run_context() if part.active else \
+                jax.default_device(self.place.jax_device()):
+            # commit the state to its run placement BEFORE the first
+            # call: prefetch-staged feeds arrive committed, while fresh
             # startup state is uncommitted — without this the second
             # chunk's jit signature differs (state now = committed jit
             # outputs) and silently retraces+recompiles the whole
-            # K-step program once more. device_put on already-committed
-            # same-device arrays is a no-op.
-            state = jax.device_put(state, self.place.jax_device())
+            # K-step program once more. The Partitioner owns the
+            # placement: single device on the fallback mesh, per-var
+            # NamedSharding on a real one (the PR-5 "single-device
+            # commits fight pjit's NamedSharding" conflict dissolves
+            # here). device_put on already-committed matching arrays is
+            # a no-op.
+            state = part.commit_state(state, state_s)
+            if part.active:
+                # device-stacked prefetch-staged feeds come out of
+                # jnp.stack committed with whatever sharding XLA
+                # propagated; re-commit any that drifted from the
+                # declared in_shardings
+                stacked = part.reconcile(stacked, stacked_s)
             fetches, new_state = jitted(stacked, state)
         run_wall = time.perf_counter() - t_run
         self._m_run.observe(run_wall)

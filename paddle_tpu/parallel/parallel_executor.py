@@ -7,15 +7,21 @@ NCCL. TPU design: ONE program, batch dimension sharded over mesh axis 'dp',
 parameters replicated; XLA's SPMD partitioner inserts the gradient psum
 (over ICI) automatically. Multi-host: call jax.distributed.initialize first
 (see paddle_tpu.parallel.transpiler).
+
+Since the partition subsystem landed (PARTITIONING.md) this class is a
+thin facade: it builds a :class:`~paddle_tpu.partition.Partitioner` for
+its mesh and hands every run to the SAME ``Executor.run`` /
+``Executor.run_chained`` code path the single-device executor uses —
+one dispatch engine, one compiled-program cache (keys carry the
+partitioner's (mesh, sharding) token), K-step chaining and async fetch
+included.
 """
 import numpy as np
 import jax
 
-from ..executor import Executor, global_scope, as_numpy
-from ..framework import default_main_program, Program, Variable
-from ..core.lowering import lower_block, RNG_KEY
-from ..lod import SequenceTensor
-from .mesh import get_mesh
+from ..executor import Executor, global_scope
+from ..framework import default_main_program
+from ..partition import Partitioner
 
 __all__ = ['ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy']
 
@@ -61,160 +67,69 @@ class ParallelExecutor(object):
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, num_threads=None,
                  allow_op_delay=False, use_tpu=True, num_devices=None,
-                 mesh=None, exec_strategy=None, build_strategy=None):
+                 mesh=None, partitioner=None, exec_strategy=None,
+                 build_strategy=None):
         self._program = main_program or default_main_program()
-        self._mesh = mesh or get_mesh(num_devices)
+        if partitioner is None:
+            partitioner = Partitioner(mesh=mesh, num_devices=num_devices)
+        self._partitioner = partitioner
+        self._mesh = partitioner.mesh
         self._loss_name = loss_name
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._build_strategy = build_strategy or BuildStrategy()
-        self._exe = Executor()
+        self._exe = Executor(partitioner=partitioner)
         if share_vars_from is not None:
             # parity: share scope with the training ParallelExecutor
             self._scope = share_vars_from._scope
         else:
             self._scope = global_scope()
-        self._cache = {}
+
+    @property
+    def partitioner(self):
+        return self._partitioner
 
     @property
     def device_count(self):
-        return int(np.prod(list(self._mesh.shape.values())))
+        return self._partitioner.device_count
+
+    def cache_info(self):
+        return self._exe.cache_info()
+
+    def reset_cache_info(self):
+        return self._exe.reset_cache_info()
 
     def _var_sharding(self, name):
-        """NamedSharding for a state var: Variable.sharding (set via
-        ParamAttr(sharding=...) / set_sharding / the ZeRO transpiler) is
-        honored; axis names absent from this mesh degrade to replicated
-        on that dim. Default: replicated (reference semantics)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from .mesh import clean_spec
-        mesh = self._mesh
-        var = self._program.global_block()._find_var_recursive(name)
-        spec = getattr(var, 'sharding', None) if var is not None else None
-        if not spec:
-            return NamedSharding(mesh, P())
-        spec = clean_spec(spec, mesh)
-        # a sharding decided against a different world size (e.g. ZeRO
-        # slicing at transpile time before the mesh existed) may not
-        # divide this mesh's extent — degrade that dim to replicated
-        # rather than failing the whole step
-        extents = dict(zip(mesh.axis_names, mesh.devices.shape))
-        shape = getattr(var, 'shape', None) or ()
-        for d, entry in enumerate(spec):
-            if entry is None or d >= len(shape):
-                continue
-            names = entry if isinstance(entry, (tuple, list)) else (entry,)
-            e = int(np.prod([extents.get(a, 1) for a in names]))
-            if e and int(shape[d]) % e != 0:
-                spec[d] = None
-        return NamedSharding(mesh, P(*spec))
+        """Facade kept for callers of the pre-partitioner API."""
+        return self._partitioner.var_sharding(self._program, name)
 
     def _shardings(self, feed, state_names):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        mesh = self._mesh
-        repl = NamedSharding(mesh, P())
+        part = self._partitioner
+        return (part.feed_shardings(feed),
+                part.state_shardings(self._program, state_names),
+                part.replicated)
 
-        def feed_shard(v):
-            if isinstance(v, SequenceTensor):
-                return SequenceTensor(
-                    NamedSharding(mesh, P('dp')), NamedSharding(mesh,
-                                                                P('dp')),
-                    None if v.sub_lengths is None else
-                    NamedSharding(mesh, P('dp')))
-            return NamedSharding(mesh, P('dp'))
-
-        feeds_s = {k: feed_shard(v) for k, v in feed.items()}
-        state_s = {n: self._var_sharding(n) for n in state_names}
-        return feeds_s, state_s, repl
-
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True, async_fetch=False):
         feed = feed if feed is not None else feed_dict or {}
-        program = self._program
-        scope = self._scope
-        fetch_names, feed, state_in, state_out, static_env = \
-            self._exe._prep_lowering(program, feed, fetch_list, scope)
+        return self._exe.run(program=self._program, feed=feed,
+                             fetch_list=fetch_list or [],
+                             scope=self._scope,
+                             return_numpy=return_numpy,
+                             async_fetch=async_fetch)
 
-        from ..executor import program_cache_key
-        from ..debugging import nan_checks_enabled
-        guard = nan_checks_enabled()
-        key = program_cache_key(program, feed, static_env, fetch_names,
-                                state_in, state_out, guard)
-        multiproc = jax.process_count() > 1
-        jitted = self._cache.get(key)
-        if jitted is None or multiproc:
-            # only the cache-miss path and the multi-process globalize
-            # path consume the shardings; skip the per-step block walk
-            # on the single-process hot path
-            feeds_s, state_s, repl = self._shardings(feed, state_in)
-        if jitted is None:
-            from ..core import lowering as _lowering
-            fn = lower_block(program, program.global_block(),
-                             sorted(feed.keys()), fetch_names, state_in,
-                             state_out, static_env=static_env)
-
-            def fn_with_mesh(feeds, state, _fn=fn):
-                # activations with Variable.sharding get a
-                # with_sharding_constraint during tracing
-                with _lowering.sharding_mesh(self._mesh):
-                    return _fn(feeds, state)
-
-            out_state_s = {n: self._var_sharding(n) for n in state_out}
-            # multi-process: fetches must come back fully replicated so
-            # every process can materialize them as numpy
-            fetch_s = repl if multiproc else None
-            if guard:
-                # debug mode: functionalize per-op NaN/Inf checks; no
-                # donation so state survives a thrown error
-                from jax.experimental import checkify
-                jitted = jax.jit(
-                    checkify.checkify(fn_with_mesh),
-                    in_shardings=(feeds_s, state_s),
-                    out_shardings=(None, (fetch_s, out_state_s)))
-            else:
-                jitted = jax.jit(
-                    fn_with_mesh, in_shardings=(feeds_s, state_s),
-                    out_shardings=(fetch_s, out_state_s),
-                    donate_argnums=(1,))
-            self._cache[key] = jitted
-
-        state = {n: scope.raw(n) for n in state_in}
-        if multiproc:
-            # Each process feeds its LOCAL batch shard (the reference's
-            # per-trainer reader semantics); host-local values become
-            # global arrays over the multi-process mesh. Replicated
-            # state (params, RNG key) passes the full local value.
-            def _globalize(v, s, full_value):
-                if isinstance(v, jax.Array) and not v.is_fully_addressable:
-                    return v          # already a global array (prev step)
-                arr = np.asarray(v)
-                # full_value: every process holds the WHOLE tensor
-                # (startup-initialized state) — pass global_shape so a
-                # dp-sharded var (ZeRO slice) extracts this process's
-                # shards instead of inferring a nprocs-times-larger
-                # global. Feeds are per-process chunks: infer global.
-                return jax.make_array_from_process_local_data(
-                    s, arr, global_shape=arr.shape if full_value
-                    else None)
-            feed = jax.tree_util.tree_map(
-                lambda v, s: _globalize(v, s, False), feed, feeds_s)
-            # state shardings are per-var NamedShardings; broadcast over
-            # the (possibly pytree) state value's leaves
-            state = {n: jax.tree_util.tree_map(
-                lambda v, s=state_s[n]: _globalize(v, s, True), state[n])
-                for n in state}
-        with self._mesh:
-            if guard:
-                err, (fetches, new_state) = jitted(feed, state)
-                err.throw()
-            else:
-                fetches, new_state = jitted(feed, state)
-        for n, v in new_state.items():
-            scope.set_var(n, v)
-        if getattr(program, '_half_inference', None):
-            # Float16Transpiler boundary contract, same as Executor.run
-            from ..executor import _to_f32_fetch
-            fetches = [_to_f32_fetch(f) for f in fetches]
-        if return_numpy:
-            fetches = [as_numpy(f) for f in fetches]
-        return fetches
+    def run_chained(self, feed_list=None, fetch_list=None,
+                    return_numpy=True, async_fetch=False, program=None):
+        """K steps in ONE sharded dispatch — the same
+        ``Executor.run_chained`` the single-device trainer uses, with
+        the scan carry sharded per the partitioner (PERF.md "Dispatch
+        pipelining"). Falls back to sequential sharded runs under the
+        same conditions as the plain executor."""
+        return self._exe.run_chained(program or self._program,
+                                     feed_list=feed_list,
+                                     fetch_list=fetch_list,
+                                     scope=self._scope,
+                                     return_numpy=return_numpy,
+                                     async_fetch=async_fetch)
 
     def bcast_params(self):
         """Parity: ParallelExecutor.bcast_params (NCCL bcast). Params are
@@ -230,33 +145,31 @@ class ParallelExecutor(object):
 
         Returns dict(argument_bytes, temp_bytes, output_bytes) for ONE
         device of the mesh."""
+        from ..core.lowering import lower_block
         program = self._program
         scope = self._scope
+        part = self._partitioner
         fetch_names, feed, state_in, state_out, static_env = \
             self._exe._prep_lowering(program, feed, fetch_list, scope,
                                      consume_readers=False)
-        # NB: lowers the FULL program (no pruning), mirroring
-        # ParallelExecutor.run — Executor.cost_analysis models the
-        # pruning Executor.run path instead.
-        from ..core import lowering as _lowering
+        # NB: lowers the FULL program (no pruning), so the accounting
+        # covers every declared buffer; Executor.run models the pruned
+        # path instead.
         fn = lower_block(program, program.global_block(),
                          sorted(feed.keys()), fetch_names, state_in,
                          state_out, static_env=static_env)
-
-        def fn_with_mesh(feeds, state, _fn=fn):
-            with _lowering.sharding_mesh(self._mesh):
-                return _fn(feeds, state)
-
-        feeds_s, state_s, repl = self._shardings(feed, state_in)
-        out_state_s = {n: self._var_sharding(n) for n in state_out}
-        jitted = jax.jit(fn_with_mesh, in_shardings=(feeds_s, state_s),
-                         out_shardings=(None, out_state_s))
+        feeds_s = part.feed_shardings(feed)
+        state_s = part.state_shardings(program, state_in)
+        out_state_s = part.state_shardings(program, state_out)
+        jitted = part.partition(part.trace_wrap(fn),
+                                in_shardings=(feeds_s, state_s),
+                                out_shardings=(None, out_state_s))
         state = {n: scope.raw(n) for n in state_in}
         abstract = jax.tree_util.tree_map(
             lambda v: jax.ShapeDtypeStruct(np.shape(v),
                                            np.asarray(v).dtype),
             (feed, state))
-        with self._mesh:
+        with part.run_context():
             comp = jitted.lower(*abstract).compile()
         ma = comp.memory_analysis()
         return {

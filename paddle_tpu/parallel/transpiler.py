@@ -82,11 +82,13 @@ class DistributeTranspiler(object):
 
     def _dp_size(self):
         """Shard count for ZeRO slicing: the dp extent of the active mesh
-        (single- or multi-process), falling back to the trainer count."""
+        (single- or multi-process), falling back to the trainer count.
+        Routed through the partition subsystem so the transpiler and
+        the Partitioner can never disagree about an axis extent."""
+        from ..partition import mesh_axis_extent
         from .mesh import _current_mesh
         if _current_mesh is not None:
-            return int(dict(zip(_current_mesh.axis_names,
-                                _current_mesh.devices.shape)).get('dp', 1))
+            return mesh_axis_extent(_current_mesh, 'dp')
         return max(self.trainers, 1)
 
     def _slice_optimizer_state(self):
@@ -104,6 +106,7 @@ class DistributeTranspiler(object):
         matching trainer semantics). Consumed by
         ParallelExecutor._var_sharding.
         """
+        from ..partition import first_divisible_dim
         dp = self._dp_size()
         self.sliced_vars = []
         if dp <= 1:
@@ -121,12 +124,13 @@ class DistributeTranspiler(object):
                     # slice over the FIRST dp-divisible dim (r3: was
                     # dim-0-only, which left odd-leading-dim
                     # accumulators — biases, embeddings with ragged
-                    # vocab — fully replicated)
-                    for d, extent in enumerate(var.shape):
-                        if extent % dp == 0 and extent >= dp:
-                            var.sharding = (None,) * d + ('dp',)
-                            self.sliced_vars.append(name)
-                            break
+                    # vocab — fully replicated); the same divisibility
+                    # rule the Partitioner resolves specs with, so an
+                    # annotation placed here never degrades later
+                    d = first_divisible_dim(var.shape, dp)
+                    if d is not None:
+                        var.sharding = (None,) * d + ('dp',)
+                        self.sliced_vars.append(name)
         self._program._bump_version()
 
     def get_trainer_program(self):

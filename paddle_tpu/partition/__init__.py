@@ -1,0 +1,19 @@
+"""paddle_tpu.partition — one Partitioner behind every execution path.
+
+See PARTITIONING.md. The :class:`Partitioner` owns a device mesh plus
+logical-axis rules and makes every placement decision the stack needs:
+``Executor`` (single-step and K-step chained dispatch),
+``ParallelExecutor``, the trainer's prefetch staging and the serving
+model registry all route their jit construction, ``device_put`` calls
+and cache-key sharding tokens through it. A 1-device mesh is the CPU
+fallback: plain ``jax.jit``, bit-identical to the classic executor.
+"""
+from .partitioner import (Partitioner, pjit_with_cpu_fallback,  # noqa
+                          with_sharding_constraint, mesh_axis_extent,
+                          first_divisible_dim)
+from .rules import (AxisNames, standard_logical_axis_rules)  # noqa
+
+__all__ = ['Partitioner', 'pjit_with_cpu_fallback',
+           'with_sharding_constraint', 'mesh_axis_extent',
+           'first_divisible_dim', 'AxisNames',
+           'standard_logical_axis_rules']

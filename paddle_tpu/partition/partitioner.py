@@ -1,0 +1,471 @@
+"""Partitioner: ONE owner for device placement across every execution
+path (PARTITIONING.md).
+
+Before this subsystem, three runtimes made placement decisions
+independently: ``Executor.run`` committed state to a single device,
+``ParallelExecutor`` built pjit shardings from ``Variable.sharding``,
+and the serving worker loaded every model single-device. The
+Partitioner (T5X pattern, SNIPPETS.md [1]-[3]) centralizes all of it:
+
+- it owns a :class:`jax.sharding.Mesh` plus logical-axis rules mapping
+  parameter/activation axis names to mesh axes (``rules.py``);
+- :meth:`partition` is ``pjit_with_cpu_fallback``: plain ``jax.jit``
+  on a single-device mesh, sharded jit (in/out shardings + donation)
+  on a real mesh — the SAME compiled-program cache key carries the
+  (mesh shape, sharding spec) token either way;
+- :meth:`stage` / :meth:`commit_state` / :meth:`shard_scope` are the
+  sharded ``device_put`` helpers that replace every ad-hoc placement
+  call in the trainer prefetch pipeline, ``Executor.run_chained`` and
+  the serving model registry.
+
+Telemetry: ``partition_mesh_devices`` gauge (per mesh-shape label),
+``partition_resharding_seconds`` histogram, and ``partition`` journal
+events for create/shard_scope.
+"""
+import contextlib
+import time
+
+import numpy as np
+import jax
+
+from .. import observability as _obs
+from .rules import resolve_entry, standard_logical_axis_rules
+
+__all__ = ['Partitioner', 'pjit_with_cpu_fallback',
+           'with_sharding_constraint']
+
+
+def _mesh_desc(mesh):
+    return 'x'.join('%s=%d' % (a, e) for a, e in
+                    zip(mesh.axis_names, mesh.devices.shape))
+
+
+def mesh_axis_extent(mesh, axis):
+    """Extent of a named axis on ``mesh`` (1 when absent/None)."""
+    if mesh is None:
+        return 1
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape)
+                    ).get(axis, 1))
+
+
+def first_divisible_dim(shape, extent):
+    """Index of the first dim an ``extent``-way shard divides evenly,
+    or None. The ONE divisibility rule shared by the ZeRO transpiler's
+    accumulator slicing and :meth:`Partitioner.resolve_spec`'s
+    degradation — both must agree or a transpile-time annotation could
+    silently degrade at partition time."""
+    for d, e in enumerate(shape):
+        if extent and int(e) % extent == 0 and int(e) >= extent:
+            return d
+    return None
+
+
+def pjit_with_cpu_fallback(fun, in_shardings=None, out_shardings=None,
+                           donate_argnums=(), mesh=None):
+    """jit wrapper with the T5X fallback: a single-device (or absent)
+    mesh compiles with plain ``jax.jit`` — no shardings, identical
+    cache behavior to the classic executor — while a real mesh compiles
+    the sharded program."""
+    if mesh is None or mesh.devices.size <= 1:
+        return jax.jit(fun, donate_argnums=donate_argnums)
+    return jax.jit(fun, in_shardings=in_shardings,
+                   out_shardings=out_shardings,
+                   donate_argnums=donate_argnums)
+
+
+def with_sharding_constraint(x, spec):
+    """Constrain ``x`` to ``spec`` under the lowering's active mesh;
+    no-op on CPU fallback / outside a partitioned trace (SNIPPETS.md
+    [2])."""
+    from ..core import lowering as _lowering
+    mesh, resolver = _lowering.active_sharding_mesh()
+    if mesh is None:
+        return x
+    return _lowering._constrain(x, spec, mesh, resolver)
+
+
+class Partitioner(object):
+    """Owns a mesh + logical-axis rules; resolves every placement
+    decision the executors, trainer and serving runtime make.
+
+    Parameters
+    ----------
+    mesh : jax.sharding.Mesh, optional
+        Defaults to :func:`parallel.mesh.get_mesh` (all local devices
+        on the 'dp' axis).
+    num_devices : int, optional
+        Build a fresh 1-D dp mesh over the first N devices.
+    rules : sequence of (logical, mesh-axis) pairs, optional
+        Defaults to :func:`rules.standard_logical_axis_rules`.
+    batch_axis : str
+        Mesh (or logical) axis feeds shard their batch dim over.
+    """
+
+    def __init__(self, mesh=None, num_devices=None, rules=None,
+                 batch_axis='batch'):
+        if mesh is None:
+            from ..parallel.mesh import get_mesh
+            mesh = get_mesh(num_devices)
+        self.mesh = mesh
+        self.rules = tuple(rules if rules is not None
+                           else standard_logical_axis_rules())
+        self._axes = tuple(mesh.axis_names)
+        self._extents = dict(zip(self._axes, mesh.devices.shape))
+        self.batch_axis = resolve_entry(batch_axis, self._axes,
+                                        self.rules)
+        reg = _obs.default_registry()
+        reg.gauge('partition_mesh_devices',
+                  'devices in a live Partitioner mesh',
+                  mesh=_mesh_desc(mesh)).set(self.device_count)
+        self._m_reshard = reg.histogram(
+            'partition_resharding_seconds',
+            'wall spent in Partitioner device_put helpers (feed '
+            'staging, state commit, scope sharding)')
+        if _obs.journal_active():
+            _obs.emit('partition', action='create',
+                      mesh=_mesh_desc(mesh), devices=self.device_count)
+
+    @classmethod
+    def for_place(cls, place):
+        """The CPU/single-device fallback partitioner: a 1-device mesh
+        over ``place``'s device. Every plain Executor runs behind one
+        of these, so the single- and multi-device paths share code (and
+        cache-key shape) while the fallback compiles with plain jit."""
+        from jax.sharding import Mesh
+        dev = place.jax_device() if hasattr(place, 'jax_device') \
+            else place
+        return cls(mesh=Mesh(np.asarray([dev]), ('dp',)))
+
+    # ---- introspection ---------------------------------------------------
+    @property
+    def device_count(self):
+        return int(self.mesh.devices.size)
+
+    @property
+    def active(self):
+        """True when dispatch is actually sharded (multi-device mesh);
+        False is the CPU/single-device fallback."""
+        return self.device_count > 1
+
+    @property
+    def multiprocess(self):
+        return jax.process_count() > 1
+
+    @property
+    def device(self):
+        """The one device of a fallback mesh (first device otherwise)."""
+        return self.mesh.devices.flat[0]
+
+    def axis_extent(self, axis):
+        return int(self._extents.get(axis, 1))
+
+    def describe(self):
+        return {'mesh': _mesh_desc(self.mesh),
+                'devices': self.device_count,
+                'axes': dict(self._extents),
+                'batch_axis': self.batch_axis,
+                'active': self.active}
+
+    # ---- spec resolution -------------------------------------------------
+    def resolve_spec(self, spec, ndim=None, shape=None):
+        """Variable.sharding tuple -> per-dim mesh axes (list), with
+        logical-rule resolution, unknown-axis degradation, optional
+        ndim truncation, and divisibility degradation when ``shape`` is
+        given (a spec decided against a different world size must
+        degrade to replicated on that dim, not fail the step). This is
+        the ONE interpreter — ParallelExecutor in_shardings and the
+        lowering's with_sharding_constraint pass both call it."""
+        out = [resolve_entry(e, self._axes, self.rules) for e in spec]
+        if ndim is not None:
+            out = out[:ndim]
+        if shape is not None:
+            for d, entry in enumerate(out):
+                if entry is None or d >= len(shape):
+                    continue
+                names = entry if isinstance(entry, (tuple, list)) \
+                    else (entry,)
+                e = int(np.prod([self.axis_extent(a) for a in names]))
+                if e and int(shape[d]) % e != 0:
+                    out[d] = None
+        return out
+
+    def named_sharding(self, spec=()):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self):
+        return self.named_sharding(())
+
+    def var_sharding(self, program, name):
+        """NamedSharding for a state var: ``Variable.sharding`` (set
+        via ParamAttr(sharding=...) / set_sharding / the ZeRO
+        transpiler) resolved through the rules; absent -> replicated
+        (reference semantics)."""
+        var = program.global_block()._find_var_recursive(name)
+        spec = getattr(var, 'sharding', None) if var is not None else None
+        if not spec:
+            return self.replicated
+        shape = getattr(var, 'shape', None) or ()
+        return self.named_sharding(self.resolve_spec(spec, shape=shape))
+
+    def state_shardings(self, program, names):
+        """Per-name NamedShardings for a state dict, memoized per
+        (program fingerprint, mesh, names) — the sharded hot path
+        commits state every dispatch, so this must not re-walk the
+        block per step. Variable.sharding mutations bump the program
+        fingerprint, invalidating the memo."""
+        names = tuple(names)
+        memo = program.__dict__.setdefault('_partition_state_memo', {})
+        key = (program.fingerprint(), self.mesh_token(), self.rules,
+               names)
+        hit = memo.get(key)
+        if hit is None:
+            hit = {n: self.var_sharding(program, n) for n in names}
+            memo[key] = hit
+        return hit
+
+    def _reconcile_leaf(self, v, s):
+        """Re-commit a leaf only when pjit would refuse it: a
+        multi-device committed array whose sharding differs from the
+        declared one (pjit auto-reshards single-device and host args,
+        but errors on mismatched mesh-committed arrays — e.g. stacked
+        prefetch-staged feeds, or state committed before a ZeRO
+        re-annotation)."""
+        if isinstance(v, jax.Array) and \
+                len(v.sharding.device_set) > 1 and \
+                not v.sharding.is_equivalent_to(s, v.ndim):
+            return jax.device_put(v, s)
+        return v
+
+    def reconcile(self, tree, shardings):
+        """Leaf-wise :meth:`_reconcile_leaf` over structure-matching
+        (value, sharding) trees."""
+        return jax.tree_util.tree_map(self._reconcile_leaf, tree,
+                                      shardings)
+
+    def reconcile_state(self, state, state_s):
+        """:meth:`reconcile` for a state dict: one NamedSharding per
+        name, broadcast over that value's leaves (a persistable may be
+        a pytree)."""
+        return {n: jax.tree_util.tree_map(
+            lambda v, s=state_s[n]: self._reconcile_leaf(v, s),
+            state[n]) for n in state}
+
+    def feed_sharding(self, value):
+        """Batch-dim sharding for one feed leaf: dim 0 over the batch
+        axis when the extent divides it, replicated otherwise (pow2
+        serving buckets smaller than the mesh, ragged trainer tails).
+        SequenceTensor feeds shard data/lengths rows alike."""
+        from ..lod import SequenceTensor
+        ax = self.batch_axis
+        if ax is None or not self.active:
+            if isinstance(value, SequenceTensor):
+                return SequenceTensor(self.replicated, self.replicated,
+                                      None if value.sub_lengths is None
+                                      else self.replicated)
+            return self.replicated
+        extent = self.axis_extent(ax)
+
+        def leaf(v):
+            shape = np.shape(v)
+            if not shape or int(shape[0]) % extent != 0:
+                return self.replicated
+            return self.named_sharding((ax,))
+
+        if isinstance(value, SequenceTensor):
+            return SequenceTensor(
+                leaf(value.data), leaf(value.lengths),
+                None if value.sub_lengths is None
+                else leaf(value.sub_lengths))
+        return leaf(value)
+
+    def feed_shardings(self, feed):
+        return {k: self.feed_sharding(v) for k, v in feed.items()}
+
+    def stacked_feed_shardings(self, feed):
+        """Shardings for run_chained's stacked feeds: the per-step spec
+        with a leading None for the [K] chain axis."""
+        from ..lod import SequenceTensor
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shift(s):
+            if isinstance(s, SequenceTensor):
+                return SequenceTensor(
+                    shift(s.data), shift(s.lengths),
+                    None if s.sub_lengths is None
+                    else shift(s.sub_lengths))
+            return NamedSharding(self.mesh, P(None, *s.spec))
+
+        return {k: shift(s)
+                for k, s in self.feed_shardings(feed).items()}
+
+    # ---- compile ---------------------------------------------------------
+    def partition(self, fn, in_shardings=None, out_shardings=None,
+                  donate_argnums=()):
+        """``pjit_with_cpu_fallback`` against this mesh."""
+        return pjit_with_cpu_fallback(
+            fn, in_shardings=in_shardings, out_shardings=out_shardings,
+            donate_argnums=donate_argnums,
+            mesh=self.mesh if self.active else None)
+
+    def trace_wrap(self, fn):
+        """Wrap a lowered ``fn(feeds, state)`` so tracing runs under
+        this mesh + resolver: Variable.sharding-annotated activations
+        get with_sharding_constraint applied by the lowering."""
+        if not self.active:
+            return fn
+        from ..core import lowering as _lowering
+        part = self
+
+        def fn_with_mesh(feeds, state, _fn=fn):
+            with _lowering.sharding_mesh(part.mesh, part.resolve_spec):
+                return _fn(feeds, state)
+
+        return fn_with_mesh
+
+    @contextlib.contextmanager
+    def run_context(self):
+        """Execution context for a partitioned call: the mesh scope on
+        a real mesh (collective lowering needs it), nothing extra on
+        the fallback (the caller's default_device applies)."""
+        if self.active:
+            with self.mesh:
+                yield
+        else:
+            yield
+
+    # ---- cache key -------------------------------------------------------
+    def mesh_token(self):
+        """Hashable identity of the mesh: axis names, shape, and the
+        concrete device ids (two same-shape meshes over different
+        devices must never share a compiled program)."""
+        return (self._axes, tuple(self.mesh.devices.shape),
+                tuple(int(d.id) for d in self.mesh.devices.flat))
+
+    def cache_token(self, program):
+        """The (mesh shape, sharding spec) component of
+        ``program_cache_key``: mesh token + rules + the program's
+        resolved sharding signature, memoized per program fingerprint
+        (Variable.sharding mutations bump the fingerprint, so the memo
+        can never serve a stale signature)."""
+        memo = program.__dict__.setdefault('_partition_memo', {})
+        key = (program.fingerprint(), self.mesh_token(), self.rules)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        sig = []
+        for b in program.blocks:
+            for v in b.vars.values():
+                spec = getattr(v, 'sharding', None)
+                if spec:
+                    shape = getattr(v, 'shape', None) or ()
+                    sig.append((v.name, tuple(
+                        self.resolve_spec(spec, shape=shape))))
+        token = ('partition', self.mesh_token(), self.rules,
+                 tuple(sorted(sig)))
+        memo[key] = token
+        return token
+
+    # ---- placement helpers ----------------------------------------------
+    def device_put(self, value, spec=None):
+        """Sharded ``jax.device_put``: onto the fallback device, or
+        onto the mesh under ``spec`` (default replicated)."""
+        t0 = time.perf_counter()
+        if not self.active:
+            out = jax.device_put(value, self.device)
+        else:
+            out = jax.device_put(value,
+                                 self.named_sharding(spec or ()))
+        self._m_reshard.observe(time.perf_counter() - t0)
+        return out
+
+    def stage(self, feed):
+        """Stage a feed dict/pytree for dispatch: batch-dim sharded
+        over the mesh (prefetch staging on the ParallelExecutor path —
+        the PR-5 clamp replaced by this call), plain device_put on the
+        fallback."""
+        t0 = time.perf_counter()
+        if not self.active:
+            out = jax.device_put(feed, self.device)
+        elif isinstance(feed, dict):
+            out = {k: jax.device_put(v, self.feed_sharding(v))
+                   for k, v in feed.items()}
+        else:
+            out = jax.device_put(feed, self.feed_sharding(feed))
+        self._m_reshard.observe(time.perf_counter() - t0)
+        return out
+
+    def commit_state(self, state, shardings=None):
+        """Commit a state dict to its run placement before dispatch
+        (run_chained: donated carries must arrive committed or the
+        second chunk retraces). ``shardings`` maps name ->
+        NamedSharding on a real mesh; the fallback commits to the one
+        device — exactly the classic single-device behavior."""
+        t0 = time.perf_counter()
+        if not self.active or not shardings:
+            out = jax.device_put(state, self.device)
+        else:
+            out = {n: jax.tree_util.tree_map(
+                lambda v, s=shardings[n]: jax.device_put(v, s),
+                state[n]) for n in state}
+        self._m_reshard.observe(time.perf_counter() - t0)
+        return out
+
+    def shard_scope(self, scope, program):
+        """Distribute a scope's persistable state over the mesh: every
+        program-declared persistable var resident in the scope is
+        device_put with its resolved sharding (replicated by default;
+        mp/dp-annotated weights land sharded). This is how a
+        ModelServer loads a model bigger than one chip. Returns the
+        number of vars placed."""
+        from ..lod import SequenceTensor
+        t0 = time.perf_counter()
+        count = 0
+        seen = set()
+        for b in program.blocks:
+            for v in b.vars.values():
+                if not getattr(v, 'persistable', False) or \
+                        v.name in seen:
+                    continue
+                seen.add(v.name)
+                val = scope.raw(v.name)
+                if val is None or isinstance(val, SequenceTensor):
+                    continue
+                scope.set_var(v.name,
+                              jax.device_put(
+                                  val, self.var_sharding(program,
+                                                         v.name)))
+                count += 1
+        wall = time.perf_counter() - t0
+        self._m_reshard.observe(wall)
+        _obs.emit('partition', action='shard_scope',
+                  mesh=_mesh_desc(self.mesh), vars=count,
+                  dur_s=round(wall, 6))
+        return count
+
+    # ---- multi-process ---------------------------------------------------
+    def globalize(self, feed, state, feeds_s, state_s):
+        """Multi-process entry: host-local values become global arrays
+        over the process-spanning mesh. Feeds are per-process batch
+        shards (the reference's per-trainer reader semantics); state is
+        held whole by every process (startup-initialized), so its
+        global shape is the local shape."""
+        def _glob(v, s, full_value):
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                return v          # already a global array (prev step)
+            arr = np.asarray(v)
+            return jax.make_array_from_process_local_data(
+                s, arr, global_shape=arr.shape if full_value else None)
+
+        feed = jax.tree_util.tree_map(
+            lambda v, s: _glob(v, s, False), feed, feeds_s)
+        state = {n: jax.tree_util.tree_map(
+            lambda v, s=state_s[n]: _glob(v, s, True), state[n])
+            for n in state}
+        return feed, state
+
+    def __repr__(self):
+        return 'Partitioner(%s%s)' % (
+            _mesh_desc(self.mesh),
+            '' if self.active else ', cpu-fallback')

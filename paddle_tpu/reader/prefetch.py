@@ -45,9 +45,14 @@ class _Err(object):
 def stage_on_device(value, place):
     """``jax.device_put`` a batch/feed (dict, tuple, SequenceTensor —
     any pytree) onto ``place``'s device. ``place`` may be a
-    core.places.Place, a raw jax Device, or None (no staging)."""
+    core.places.Place, a raw jax Device, a
+    :class:`~paddle_tpu.partition.Partitioner` (staging then uses its
+    sharded ``device_put`` — batch-dim sharded over the mesh), or None
+    (no staging)."""
     if place is None:
         return value
+    if hasattr(place, 'stage'):
+        return place.stage(value)
     import jax
     device = place.jax_device() if hasattr(place, 'jax_device') else place
     return jax.device_put(value, device)

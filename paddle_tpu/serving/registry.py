@@ -76,19 +76,29 @@ class ModelRegistry(object):
         self._models = {}
 
     def load(self, name, dirname, executor, model_filename=None,
-             params_filename=None):
+             params_filename=None, partitioner=None):
         """Load a ``save_inference_model`` directory under ``name`` into
-        a fresh private scope."""
+        a fresh private scope. With a ``partitioner`` over a real mesh,
+        the loaded parameters are distributed across it right here
+        (:meth:`Partitioner.shard_scope`) — mp/dp-annotated weights
+        land sharded, the rest replicated — so a model too big for one
+        chip is servable (PARTITIONING.md)."""
         scope = Scope()
         program, feed_names, fetch_vars = _io.load_inference_model(
             dirname, executor, model_filename=model_filename,
             params_filename=params_filename, scope=scope)
-        return self.register(name, program, feed_names, fetch_vars, scope)
+        return self.register(name, program, feed_names, fetch_vars,
+                             scope, partitioner=partitioner)
 
-    def register(self, name, program, feed_names, fetch_vars, scope):
+    def register(self, name, program, feed_names, fetch_vars, scope,
+                 partitioner=None):
         """Register an already-built (program, scope) pair — the
         in-process path used by tests and by trainers that promote a
-        model to serving without a disk round-trip."""
+        model to serving without a disk round-trip. A real-mesh
+        ``partitioner`` distributes the scope's parameters before the
+        model goes live."""
+        if partitioner is not None and partitioner.active:
+            partitioner.shard_scope(scope, program)
         model = LoadedModel(name, program, feed_names, fetch_vars, scope)
         with self._lock:
             self._models[name] = model

@@ -117,9 +117,15 @@ class ModelServer(object):
                  batch_timeout=0.002, policy=None, retry_attempts=2,
                  retry_backoff=0.05, retry_on=(OSError,),
                  breaker_config=None, stage_timeouts=None,
-                 watchdog_poll=0.05):
+                 watchdog_poll=0.05, partitioner=None):
         self.place = place or _places.TPUPlace(0)
-        self.executor = Executor(self.place)
+        # PARTITIONING.md: a real-mesh partitioner makes this server
+        # sharded end to end — loaded models distribute their params
+        # across the mesh, and every bucket's program compiles as a
+        # sharded computation through the SAME Executor cache (warmup
+        # pre-pays one compile per (bucket, program, sharding, mesh)).
+        self.partitioner = partitioner
+        self.executor = Executor(self.place, partitioner=partitioner)
         self.policy = policy or BucketPolicy(max_bucket=max_batch_size)
         if self.policy.max_bucket < max_batch_size:
             raise ValueError(
@@ -157,16 +163,19 @@ class ModelServer(object):
         _fi.maybe_fault(_fi.SITE_SERVING_LOAD)
         model = self.registry.load(name, dirname, self.executor,
                                    model_filename=model_filename,
-                                   params_filename=params_filename)
+                                   params_filename=params_filename,
+                                   partitioner=self.partitioner)
         self._start_worker(model)
         return model
 
     def register_model(self, name, program, feed_names, fetch_vars,
                        scope):
         """Serve an in-memory (program, scope) pair — no disk round
-        trip. The scope must hold the program's parameters."""
+        trip. The scope must hold the program's parameters (they are
+        distributed over the server's mesh when one is configured)."""
         model = self.registry.register(name, program, feed_names,
-                                       fetch_vars, scope)
+                                       fetch_vars, scope,
+                                       partitioner=self.partitioner)
         self._start_worker(model)
         return model
 
@@ -218,6 +227,8 @@ class ModelServer(object):
             program, feed_names, fetch_vars = _load_inference_model(
                 dirname, self.executor, model_filename=model_filename,
                 params_filename=params_filename, scope=scope)
+            if self.partitioner is not None and self.partitioner.active:
+                self.partitioner.shard_scope(scope, program)
             candidate = LoadedModel(name, program, feed_names,
                                     fetch_vars, scope)
             if validate:

@@ -161,8 +161,9 @@ class Trainer(object):
         ``steps_per_dispatch=K``: chain K steps into ONE device
         dispatch (``Executor.run_chained``); amortizes per-dispatch
         latency. Partial tails and shape changes fall back to
-        sequential steps automatically. Requires the plain Executor
-        path (``parallel=False``).
+        sequential steps automatically. Works on both the plain
+        Executor and the ParallelExecutor path — on a multi-device
+        mesh the chain runs as one sharded scan (PARTITIONING.md).
 
         ``sync_interval=M``: materialize fetched losses only every M
         steps — between syncs, ``EndStepEvent.metrics`` carry LAZY
@@ -328,9 +329,11 @@ class Trainer(object):
         pipeline; the consumer-side ``next()`` wait is then the
         measured ``trainer_host_wait_seconds`` — near zero when the
         host keeps up, the host-bound fraction when it does not.
-        ``stage_place`` is None on the ParallelExecutor path: feeds
-        must stay host-side numpy so pjit shards them over the mesh
-        (a single-device commit would fight the NamedSharding)."""
+        ``stage_place`` is the executor's Partitioner: staging goes
+        through its sharded ``device_put`` — batch-dim sharded over
+        the mesh on the ParallelExecutor path, plain single-device
+        staging on the classic path (PARTITIONING.md; this replaced
+        the PR-5 skip-staging clamp)."""
         if prefetch > 0:
             from .reader.prefetch import prefetch_feeds
             return prefetch_feeds(reader, feeder, depth=prefetch,
@@ -354,12 +357,9 @@ class Trainer(object):
         prefetch = getattr(self, '_prefetch', 0)
         chain_k = getattr(self, '_steps_per_dispatch', 1)
         sync_interval = getattr(self, '_sync_interval', 1)
-        is_pe = isinstance(exe, parallel_executor.ParallelExecutor)
-        if is_pe:
-            chain_k = 1      # run_chained is a plain-Executor feature
         if guard is not None:
             sync_interval = 1    # the guard inspects every loss
-        lazy = sync_interval > 1 and not is_pe
+        lazy = sync_interval > 1
         grad_names = []
         if guard is not None and guard.monitor_gradients:
             grad_names = self._grad_fetch_names()
@@ -406,12 +406,14 @@ class Trainer(object):
                 else []
             gs0 = global_step
             t0 = time.monotonic()
+            # ONE dispatch surface for both executors: the PE facade
+            # forwards to the same Executor.run/run_chained (sharded
+            # when its Partitioner's mesh is real) — the PR-5 clamps
+            # (K forced to 1, no staging on the PE path) are gone.
             if len(chunk) > 1:
                 outs_steps = exe.run_chained(
                     feed_list=[c[2] for c in chunk],
                     fetch_list=run_fetches, async_fetch=lazy)
-            elif is_pe:
-                outs_steps = [exe.run(run_fetches, feed=chunk[0][2])]
             else:
                 outs_steps = [exe.run(feed=chunk[0][2],
                                       fetch_list=run_fetches,
@@ -491,7 +493,7 @@ class Trainer(object):
             epoch_t0 = time.monotonic()
             epoch_steps0 = steps_done
             stream = self._feed_stream(reader, feeder, prefetch,
-                                       None if is_pe else self.place)
+                                       exe.partitioner)
             try:
                 step_id = -1
                 chunk = []   # [(step_id, begin, feed, examples, wait_s)]

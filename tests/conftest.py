@@ -64,3 +64,9 @@ def pytest_configure(config):
         'compiler: tests of the paddle_tpu.compiler pass pipeline — '
         'semantic equivalence, pass idempotence, cache keying, tuning '
         'cache (tier-1; filter with -m "not compiler")')
+    config.addinivalue_line(
+        'markers',
+        'partition: tests of the paddle_tpu.partition subsystem — '
+        'CPU-fallback bit-exactness, multi-device CPU-mesh training '
+        'parity, per-(program, sharding, mesh) compile caching, '
+        'sharded serving load (tier-1; filter with -m "not partition")')

@@ -7,7 +7,11 @@ import jax.numpy as jnp
 import pytest
 
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:     # jax < 0.5 ships it under experimental only
+    from jax.experimental.shard_map import shard_map
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.parallel import collective

@@ -22,9 +22,7 @@ def test_ring_attention_matches_dense():
                                 jnp.asarray(v))
 
     from jax.sharding import PartitionSpec as P
-    import functools
-    ring = jax.jit(functools.partial(
-        jax.shard_map,
+    ring = jax.jit(T.shard_map_compat(
         mesh=mesh, in_specs=(P(None, 'sp'),) * 3,
         out_specs=P(None, 'sp'), check_vma=False)(
             lambda a, b, c: T.ring_attention(a, b, c, 'sp')))(q, k, v)

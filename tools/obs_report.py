@@ -17,9 +17,11 @@ a training journal must hold step records; ``--require serving`` for a
 serving soak; ``--require pipeline`` for a pipelined-trainer run —
 step records must carry the ``feed_wait`` host-wait field; ``--require
 compiler`` for a run that must have gone through the compiler pass
-pipeline (``compile_pass`` records); ``--require any`` for presence
-only). ``tools/serve_bench.py --smoke`` runs this gate over the
-journal its load run writes.
+pipeline (``compile_pass`` records); ``--require partition`` for a run
+that must have placed work through the Partitioner (``partition``
+records, PARTITIONING.md); ``--require any`` for presence only).
+``tools/serve_bench.py --smoke`` runs this gate over the journal its
+load run writes.
 """
 import argparse
 import json
@@ -27,7 +29,7 @@ import sys
 
 REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                'pipeline': 'step_end', 'compiler': 'compile_pass',
-               'any': None}
+               'partition': 'partition', 'any': None}
 
 
 def load_journal(path):
@@ -109,6 +111,35 @@ def _compiler_summary(by_ev):
     }
 
 
+def _partition_summary(by_ev):
+    """Partition SLI (PARTITIONING.md): what mesh(es) the run placed
+    work on and how much wall went into resharding-class work
+    (shard_scope journal events carry dur_s; per-batch staging is
+    metric-only by design)."""
+    events = by_ev.get('partition', ())
+    meshes = {}
+    for r in events:
+        m = meshes.setdefault(r.get('mesh', '?'), {
+            'devices': r.get('devices'), 'creates': 0,
+            'scopes_sharded': 0, 'vars_placed': 0, 'reshard_s': 0.0})
+        if r.get('devices'):
+            m['devices'] = r['devices']
+        if r.get('action') == 'create':
+            m['creates'] += 1
+        elif r.get('action') == 'shard_scope':
+            m['scopes_sharded'] += 1
+            m['vars_placed'] += r.get('vars', 0)
+        m['reshard_s'] += r.get('dur_s', 0.0)
+    return {
+        'events': len(events),
+        'meshes': meshes,
+        'scopes_sharded': sum(m['scopes_sharded']
+                              for m in meshes.values()),
+        'vars_placed': sum(m['vars_placed'] for m in meshes.values()),
+        'reshard_wall_s': sum(m['reshard_s'] for m in meshes.values()),
+    }
+
+
 def summarize(records, malformed=0):
     """Aggregate a record list into a JSON-ready summary dict."""
     by_ev = {}
@@ -178,6 +209,7 @@ def summarize(records, malformed=0):
         'anomalies': len(by_ev.get('anomaly', ())),
         'pipeline': _pipeline_summary(steps, duration),
         'compiler': _compiler_summary(by_ev),
+        'partition': _partition_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -241,6 +273,18 @@ def render(summary, top=10):
                 % (tu['lookups'], tu['hits'], 100.0 * tu['hit_rate'],
                    tu['preloads'], tu['entries_preloaded'],
                    tu['puts']))
+    pa = s.get('partition') or {}
+    if pa.get('events'):
+        lines.append(
+            'partition: %d events | %d scope(s) sharded (%d vars), '
+            '%.3fs resharding wall'
+            % (pa['events'], pa['scopes_sharded'], pa['vars_placed'],
+               pa['reshard_wall_s']))
+        for mesh, m in sorted(pa['meshes'].items()):
+            lines.append('  mesh %-14s devices=%s creates=%d '
+                         'shard_scope=%d' % (mesh, m['devices'],
+                                             m['creates'],
+                                             m['scopes_sharded']))
     ex = s['executor']
     if ex['runs']:
         lookups = ex['cache_hits'] + ex['cache_misses']

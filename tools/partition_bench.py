@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Partition bench worker (PARTITIONING.md): the SAME pipelined
+``Trainer.train(prefetch=2, steps_per_dispatch=4)`` loop through the
+ParallelExecutor at mesh=1 (the Partitioner's plain-jit CPU fallback)
+vs mesh=N (sharded pjit over N host CPU devices), reporting steps/s
+and loss parity as JSON on stdout.
+
+Runs as a SUBPROCESS of ``bench.py bench_partition`` because the host
+CPU device count (XLA_FLAGS) must be fixed before jax initializes —
+the parent process has usually already brought a backend up. Feeds the
+MULTICHIP_r0*.json trajectory alongside the in-process multichip
+dryruns.
+
+    python tools/partition_bench.py --devices 2 --steps 12
+"""
+import argparse
+import json
+import os
+import sys
+
+# runnable from anywhere: the repo root (tools/..) hosts paddle_tpu
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--devices', type=int, default=2)
+    ap.add_argument('--steps', type=int, default=12)
+    ap.add_argument('--batch', type=int, default=64)
+    args = ap.parse_args()
+
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    if 'xla_force_host_platform_device_count' not in \
+            os.environ.get('XLA_FLAGS', ''):
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            ' --xla_force_host_platform_device_count=%d'
+            % args.devices).strip()
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import time
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel.mesh import set_mesh
+
+    batch, steps = args.batch, args.steps
+    rng = np.random.RandomState(0)
+    xs = rng.randn(steps * batch, 64).astype('float32')
+    ys = (xs[:, :1] * 0.5 + 0.1).astype('float32')
+
+    def reader():
+        for i in range(0, len(xs), batch):
+            yield [(xs[j], ys[j]) for j in range(i, i + batch)]
+
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[64], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=256, act='relu')
+        h = fluid.layers.fc(input=h, size=256, act='relu')
+        pred = fluid.layers.fc(input=h, size=1)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+
+    def one(mesh_n):
+        devs = jax.devices()
+        set_mesh(Mesh(np.asarray(devs[:mesh_n]), ('dp',)))
+        marks, losses = {}, []
+
+        def handler(ev):
+            if isinstance(ev, fluid.BeginEpochEvent) and ev.epoch == 1:
+                marks['t0'] = time.perf_counter()
+            elif isinstance(ev, fluid.EndEpochEvent) and ev.epoch == 1:
+                marks['t1'] = time.perf_counter()
+            elif isinstance(ev, fluid.EndStepEvent) and ev.metrics \
+                    and ev.epoch == 1:
+                losses.append(float(np.asarray(
+                    ev.metrics[0]).ravel()[0]))
+        try:
+            trainer = fluid.Trainer(
+                train_func=train_func,
+                optimizer=fluid.optimizer.Adam(learning_rate=1e-3),
+                place=fluid.CPUPlace(), parallel=True)
+            # epoch 0 absorbs compiles; epoch 1 is the timed steady
+            # state, with the full pipelined loop engaged (no clamps:
+            # K-step sharded chaining + mesh-staged prefetch)
+            trainer.train(num_epochs=2, event_handler=handler,
+                          reader=reader, feed_order=['x', 'y'],
+                          prefetch=2, steps_per_dispatch=4,
+                          sync_interval=4)
+        finally:
+            set_mesh(None)
+        wall = marks['t1'] - marks['t0']
+        return {'steps_per_sec': round(steps / wall, 2),
+                'examples_per_sec': round(steps * batch / wall, 1),
+                'losses': [round(v, 6) for v in losses]}
+
+    r1 = one(1)
+    rn = one(args.devices)
+    out = {
+        'devices': args.devices,
+        'batch_size': batch,
+        'steps_per_epoch': steps,
+        'mesh1': r1,
+        'meshN': rn,
+        'speedup_meshN_vs_mesh1': round(
+            rn['steps_per_sec'] / max(r1['steps_per_sec'], 1e-9), 3),
+        'losses_allclose': bool(np.allclose(
+            r1['losses'], rn['losses'], rtol=1e-3, atol=1e-4)),
+    }
+    json.dump(out, sys.stdout)
+    print()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
