@@ -5,6 +5,7 @@ Parity: python/paddle/fluid/io.py. Serialization: one ``.npz`` per call plus
 a JSON manifest for the inference program (the reference pickles ProgramDesc
 protobufs; we serialize the IR to JSON).
 """
+import contextlib as _contextlib
 import json
 import logging
 import os
@@ -267,8 +268,40 @@ CHECKPOINT_PREFIX = "checkpoint"
 _SERIAL_DIR_RE = re.compile(r'^%s_(\d+)$' % CHECKPOINT_PREFIX)
 
 _ORBAX_SUBDIR = '__orbax__'
+_LOCK_FILENAME = '.ckpt_lock'
 
 _logger = logging.getLogger('paddle_tpu.resilience')
+
+
+@_contextlib.contextmanager
+def _commit_lock(checkpoint_dir):
+    """Advisory exclusive lock over a checkpoint root. Two processes
+    sharing one dir used to race the serial scan -> rename -> prune
+    sequence (both pick serial max+1; the second rename lands on a
+    non-empty dir) and the manifest-mtime rate limit (both pass the
+    check, both save). flock serializes the whole commit; on platforms
+    without fcntl the lock degrades to a no-op (single-writer dirs are
+    unaffected)."""
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    fd = os.open(os.path.join(checkpoint_dir, _LOCK_FILENAME),
+                 os.O_CREAT | os.O_RDWR, 0o644)
+    locked = False
+    try:
+        try:
+            import fcntl
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            locked = True
+        except ImportError:
+            pass
+        yield
+    finally:
+        if locked:
+            try:
+                import fcntl
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            except Exception:
+                pass
+        os.close(fd)
 
 
 def _orbax_checkpointer():
@@ -317,9 +350,26 @@ def _collect_persistable_state(main_program):
     return state
 
 
+def _state_is_sharded(main_program):
+    """True when any persistable in the current scope is a
+    mesh-distributed jax array — the value-level trigger for the
+    sharded backend (a host gather of such state is exactly what the
+    sharded save path exists to avoid)."""
+    import jax
+    program = main_program or default_main_program()
+    scope = global_scope()
+    for var in filter(is_persistable, program.list_vars()):
+        val = scope.raw(var.name)
+        if isinstance(val, jax.Array) and \
+                len(val.sharding.device_set) > 1:
+            return True
+    return False
+
+
 @resilience.retry(max_attempts=3, backoff=0.05, jitter=0.1,
                   retry_on=(OSError,))
-def _write_checkpoint_payload(tmp_dir, executor, main_program, ckptr):
+def _write_checkpoint_payload(tmp_dir, executor, main_program,
+                              use_backend, ckptr):
     """Serialize persistables into ``tmp_dir`` (retry-wrapped: a
     transient filesystem error re-runs the whole payload write into a
     wiped tmp dir — nothing is ever partially reused)."""
@@ -327,6 +377,13 @@ def _write_checkpoint_payload(tmp_dir, executor, main_program, ckptr):
         shutil.rmtree(tmp_dir)
     os.makedirs(tmp_dir)
     faultinject.maybe_fault(faultinject.SITE_CKPT_WRITE)
+    if use_backend == 'sharded':
+        from .resilience import sharded as _sharded
+        state = _collect_persistable_state(main_program)
+        # one .npy per array SHARD, per-shard CRCs; a mesh-distributed
+        # array is never gathered into a full host replica on the save
+        # path (RESILIENCE.md "Sharded checkpoints")
+        return _sharded.write_state(tmp_dir, state), 'sharded'
     if ckptr is not None:
         state = _collect_persistable_state(main_program)
         ckptr.save(os.path.join(tmp_dir, _ORBAX_SUBDIR), state)
@@ -343,15 +400,28 @@ def _write_checkpoint_payload(tmp_dir, executor, main_program, ckptr):
 
 def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
                     save_interval_secs=600, main_program=None,
-                    backend='auto', trainer_state=None):
-    """Atomic checkpoint save. backend: 'auto' (orbax when importable),
-    'orbax', or 'npz'.
+                    backend='auto', trainer_state=None,
+                    partitioner=None):
+    """Atomic checkpoint save. backend: 'auto', 'sharded', 'orbax', or
+    'npz'. 'auto' picks 'sharded' when the scope holds mesh-distributed
+    state or ``partitioner`` (default: the executor's) has an active
+    mesh; else orbax when importable; else npz.
+
+    The sharded backend writes per-shard ``.npy`` payloads with
+    per-shard CRC32s plus a manifest recording mesh shape, axis rules
+    and each array's resolved sharding — NO host-side full-replication
+    gather on the save path (RESILIENCE.md "Sharded checkpoints &
+    topology portability"); ``load_checkpoint`` reshards it onto
+    whatever mesh the restoring process runs.
 
     Commit protocol (resilience/checkpoint.py): payload into a hidden
     ``.tmp_*`` dir -> fsync everything -> JSON manifest with per-tensor
     shape/dtype + CRC32 checksums (and optional ``trainer_state`` for
     auto-resume) -> ``os.rename`` into ``checkpoint_<serial>``. A kill
-    at ANY point leaves no partially-visible checkpoint.
+    at ANY point leaves no partially-visible checkpoint. The serial
+    scan -> rename -> prune sequence (and the rate-limit check) runs
+    under an advisory flock on ``.ckpt_lock`` so concurrent savers
+    sharing one dir serialize instead of racing.
 
     A save within ``save_interval_secs`` of the newest checkpoint's
     MANIFEST mtime is SKIPPED (reference io.py:569 _interval_secs_exceed
@@ -360,11 +430,23 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
     ``save_interval_secs=0`` disables the limit. Pruning keeps the
     newest ``max_num_checkpoints`` serials and can never touch the
     serial just written."""
-    if backend not in ('auto', 'orbax', 'npz'):
-        raise ValueError("backend must be 'auto', 'orbax' or 'npz', "
-                         "got %r" % (backend,))
+    if backend not in ('auto', 'sharded', 'orbax', 'npz'):
+        raise ValueError("backend must be 'auto', 'sharded', 'orbax' "
+                         "or 'npz', got %r" % (backend,))
     if checkpoint_dir is None:
         checkpoint_dir = os.getcwd()
+    part = partitioner if partitioner is not None \
+        else getattr(executor, 'partitioner', None)
+    with _commit_lock(checkpoint_dir):
+        return _save_checkpoint_locked(
+            executor, checkpoint_dir, max_num_checkpoints,
+            save_interval_secs, main_program, backend, trainer_state,
+            part)
+
+
+def _save_checkpoint_locked(executor, checkpoint_dir,
+                            max_num_checkpoints, save_interval_secs,
+                            main_program, backend, trainer_state, part):
     serials = _get_checkpoint_serials(checkpoint_dir)
     if serials and save_interval_secs:
         last_dir = _serial_dir(checkpoint_dir, max(serials))
@@ -381,21 +463,30 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
         # leftover of an interrupted legacy save (no completeness mark):
         # clear it so the rename below lands on a free name
         shutil.rmtree(cur_dir)
-    ckptr = _orbax_checkpointer() if backend in ('auto', 'orbax') else None
-    if backend == 'orbax' and ckptr is None:
-        raise RuntimeError("orbax backend requested but not importable")
+    use_backend = backend
+    if backend == 'auto':
+        if (part is not None and part.active) or \
+                _state_is_sharded(main_program):
+            use_backend = 'sharded'
+    ckptr = None
+    if use_backend in ('auto', 'orbax'):
+        ckptr = _orbax_checkpointer()
+        if backend == 'orbax' and ckptr is None:
+            raise RuntimeError(
+                "orbax backend requested but not importable")
 
-    os.makedirs(checkpoint_dir, exist_ok=True)
     tmp_dir = os.path.join(
         checkpoint_dir, '%s%s_%d.%d' % (resilience.checkpoint.TMP_PREFIX,
                                         CHECKPOINT_PREFIX, serial,
                                         os.getpid()))
     try:
         tensors, used_backend = _write_checkpoint_payload(
-            tmp_dir, executor, main_program, ckptr)
-        resilience.write_manifest(tmp_dir, tensors=tensors,
-                                  trainer_state=trainer_state,
-                                  backend=used_backend, serial=serial)
+            tmp_dir, executor, main_program, use_backend, ckptr)
+        resilience.write_manifest(
+            tmp_dir, tensors=tensors, trainer_state=trainer_state,
+            backend=used_backend, serial=serial,
+            mesh=part.mesh_meta() if part is not None else None,
+            rules=part.rules if part is not None else None)
         # legacy completeness mark, still honored by older readers
         open(os.path.join(tmp_dir, SUCCESS_MARK_FILENAME), 'w').close()
         resilience.fsync_tree(tmp_dir)
@@ -432,6 +523,27 @@ def _load_checkpoint_payload(cur_dir, executor, main_program):
     transient read errors; CheckpointCorruption is NOT retried — it is
     deterministic and handled by the serial-fallback loop above)."""
     faultinject.maybe_fault(faultinject.SITE_CKPT_READ)
+    manifest = resilience.read_manifest(cur_dir) or {}
+    if manifest.get('backend') == 'sharded':
+        # host-side reassembly of the shard table; the caller reshards
+        # the restored scope onto ITS mesh afterwards (topology-aware
+        # restore: N-device checkpoints resume on M devices, incl. M=1)
+        from .resilience import sharded as _sharded
+        state = _sharded.load_state(cur_dir, manifest)
+        scope = global_scope()
+        program = main_program or default_main_program()
+        wanted = {v.name: v for v in filter(is_persistable,
+                                            program.list_vars())}
+        from .core.lowering import runtime_dtype
+        import jax.numpy as jnp
+        for name, arr in state.items():
+            var = wanted.get(name)
+            if var is None:
+                continue
+            dt = runtime_dtype(var.dtype if var.dtype else
+                               str(arr.dtype))
+            scope.set_var(name, jnp.asarray(arr.astype(dt)))
+        return
     orbax_dir = os.path.join(cur_dir, _ORBAX_SUBDIR)
     if os.path.isdir(orbax_dir):
         ckptr = _orbax_checkpointer()
@@ -499,6 +611,7 @@ def load_checkpoint(executor, checkpoint_dir=None, serial=None,
                 last_err = err
                 continue
         _load_checkpoint_payload(cur_dir, executor, main_program)
+        _reshard_restored(cur_dir, executor, main_program)
         _obs.default_registry().counter(
             'checkpoint_loads_total', 'checkpoint restores').inc()
         _obs.emit('checkpoint_load', serial=s, dir=cur_dir,
@@ -507,6 +620,37 @@ def load_checkpoint(executor, checkpoint_dir=None, serial=None,
     raise IOError(
         'all %d checkpoint serial(s) under %s failed verification; '
         'newest error: %s' % (len(candidates), checkpoint_dir, last_err))
+
+
+def _reshard_restored(cur_dir, executor, main_program):
+    """Topology-aware restore, step 2: distribute the just-restored
+    scope over the RESTORING process's mesh via the one spec
+    interpreter (``Partitioner.resolve_spec`` through ``shard_scope``).
+    A checkpoint written on an N-device mesh thus resumes on M devices
+    — including a degraded M < N restart — with each array committed
+    to the sharding the resumed program declares. No-op on the
+    single-device fallback (classic placement applies)."""
+    part = getattr(executor, 'partitioner', None)
+    if part is None or not part.active:
+        return
+    program = main_program or default_main_program()
+    t0 = _time.monotonic()
+    placed = part.shard_scope(global_scope(), program)
+    dur = _time.monotonic() - t0
+    reg = _obs.default_registry()
+    reg.histogram('resilience_reshard_seconds',
+                  'checkpoint state resharding wall at restore'
+                  ).observe(dur)
+    manifest = resilience.read_manifest(cur_dir) or {}
+    src = manifest.get('mesh') or {}
+    _obs.emit('reshard', dir=cur_dir,
+              from_mesh='x'.join('%s=%d' % (a, e) for a, e in
+                                 zip(src.get('axes', ()),
+                                     src.get('shape', ()))) or None,
+              to_mesh='x'.join('%s=%d' % (a, e) for a, e in
+                               zip(part.mesh_meta()['axes'],
+                                   part.mesh_meta()['shape'])),
+              vars=placed, dur_s=round(dur, 6))
 
 
 def load_checkpoint_trainer_state(checkpoint_dir, serial=None):
@@ -547,6 +691,9 @@ def clean_checkpoint(checkpoint_dir, delete_dir=False):
                         CHECKPOINT_PREFIX + '_'):
             shutil.rmtree(os.path.join(checkpoint_dir, d),
                           ignore_errors=True)
+    lock = os.path.join(checkpoint_dir, _LOCK_FILENAME)
+    if os.path.exists(lock):
+        os.remove(lock)
     if delete_dir and not os.listdir(checkpoint_dir):
         os.rmdir(checkpoint_dir)
 

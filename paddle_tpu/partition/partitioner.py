@@ -166,6 +166,15 @@ class Partitioner(object):
                 'batch_axis': self.batch_axis,
                 'active': self.active}
 
+    def mesh_meta(self):
+        """JSON-ready mesh identity for checkpoint manifests (axes,
+        shape, device count) — what ``io.save_checkpoint`` records so a
+        restore on a different topology knows what it is resharding
+        (RESILIENCE.md "Sharded checkpoints & topology portability")."""
+        return {'axes': list(self._axes),
+                'shape': [int(s) for s in self.mesh.devices.shape],
+                'devices': self.device_count}
+
     # ---- spec resolution -------------------------------------------------
     def resolve_spec(self, spec, ndim=None, shape=None):
         """Variable.sharding tuple -> per-dim mesh axes (list), with

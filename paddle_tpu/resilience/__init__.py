@@ -35,8 +35,10 @@ from .faultinject import (FaultPlan, fault_plan, maybe_fault,  # noqa
                           truncate_checkpoint, nan_reader, flaky_reader,
                           SimulatedKill, KillSwitch,
                           SITE_SERVING_RUN, SITE_SERVING_LOAD,
-                          SITE_SERVING_PAD)
-from .autoresume import CheckpointConfig  # noqa
+                          SITE_SERVING_PAD, SITE_TRAINER_STEP)
+from . import sharded  # noqa
+from .autoresume import (CheckpointConfig,  # noqa
+                         partitioner_for_manifest)
 
 __all__ = [
     'retry', 'retry_call', 'RetryError',
@@ -48,5 +50,6 @@ __all__ = [
     'corrupt_checkpoint', 'truncate_checkpoint', 'nan_reader',
     'flaky_reader', 'SimulatedKill', 'KillSwitch',
     'SITE_SERVING_RUN', 'SITE_SERVING_LOAD', 'SITE_SERVING_PAD',
-    'CheckpointConfig',
+    'SITE_TRAINER_STEP', 'sharded',
+    'CheckpointConfig', 'partitioner_for_manifest',
 ]

@@ -2,23 +2,36 @@
 
 Parity: the reference trainer.py's CheckpointConfig (checkpoint_dir,
 max_num_checkpoints, epoch_interval, step_interval). Extended with the
-resilience knobs: backend selection, the secs-based rate limit, and
-``resume`` to opt out of auto-resume while keeping periodic saves.
+resilience knobs: backend selection, the secs-based rate limit,
+``resume`` to opt out of auto-resume while keeping periodic saves, and
+``preempt_save`` — when on (default), ``Trainer.train`` installs
+SIGTERM/SIGINT handlers that finish the in-flight K-step chunk, commit
+a checkpoint at the chunk boundary, journal ``preempt_save``, and
+return cleanly; the resumed run is bit-identical to an uninterrupted
+one.
 
 The Trainer saves parameters + optimizer accumulators (persistables) +
 its own progress (epoch, step, global step, RNG key) every
 ``step_interval`` steps and at every ``epoch_interval``-th epoch end;
 on construction-with-existing-checkpoints it transparently restores the
 newest uncorrupted serial and skips the already-completed steps.
+
+:func:`partitioner_for_manifest` is the mesh-degradation recovery
+entry: given the manifest a checkpoint recorded, it rebuilds the
+recorded topology when the devices still exist, and otherwise the
+largest data-parallel mesh that fits the shrunken fleet — restart
+scripts size their Partitioner through it instead of crashing on a
+mesh the machine no longer has.
 """
 
-__all__ = ['CheckpointConfig']
+__all__ = ['CheckpointConfig', 'partitioner_for_manifest']
 
 
 class CheckpointConfig(object):
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
                  epoch_interval=1, step_interval=10,
-                 save_interval_secs=0, backend='auto', resume=True):
+                 save_interval_secs=0, backend='auto', resume=True,
+                 preempt_save=True):
         if checkpoint_dir is None:
             raise ValueError('CheckpointConfig needs a checkpoint_dir')
         if epoch_interval < 1 or step_interval < 1:
@@ -31,6 +44,7 @@ class CheckpointConfig(object):
         self.save_interval_secs = save_interval_secs
         self.backend = backend
         self.resume = resume
+        self.preempt_save = preempt_save
 
     def __repr__(self):
         return ('CheckpointConfig(dir=%r, max=%d, epoch_interval=%d, '
@@ -38,3 +52,38 @@ class CheckpointConfig(object):
                                        self.max_num_checkpoints,
                                        self.epoch_interval,
                                        self.step_interval))
+
+
+def partitioner_for_manifest(manifest, place=None):
+    """A Partitioner sized for resuming a checkpoint whose manifest
+    recorded ``manifest['mesh']``.
+
+    - recorded mesh still fits the local devices: the recorded
+      topology is rebuilt exactly (same axes, same shape);
+    - FEWER devices than recorded (mesh degradation after a partial
+      outage): the largest 1-D data-parallel mesh over the surviving
+      devices — ``load_checkpoint`` reshards the restored state onto
+      it, so training continues instead of crashing;
+    - no/1-device record: the classic ``Partitioner.for_place``
+      single-device fallback.
+    """
+    import numpy as np
+    import jax
+    from ..partition import Partitioner
+
+    mesh_meta = (manifest or {}).get('mesh') or {}
+    shape = [int(s) for s in mesh_meta.get('shape') or (1,)]
+    axes = tuple(mesh_meta.get('axes') or ('dp',))
+    want = int(np.prod(shape))
+    devices = jax.devices()
+    if want <= 1 or len(devices) < 1:
+        if place is not None:
+            return Partitioner.for_place(place)
+        return Partitioner(num_devices=1)
+    if len(devices) >= want:
+        from jax.sharding import Mesh
+        arr = np.asarray(devices[:want]).reshape(shape)
+        return Partitioner(mesh=Mesh(arr, axes))
+    if len(devices) == 1 and place is not None:
+        return Partitioner.for_place(place)
+    return Partitioner(num_devices=len(devices))

@@ -95,15 +95,19 @@ def _payload_files(dirname):
 
 
 def write_manifest(dirname, tensors=None, trainer_state=None,
-                   backend=None, serial=None):
+                   backend=None, serial=None, mesh=None, rules=None):
     """Record the manifest for a fully-written payload in ``dirname``.
 
     ``tensors`` maps name -> numpy array (shape/dtype/CRC32 computed
     here — the npz backend passes the arrays it just serialized) OR
     name -> precomputed ``{'shape', 'dtype'[, 'crc32']}`` dict (the
     orbax backend records metadata without gathering sharded device
-    arrays to the host; its payload bytes are covered by the file CRCs
-    below). File-level CRC32 + size is recorded for every payload file.
+    arrays to the host; the sharded backend additionally records the
+    resolved ``spec`` and a per-shard ``shards`` table with per-shard
+    CRC32s). File-level CRC32 + size is recorded for every payload
+    file. ``mesh`` (axis names + shape) and logical-axis ``rules``
+    record the topology the payload was laid out for, so a restore on
+    a different mesh knows what it is resharding.
     """
     import time
     manifest = {
@@ -114,14 +118,18 @@ def write_manifest(dirname, tensors=None, trainer_state=None,
         'tensors': {},
         'files': {},
     }
+    if mesh is not None:
+        manifest['mesh'] = mesh
+    if rules is not None:
+        manifest['rules'] = [list(r) for r in rules]
     for name, arr in (tensors or {}).items():
         if isinstance(arr, dict):
-            manifest['tensors'][name] = {
-                'shape': list(arr['shape']),
-                'dtype': str(arr['dtype']),
-            }
-            if 'crc32' in arr:
-                manifest['tensors'][name]['crc32'] = arr['crc32']
+            entry = {'shape': list(arr['shape']),
+                     'dtype': str(arr['dtype'])}
+            for k in ('crc32', 'spec', 'shards'):
+                if k in arr:
+                    entry[k] = arr[k]
+            manifest['tensors'][name] = entry
             continue
         arr = np.asarray(arr)
         manifest['tensors'][name] = {
@@ -189,8 +197,14 @@ def verify_checkpoint(dirname, check_tensors=True):
     extra = on_disk - set(manifest.get('files', {}))
     for rel in sorted(extra):
         errors.append('unmanifested payload file %s' % rel)
-    if check_tensors and not errors:
-        errors.extend(_verify_tensors(dirname, manifest))
+    if check_tensors:
+        if manifest.get('backend') == 'sharded':
+            # runs even with file-level errors present: the per-shard
+            # check names the TENSOR a damaged shard belongs to
+            from . import sharded as _sharded
+            errors.extend(_sharded.verify_tensors(dirname, manifest))
+        elif not errors:
+            errors.extend(_verify_tensors(dirname, manifest))
     return errors
 
 

@@ -34,6 +34,10 @@ SITE_CKPT_WRITE = 'checkpoint.write'      # payload serialization
 SITE_CKPT_COMMIT = 'checkpoint.commit'    # between payload and rename
 SITE_CKPT_READ = 'checkpoint.read'        # payload deserialization
 SITE_READER_NEXT = 'reader.next'          # program-reader batch pull
+SITE_TRAINER_STEP = 'trainer.step'        # top of each train-loop step
+#   ^ the preemption-delivery site: a plan with ``action=`` fires a
+#   side effect (e.g. os.kill(os.getpid(), SIGTERM)) at an exact step,
+#   so SIGTERM-mid-chunk recovery is deterministically testable
 # serving runtime sites (SERVING.md "Failure domains & SLO guardrails")
 SITE_SERVING_RUN = 'serving/run_batch'    # inside the per-attempt run
 SITE_SERVING_LOAD = 'serving/load_model'  # model load / hot swap
@@ -69,16 +73,23 @@ class FaultPlan(object):
         self.faults = collections.Counter()
 
     def inject(self, site, error=FaultInjected, at=None, times=None,
-               every=None, delay=None):
+               every=None, delay=None, action=None):
+        """``action`` is a zero-arg callable fired at the injection
+        point (after ``delay``, before ``error``) — the side-effect
+        channel: deliver a real signal, flip a flag, damage a file.
+        With ``error=None`` the matched hit performs only the
+        delay/action (a wedge, or a pure preemption delivery)."""
         if at is None and times is None and every is None:
             times = 1
-        if error is None and delay is None:
-            raise ValueError('error=None requires delay= (a pure hang)')
+        if error is None and delay is None and action is None:
+            raise ValueError(
+                'error=None requires delay= (a pure hang) or action= '
+                '(a pure side effect)')
         self._rules[site].append({'error': error,
                                   'at': None if at is None
                                   else frozenset(at),
                                   'times': times, 'every': every,
-                                  'delay': delay})
+                                  'delay': delay, 'action': action})
         return self
 
     def check(self, site):
@@ -96,6 +107,8 @@ class FaultPlan(object):
             self.faults[site] += 1
             if rule['delay']:
                 time.sleep(rule['delay'])
+            if rule.get('action') is not None:
+                rule['action']()
             err = rule['error']
             if err is None:
                 continue          # pure hang: no error to raise
@@ -173,12 +186,21 @@ def _payload_paths(serial_dir):
     return sorted(paths, key=os.path.getsize, reverse=True)
 
 
-def corrupt_checkpoint(checkpoint_dir, serial=None, nbytes=8):
+def corrupt_checkpoint(checkpoint_dir, serial=None, nbytes=8,
+                       path_contains=None):
     """Flip ``nbytes`` bytes in the middle of the (newest, unless
     ``serial`` given) checkpoint's largest payload file WITHOUT
     touching the manifest — exactly what bitrot/torn writes look like.
-    Returns the damaged file's path."""
-    target = _payload_paths(_pick_serial_dir(checkpoint_dir, serial))[0]
+    ``path_contains`` picks a specific payload file by substring
+    instead (e.g. one SHARD of a sharded checkpoint: the validator
+    must then name exactly that shard). Returns the damaged file's
+    path."""
+    paths = _payload_paths(_pick_serial_dir(checkpoint_dir, serial))
+    if path_contains is not None:
+        paths = [p for p in paths if path_contains in p]
+        if not paths:
+            raise IOError('no payload file matching %r' % path_contains)
+    target = paths[0]
     size = os.path.getsize(target)
     offset = max(0, size // 2 - nbytes // 2)
     with open(target, 'r+b') as f:

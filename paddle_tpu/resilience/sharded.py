@@ -1,0 +1,297 @@
+"""Sharded checkpoint payloads: per-shard files, host-side reassembly,
+and topology-aware reshard planning (RESILIENCE.md "Sharded checkpoints
+& topology portability").
+
+The ``sharded`` checkpoint backend writes ONE ``.npy`` file per array
+shard instead of gathering every (possibly mesh-distributed) array to a
+single host buffer — the save path of a model sharded over N devices
+never materializes a full replica. The manifest records, per tensor,
+the global shape/dtype, the resolved sharding spec the arrays carried
+at save time, and a shard table (file, index, CRC32); plus the mesh
+(axis names + shape) and logical-axis rules, so a restore on a
+DIFFERENT mesh knows exactly what it is resharding.
+
+Everything here is numpy + stdlib on purpose: ``tools/reshard_ckpt.py``
+converts checkpoints offline between topologies with no live device
+mesh at all — resharding is pure slicing arithmetic. The device-side
+twin of :func:`resolve_spec` is ``Partitioner.resolve_spec``
+(partition/partitioner.py); both degrade unknown axes and non-divisible
+dims to replicated, and ``tests/test_elastic.py`` pins their agreement.
+"""
+import itertools
+import os
+
+import numpy as np
+
+from .checkpoint import tensor_crc32
+
+__all__ = ['SHARD_DIR', 'resolve_spec', 'shard_layout', 'shard_state',
+           'write_state', 'load_state', 'assemble_tensor',
+           'verify_tensors', 'spec_signature']
+
+# payload files live under <serial_dir>/shards/; the name encodes the
+# tensor ordinal, not the tensor name (var names like `fc_0.w_0@GRAD`
+# are not filesystem-safe) — the manifest shard table is the only map
+SHARD_DIR = 'shards'
+
+
+def resolve_spec(spec, axes, extents, rules, shape):
+    """Host-side spec resolution: per-dim mesh axes for ``shape`` on a
+    mesh with ``axes``/``extents`` under logical-axis ``rules``.
+
+    Mirrors ``Partitioner.resolve_spec``: mesh axes pass through,
+    logical names resolve through the rules, anything unresolvable or
+    non-divisible degrades to None (replicated on that dim).
+    """
+    from ..partition.rules import resolve_entry
+    rules = tuple(tuple(r) for r in (rules or ()))
+    out = [resolve_entry(e, tuple(axes), rules) for e in (spec or ())]
+    out = out[:len(shape)]
+    out += [None] * (len(shape) - len(out))
+    for d, entry in enumerate(out):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        e = int(np.prod([int(extents.get(a, 1)) for a in names]))
+        if e <= 1 or int(shape[d]) % e != 0:
+            out[d] = None
+    return out
+
+
+def _dim_cuts(spec, shape, extents):
+    """Per-dim shard counts for a RESOLVED spec (every entry already a
+    mesh axis name/tuple or None, divisibility already degraded)."""
+    cuts = []
+    for d, entry in enumerate(spec):
+        if entry is None:
+            cuts.append(1)
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        e = int(np.prod([int(extents.get(a, 1)) for a in names]))
+        cuts.append(e if e > 1 and int(shape[d]) % e == 0 else 1)
+    return cuts
+
+
+def shard_layout(shape, spec, extents):
+    """The shard index table a (shape, resolved-spec) pair splits into:
+    a list of ``[[start, stop], ...]`` per-dim bounds, row-major over
+    the per-dim cuts. Replicated (or scalar) arrays are ONE shard."""
+    shape = [int(s) for s in shape]
+    padded = (list(spec or ()) + [None] * len(shape))[:len(shape)]
+    cuts = _dim_cuts(padded, shape, extents)
+    per_dim = []
+    for size, n in zip(shape, cuts):
+        step = size // n
+        per_dim.append([[i * step, (i + 1) * step] for i in range(n)])
+    if not shape:
+        return [[]]
+    return [list(combo) for combo in itertools.product(*per_dim)]
+
+
+def _normalize_index(index, shape):
+    """A jax ``Shard.index`` (tuple of slices) -> ``[[start, stop]]``
+    bounds per dim."""
+    out = []
+    for sl, size in zip(index, shape):
+        start, stop, step = sl.indices(int(size))
+        if step != 1:
+            raise ValueError('strided shard index %r unsupported' % (sl,))
+        out.append([int(start), int(stop)])
+    return out
+
+
+def _array_spec(val):
+    """The sharding spec a live array actually carries: its
+    NamedSharding PartitionSpec padded to ndim, else fully replicated."""
+    sharding = getattr(val, 'sharding', None)
+    spec = getattr(sharding, 'spec', None)
+    ndim = int(getattr(val, 'ndim', np.ndim(val)))
+    if spec is None:
+        return [None] * ndim
+    out = [list(e) if isinstance(e, tuple) else e for e in tuple(spec)]
+    return (out + [None] * ndim)[:ndim]
+
+
+def shard_state(state):
+    """Plan the shard set of a state dict WITHOUT copying anything.
+
+    Yields ``(name, val, spec, shards)`` where ``shards`` is a list of
+    ``(bounds, extract)`` pairs — ``extract()`` returns the shard's
+    numpy payload. Mesh-distributed jax arrays enumerate their unique
+    addressable shards (no full-replica gather); everything else is one
+    whole shard. A non-fully-addressable array (multi-process) falls
+    back to a gathered single shard — the portable lowest common
+    denominator."""
+    for name in sorted(state):
+        val = state[name]
+        shape = tuple(int(s) for s in np.shape(val))
+        addressable = getattr(val, 'addressable_shards', None)
+        fully = getattr(val, 'is_fully_addressable', True)
+        dev_set = getattr(getattr(val, 'sharding', None), 'device_set',
+                          ())
+        if addressable and fully and len(dev_set) > 1:
+            seen = {}
+            for sh in addressable:
+                bounds = _normalize_index(sh.index, shape)
+                key = tuple(tuple(b) for b in bounds)
+                if key not in seen:
+                    seen[key] = sh
+            shards = [(list(list(b) for b in key),
+                       (lambda s=sh: np.asarray(s.data)))
+                      for key, sh in sorted(seen.items())]
+            # a replicated-over-the-mesh array dedupes to one full shard
+            yield name, val, _array_spec(val), shards
+        else:
+            bounds = [[0, s] for s in shape]
+            yield name, val, [None] * len(shape), \
+                [(bounds, (lambda v=val: np.asarray(v)))]
+
+
+def write_state(dirname, state, dtypes=None):
+    """Write every shard of ``state`` under ``dirname``/``shards``/ and
+    return the manifest ``tensors`` table:
+
+        name -> {shape, dtype, spec, shards: [{file, index, crc32}]}
+
+    ``dtypes`` optionally overrides the recorded dtype per name (the
+    runtime is 32-bit; the record keeps what was actually written)."""
+    shard_root = os.path.join(dirname, SHARD_DIR)
+    os.makedirs(shard_root, exist_ok=True)
+    tensors = {}
+    for t_idx, (name, val, spec, shards) in enumerate(shard_state(state)):
+        entries = []
+        dtype = None
+        for s_idx, (bounds, extract) in enumerate(shards):
+            arr = extract()
+            dtype = str(arr.dtype)
+            rel = '%s/t%04d_s%03d.npy' % (SHARD_DIR, t_idx, s_idx)
+            np.save(os.path.join(dirname, rel), arr, allow_pickle=False)
+            entries.append({'file': rel, 'index': bounds,
+                            'crc32': tensor_crc32(arr)})
+        tensors[name] = {
+            'shape': [int(s) for s in np.shape(val)],
+            'dtype': (dtypes or {}).get(name, dtype),
+            'spec': spec,
+            'shards': entries,
+        }
+    return tensors
+
+
+def write_resharded(dirname, state, specs, axes, extents, rules=None):
+    """Write HOST arrays as the shard set a TARGET mesh would hold:
+    each tensor's spec is resolved against (``axes``, ``extents``,
+    ``rules``) and the array sliced accordingly — resharding as pure
+    numpy arithmetic, no live device mesh required. This is the
+    ``tools/reshard_ckpt.py`` engine. Returns the manifest ``tensors``
+    table (same schema as :func:`write_state`)."""
+    shard_root = os.path.join(dirname, SHARD_DIR)
+    os.makedirs(shard_root, exist_ok=True)
+    tensors = {}
+    for t_idx, name in enumerate(sorted(state)):
+        arr = np.asarray(state[name])
+        spec = resolve_spec((specs or {}).get(name) or (), axes,
+                            extents, rules, arr.shape)
+        entries = []
+        for s_idx, bounds in enumerate(
+                shard_layout(arr.shape, spec, extents)):
+            sel = tuple(slice(int(b[0]), int(b[1])) for b in bounds)
+            shard = np.ascontiguousarray(arr[sel])
+            rel = '%s/t%04d_s%03d.npy' % (SHARD_DIR, t_idx, s_idx)
+            np.save(os.path.join(dirname, rel), shard,
+                    allow_pickle=False)
+            entries.append({'file': rel, 'index': [list(b)
+                                                   for b in bounds],
+                            'crc32': tensor_crc32(shard)})
+        tensors[name] = {
+            'shape': [int(s) for s in arr.shape],
+            'dtype': str(arr.dtype),
+            'spec': spec,
+            'shards': entries,
+        }
+    return tensors
+
+
+def assemble_tensor(dirname, meta):
+    """Reassemble one tensor from its shard table into a host array."""
+    shape = tuple(int(s) for s in meta['shape'])
+    out = np.empty(shape, dtype=np.dtype(meta['dtype']))
+    for entry in meta['shards']:
+        arr = np.load(os.path.join(dirname, entry['file']),
+                      allow_pickle=False)
+        sel = tuple(slice(int(b[0]), int(b[1]))
+                    for b in entry['index'])
+        out[sel] = arr.reshape(out[sel].shape)
+    return out
+
+
+def load_state(dirname, manifest):
+    """name -> host array for every tensor in a sharded manifest."""
+    return {name: assemble_tensor(dirname, meta)
+            for name, meta in (manifest.get('tensors') or {}).items()}
+
+
+def verify_tensors(dirname, manifest):
+    """Per-shard validation of a sharded checkpoint: every shard file
+    present and loadable, shard shape matching its recorded index
+    bounds, per-shard CRC32 matching, and the shard set tiling the
+    full tensor (no holes, no double-writes). Errors NAME the broken
+    shard — `corrupt one shard` must point at exactly that shard."""
+    errors = []
+    for name, meta in sorted((manifest.get('tensors') or {}).items()):
+        shape = tuple(int(s) for s in meta.get('shape', ()))
+        total = int(np.prod(shape)) if shape else 1
+        covered = 0
+        seen = set()
+        shards = meta.get('shards') or []
+        if not shards:
+            errors.append('tensor %s: empty shard table' % name)
+            continue
+        for entry in shards:
+            rel = entry.get('file', '?')
+            tag = 'tensor %s shard %s' % (name, rel)
+            path = os.path.join(dirname, rel)
+            bounds = tuple(tuple(int(x) for x in b)
+                           for b in entry.get('index', ()))
+            if bounds in seen:
+                errors.append('%s: duplicate shard index %r'
+                              % (tag, bounds))
+                continue
+            seen.add(bounds)
+            want_shape = tuple(b[1] - b[0] for b in bounds)
+            try:
+                arr = np.load(path, allow_pickle=False)
+            except (OSError, ValueError) as e:
+                errors.append('%s: unreadable (%r)' % (tag, e))
+                continue
+            if tuple(arr.shape) not in (want_shape,
+                                        tuple(s for s in want_shape)):
+                errors.append('%s: shape %s != index extents %s'
+                              % (tag, list(arr.shape),
+                                 list(want_shape)))
+                continue
+            if str(arr.dtype) != meta.get('dtype'):
+                errors.append('%s: dtype %s != manifest %s'
+                              % (tag, arr.dtype, meta.get('dtype')))
+                continue
+            if tensor_crc32(arr) != entry.get('crc32'):
+                errors.append('%s: payload crc mismatch' % tag)
+                continue
+            covered += int(np.prod(want_shape)) if want_shape else 1
+        if not any(e.startswith('tensor %s ' % name) or
+                   e.startswith('tensor %s:' % name) for e in errors) \
+                and covered != total:
+            errors.append(
+                'tensor %s: shards cover %d of %d elements'
+                % (name, covered, total))
+    return errors
+
+
+def spec_signature(tensors):
+    """Stable (name, spec) signature of a manifest tensor table — what
+    check_checkpoint surfaces and reshard planning diffs against."""
+    sig = []
+    for name in sorted(tensors or {}):
+        spec = (tensors[name].get('spec') or [])
+        sig.append((name, tuple(
+            tuple(e) if isinstance(e, list) else e for e in spec)))
+    return tuple(sig)

@@ -35,6 +35,7 @@ from .core.places import TPUPlace, CPUPlace
 from .parallel import parallel_executor
 from .resilience import CheckpointConfig, AnomalyGuard  # noqa: F401 (API)
 from .resilience import anomaly as _anomaly
+from .resilience import faultinject as _fi
 
 __all__ = ['Trainer', 'BeginEpochEvent', 'EndEpochEvent',
            'BeginStepEvent', 'EndStepEvent', 'check_and_get_place',
@@ -261,20 +262,33 @@ class Trainer(object):
         self.scope.set_var(RNG_KEY, jnp.asarray(arr))
 
     def _save_progress_checkpoint(self, cfg, epoch_id, step_id,
-                                  global_step):
+                                  global_step, exe=None, force=False):
         """One atomic checkpoint carrying params + optimizer
         accumulators (persistables) and the trainer's own progress, so
-        a restart replays NOTHING and repeats NOTHING."""
+        a restart replays NOTHING and repeats NOTHING. ``exe`` is the
+        TRAINING executor (its Partitioner's mesh/rules land in the
+        manifest; sharded state saves per-shard). ``force`` bypasses
+        the secs rate limit — a preemption save must always commit."""
         state = {'epoch': epoch_id, 'step': step_id,
                  'global_step': global_step, 'rng': self._rng_state()}
         io.save_checkpoint(
-            executor.Executor(self.place), cfg.checkpoint_dir,
+            exe if exe is not None else executor.Executor(self.place),
+            cfg.checkpoint_dir,
             max_num_checkpoints=cfg.max_num_checkpoints,
-            save_interval_secs=cfg.save_interval_secs,
+            save_interval_secs=0 if force else cfg.save_interval_secs,
             main_program=self.train_program, backend=cfg.backend,
             trainer_state=state)
 
-    def _maybe_resume(self, cfg):
+    def _reload_executor(self, exe):
+        """An Executor for checkpoint restore that places restored
+        state through the TRAINING executor's Partitioner — on a mesh,
+        rollback/resume reshards the state back over the mesh instead
+        of committing a single-device copy the sharded step would then
+        refuse (RESILIENCE.md "Sharded checkpoints")."""
+        return executor.Executor(
+            self.place, partitioner=getattr(exe, 'partitioner', None))
+
+    def _maybe_resume(self, cfg, exe=None):
         """Restore the newest healthy checkpoint (params into the
         scope, RNG key, progress counters). Returns (start_epoch,
         resume_step, global_step); resume_step is the LAST COMPLETED
@@ -283,8 +297,9 @@ class Trainer(object):
             return 0, -1, 0
         if not io._get_checkpoint_serials(cfg.checkpoint_dir):
             return 0, -1, 0
-        exe = executor.Executor(self.place)
-        cur_dir = io.load_checkpoint(exe, cfg.checkpoint_dir,
+        reload_exe = self._reload_executor(exe) if exe is not None \
+            else executor.Executor(self.place)
+        cur_dir = io.load_checkpoint(reload_exe, cfg.checkpoint_dir,
                                      main_program=self.train_program)
         from .resilience import read_manifest
         manifest = read_manifest(cur_dir) or {}
@@ -363,8 +378,29 @@ class Trainer(object):
         grad_names = []
         if guard is not None and guard.monitor_gradients:
             grad_names = self._grad_fetch_names()
-        reload_exe = executor.Executor(self.place)
-        start_epoch, resume_step, global_step = self._maybe_resume(cfg)
+        reload_exe = self._reload_executor(exe)
+        start_epoch, resume_step, global_step = self._maybe_resume(cfg,
+                                                                   exe)
+        # preemption safety (RESILIENCE.md): SIGTERM/SIGINT set a flag;
+        # the loop finishes the in-flight K-step chunk, commits a
+        # checkpoint at the chunk boundary, journals `preempt_save`,
+        # and returns cleanly — resume is bit-identical to an
+        # uninterrupted run. Handlers only install on the main thread
+        # (signal.signal raises elsewhere) and when a checkpoint config
+        # with preempt_save is present.
+        import signal as _signal
+        import threading as _threading
+        preempt = {'sig': None}
+        prev_handlers = {}
+        if cfg is not None and getattr(cfg, 'preempt_save', True) and \
+                _threading.current_thread() is _threading.main_thread():
+            def _on_preempt(signum, frame):
+                preempt['sig'] = signum
+            for s in (_signal.SIGTERM, _signal.SIGINT):
+                try:
+                    prev_handlers[s] = _signal.signal(s, _on_preempt)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
         # telemetry (OBSERVABILITY.md): per-step metrics into the
         # process registry + typed records into the installed journal
         reg = _obs.default_registry()
@@ -485,74 +521,115 @@ class Trainer(object):
                 # the checkpoint records the chunk's last step (for
                 # K=1 this is exactly the old per-step behavior)
                 self._save_progress_checkpoint(cfg, epoch_id,
-                                               chunk[-1][0], global_step)
+                                               chunk[-1][0], global_step,
+                                               exe=exe)
 
-        for epoch_id in range(start_epoch, num_epochs):
-            event_handler(BeginEpochEvent(epoch_id))
-            _obs.emit('epoch_begin', epoch=epoch_id)
-            epoch_t0 = time.monotonic()
-            epoch_steps0 = steps_done
-            stream = self._feed_stream(reader, feeder, prefetch,
-                                       exe.partitioner)
-            try:
-                step_id = -1
-                chunk = []   # [(step_id, begin, feed, examples, wait_s)]
-                while True:
-                    if self.__stop:
+        def commit_preempt(epoch_id, last_step):
+            """Chunk-boundary preemption commit: the scope holds the
+            state of the last FLUSHED chunk, so this checkpoint resumes
+            exactly where the dispatch stream stopped."""
+            sig = preempt['sig']
+            self._save_progress_checkpoint(cfg, epoch_id, last_step,
+                                           global_step, exe=exe,
+                                           force=True)
+            reg.counter('resilience_preempt_saves_total',
+                        'chunk-boundary checkpoints committed on '
+                        'SIGTERM/SIGINT').inc()
+            _obs.emit('preempt_save', signal=int(sig), epoch=epoch_id,
+                      step=last_step, global_step=global_step)
+            _logger.warning(
+                'preemption (signal %d): committed checkpoint at chunk '
+                'boundary (epoch %d, step %d, global step %d); exiting '
+                'cleanly', sig, epoch_id, last_step, global_step)
+
+        try:
+            for epoch_id in range(start_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                _obs.emit('epoch_begin', epoch=epoch_id)
+                epoch_t0 = time.monotonic()
+                epoch_steps0 = steps_done
+                stream = self._feed_stream(reader, feeder, prefetch,
+                                           exe.partitioner)
+                try:
+                    step_id = -1
+                    chunk = []  # [(step_id, begin, feed, examples, wait_s)]
+                    while True:
+                        if self.__stop:
+                            return
+                        t_wait = time.monotonic()
+                        try:
+                            examples, feed = next(stream)
+                        except StopIteration:
+                            break
+                        wait_s = time.monotonic() - t_wait
+                        step_id += 1
+                        if epoch_id == start_epoch and \
+                                step_id <= resume_step:
+                            continue  # completed before the restart
+                        # deterministic preemption-delivery site: a
+                        # FaultPlan action here (e.g. os.kill SIGTERM)
+                        # lands mid-chunk at an exact step index
+                        _fi.maybe_fault(_fi.SITE_TRAINER_STEP)
+                        begin = BeginStepEvent(epoch_id, step_id)
+                        event_handler(begin)
+                        _obs.emit('step_begin', epoch=epoch_id,
+                                  step=step_id, global_step=global_step)
+                        m_host_wait.observe(wait_s)
+                        if guard is not None and guard.check_feeds:
+                            err = guard.inspect_feed(feed)
+                            if err is not None and self._handle_anomaly(
+                                    err, reload_exe) == 'skip':
+                                # batch never reaches the device: params
+                                # stay clean; the event stream still
+                                # advances so step counts match an
+                                # un-poisoned run
+                                global_step += 1
+                                _obs.emit('step_end', epoch=epoch_id,
+                                          step=step_id,
+                                          global_step=global_step,
+                                          skipped='anomaly')
+                                event_handler(EndStepEvent(epoch_id,
+                                                           step_id,
+                                                           None))
+                                continue
+                        chunk.append((step_id, begin, feed, examples,
+                                      wait_s))
+                        if len(chunk) >= chain_k:
+                            flush(epoch_id, chunk)
+                            chunk = []
+                            if preempt['sig'] is not None:
+                                # the in-flight chunk just committed;
+                                # checkpoint at its boundary and leave
+                                commit_preempt(epoch_id, step_id)
+                                return
+                    if chunk:
+                        flush(epoch_id, chunk)  # epoch tail (< K steps)
+                    if preempt['sig'] is not None:
+                        commit_preempt(epoch_id, step_id)
                         return
-                    t_wait = time.monotonic()
-                    try:
-                        examples, feed = next(stream)
-                    except StopIteration:
-                        break
-                    wait_s = time.monotonic() - t_wait
-                    step_id += 1
-                    if epoch_id == start_epoch and \
-                            step_id <= resume_step:
-                        continue  # completed before the restart
-                    begin = BeginStepEvent(epoch_id, step_id)
-                    event_handler(begin)
-                    _obs.emit('step_begin', epoch=epoch_id,
-                              step=step_id, global_step=global_step)
-                    m_host_wait.observe(wait_s)
-                    if guard is not None and guard.check_feeds:
-                        err = guard.inspect_feed(feed)
-                        if err is not None and self._handle_anomaly(
-                                err, reload_exe) == 'skip':
-                            # batch never reaches the device: params
-                            # stay clean; the event stream still
-                            # advances so step counts match an
-                            # un-poisoned run
-                            global_step += 1
-                            _obs.emit('step_end', epoch=epoch_id,
-                                      step=step_id,
-                                      global_step=global_step,
-                                      skipped='anomaly')
-                            event_handler(EndStepEvent(epoch_id,
-                                                       step_id, None))
-                            continue
-                    chunk.append((step_id, begin, feed, examples,
-                                  wait_s))
-                    if len(chunk) >= chain_k:
-                        flush(epoch_id, chunk)
-                        chunk = []
-                if chunk:
-                    flush(epoch_id, chunk)   # epoch tail (< K steps)
-            finally:
-                close = getattr(stream, 'close', None)
-                if close is not None:
-                    close()   # stop the prefetch worker promptly
-            event_handler(EndEpochEvent(epoch_id))
-            epoch_wall = time.monotonic() - epoch_t0
-            _obs.emit('epoch_end', epoch=epoch_id,
-                      steps=steps_done - epoch_steps0,
-                      dur_s=round(epoch_wall, 6))
-            if cfg is not None and \
-                    (epoch_id + 1) % cfg.epoch_interval == 0:
-                # recorded as "epoch_id+1, nothing done yet": a resume
-                # lands at the top of the NEXT epoch, not a replay
-                self._save_progress_checkpoint(cfg, epoch_id + 1, -1,
-                                               global_step)
+                finally:
+                    close = getattr(stream, 'close', None)
+                    if close is not None:
+                        close()   # stop the prefetch worker promptly
+                event_handler(EndEpochEvent(epoch_id))
+                epoch_wall = time.monotonic() - epoch_t0
+                _obs.emit('epoch_end', epoch=epoch_id,
+                          steps=steps_done - epoch_steps0,
+                          dur_s=round(epoch_wall, 6))
+                if cfg is not None and \
+                        (epoch_id + 1) % cfg.epoch_interval == 0:
+                    # recorded as "epoch_id+1, nothing done yet": a
+                    # resume lands at the top of the NEXT epoch, not a
+                    # replay
+                    self._save_progress_checkpoint(cfg, epoch_id + 1,
+                                                   -1, global_step,
+                                                   exe=exe)
+        finally:
+            for s, h in prev_handlers.items():
+                try:
+                    _signal.signal(s, h)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
 
     def _test_by_executor(self, reader, feed_order, fetch_list):
         with executor.scope_guard(self.scope):

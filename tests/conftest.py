@@ -70,3 +70,10 @@ def pytest_configure(config):
         'CPU-fallback bit-exactness, multi-device CPU-mesh training '
         'parity, per-(program, sharding, mesh) compile caching, '
         'sharded serving load (tier-1; filter with -m "not partition")')
+    config.addinivalue_line(
+        'markers',
+        'elastic: tests of partition-aware resilience — sharded '
+        'checkpoints, topology-portable restore (N-device save -> '
+        'M-device resume), SIGTERM preemption safety, mesh-degraded '
+        'autoresume, concurrent-saver locking (tier-1; filter with '
+        '-m "not elastic")')

@@ -247,7 +247,8 @@ def test_kill_mid_save_leaves_no_partial_checkpoint(tmp_path):
             with pytest.raises(FaultInjected):
                 pio.save_checkpoint(exe2, ckdir, main_program=main2,
                                     save_interval_secs=0, backend='npz')
-        listing = sorted(os.listdir(ckdir))
+        listing = sorted(d for d in os.listdir(ckdir)
+                         if d != '.ckpt_lock')  # the advisory lockfile
         assert listing == ['checkpoint_0']  # no serial 1, no tmp wreck
         assert resilience.verify_checkpoint(
             os.path.join(ckdir, 'checkpoint_0')) == []

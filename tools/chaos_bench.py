@@ -85,12 +85,52 @@ def _reference_fn(model_dir):
     return run
 
 
+def _mesh_partitioner(mesh):
+    """A dp-mesh Partitioner over the first ``mesh`` local devices, or
+    None for the classic single-device run."""
+    if not mesh or mesh <= 1:
+        return None
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.partition import Partitioner
+    devs = jax.devices()
+    if len(devs) < mesh:
+        raise RuntimeError(
+            'mesh=%d requested but only %d device(s) visible — set '
+            'XLA_FLAGS=--xla_force_host_platform_device_count=%d (the '
+            'CLI does this automatically)' % (mesh, len(devs), mesh))
+    return Partitioner(mesh=Mesh(np.asarray(devs[:mesh]), ('dp',)))
+
+
+def _sharded_reference_fn(fluid, artifact, mesh, max_batch):
+    """Fault-free reference for mesh mode: a CLEAN ModelServer with the
+    same partitioner/bucketing config, so 'bit-identical recovery'
+    compares the faulted sharded pipeline against the identical sharded
+    computation (a raw single-device executor run is a different XLA
+    program; cross-mesh float reductions need not match bitwise)."""
+    from paddle_tpu.serving import ModelServer
+    srv = ModelServer(place=fluid.CPUPlace(), max_batch_size=max_batch,
+                      partitioner=_mesh_partitioner(mesh))
+    srv.load_model('ref', artifact)
+    srv.warmup('ref')
+
+    def run(x):
+        out, = srv.infer('ref', {'x': x}, timeout=60.0)
+        return np.asarray(out)
+    run.close = srv.close
+    return run
+
+
 def run_chaos(n_requests=24, fault_times=3, extra_fault_at=None,
               max_batch=8, seed=1, failure_threshold=3, cooldown=0.25,
-              probe_successes=2, hang_phase=True):
+              probe_successes=2, hang_phase=True, mesh=1):
     """Returns a result dict with ``problems`` (empty = all invariants
     held). Faults and inputs are fully seeded — two runs with the same
-    arguments exercise the identical schedule."""
+    arguments exercise the identical schedule. ``mesh=N`` runs the
+    whole plan against a SHARDED ModelServer (models distributed over
+    an N-device dp mesh via the Partitioner); the guardrail invariants
+    — no worker death, typed resolution, bit-identical recovery — must
+    hold unchanged."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.resilience import (FaultPlan, fault_plan,
                                        SITE_SERVING_RUN)
@@ -105,8 +145,14 @@ def run_chaos(n_requests=24, fault_times=3, extra_fault_at=None,
               for _ in range(n_requests)]
     with tempfile.TemporaryDirectory(prefix='chaos_bench_') as workdir:
         artifact = _build_artifact(workdir)
-        reference = _reference_fn(artifact)
+        if mesh and mesh > 1:
+            reference = _sharded_reference_fn(fluid, artifact, mesh,
+                                              max_batch)
+        else:
+            reference = _reference_fn(artifact)
         expected = [reference(x) for x in inputs]
+        if hasattr(reference, 'close'):
+            reference.close()
 
         # ---- phase 1: batch-kill schedule vs the breaker -----------------
         plan = FaultPlan().inject(SITE_SERVING_RUN, times=fault_times)
@@ -115,6 +161,7 @@ def run_chaos(n_requests=24, fault_times=3, extra_fault_at=None,
         srv = ModelServer(
             place=fluid.CPUPlace(), max_batch_size=max_batch,
             retry_attempts=1, retry_backoff=0.0,
+            partitioner=_mesh_partitioner(mesh),
             breaker_config=dict(failure_threshold=failure_threshold,
                                 cooldown=cooldown,
                                 probe_successes=probe_successes,
@@ -222,7 +269,8 @@ def run_chaos(n_requests=24, fault_times=3, extra_fault_at=None,
         # ---- phase 2: wedged worker vs watchdog + close(timeout) ---------
         wedge = None
         if hang_phase:
-            wedge = _run_wedge_phase(fluid, artifact, problems)
+            wedge = _run_wedge_phase(fluid, artifact, problems,
+                                     mesh=mesh)
 
     return {
         'config': {'n_requests': n_requests, 'fault_times': fault_times,
@@ -230,7 +278,8 @@ def run_chaos(n_requests=24, fault_times=3, extra_fault_at=None,
                    'max_batch': max_batch, 'seed': seed,
                    'failure_threshold': failure_threshold,
                    'cooldown': cooldown,
-                   'probe_successes': probe_successes},
+                   'probe_successes': probe_successes,
+                   'mesh': mesh or 1},
         'outcomes': {'ok': sum(1 for k, _ in outcomes if k == 'ok'),
                      'typed_errors': len(failed),
                      'breaker_sheds': sheds,
@@ -242,7 +291,7 @@ def run_chaos(n_requests=24, fault_times=3, extra_fault_at=None,
     }
 
 
-def _run_wedge_phase(fluid, artifact, problems):
+def _run_wedge_phase(fluid, artifact, problems, mesh=1):
     """Inject a pure hang, assert the watchdog fails it on deadline and
     close(timeout=) returns instead of hanging on the wedged worker."""
     from paddle_tpu.resilience import (FaultPlan, fault_plan,
@@ -251,6 +300,7 @@ def _run_wedge_phase(fluid, artifact, problems):
 
     srv = ModelServer(place=fluid.CPUPlace(), max_batch_size=4,
                       retry_attempts=1, retry_backoff=0.0,
+                      partitioner=_mesh_partitioner(mesh),
                       watchdog_poll=0.02)
     srv.load_model('m', artifact)
     srv.warmup('m')
@@ -292,6 +342,10 @@ def main(argv=None):
                     help='consecutive batch kills at the head')
     ap.add_argument('--max-batch', type=int, default=8)
     ap.add_argument('--seed', type=int, default=1)
+    ap.add_argument('--mesh', type=int, default=1,
+                    help='run the plan against a ModelServer sharded '
+                         'over an N-device dp mesh (host CPU devices '
+                         'are provisioned automatically)')
     ap.add_argument('--smoke', action='store_true',
                     help='seeded short schedule; exit nonzero if any '
                          'guardrail invariant breaks')
@@ -300,6 +354,13 @@ def main(argv=None):
     ap.add_argument('--json', default=None,
                     help='write the full result dict to this path')
     args = ap.parse_args(argv)
+    if args.mesh > 1 and 'xla_force_host_platform_device_count' not in \
+            os.environ.get('XLA_FLAGS', ''):
+        # must land before jax initializes (first import below)
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            ' --xla_force_host_platform_device_count=%d'
+            % args.mesh).strip()
     _force_cpu()
 
     if args.smoke:
@@ -309,13 +370,15 @@ def main(argv=None):
                             extra_fault_at=(12,), max_batch=8, seed=1,
                             failure_threshold=3, cooldown=0.25,
                             probe_successes=2,
-                            hang_phase=not args.no_hang_phase)
+                            hang_phase=not args.no_hang_phase,
+                            mesh=args.mesh)
     else:
         results = run_chaos(n_requests=args.requests,
                             fault_times=args.fault_times,
                             extra_fault_at=(args.requests // 2,),
                             max_batch=args.max_batch, seed=args.seed,
-                            hang_phase=not args.no_hang_phase)
+                            hang_phase=not args.no_hang_phase,
+                            mesh=args.mesh)
 
     if args.json:
         payload = dict(results)
@@ -324,9 +387,10 @@ def main(argv=None):
             json.dump(payload, f, indent=2, sort_keys=True, default=repr)
 
     o = results['outcomes']
-    print('chaos: %d ok, %d typed errors, %d breaker sheds, '
+    print('chaos%s: %d ok, %d typed errors, %d breaker sheds, '
           '%d bit-identical post-recovery'
-          % (o['ok'], o['typed_errors'], o['breaker_sheds'],
+          % (' (mesh=%d)' % args.mesh if args.mesh > 1 else '',
+             o['ok'], o['typed_errors'], o['breaker_sheds'],
              o['recovered_bit_identical']))
     print('breaker transitions: %s'
           % ' -> '.join(results['breaker_transitions']))

@@ -61,15 +61,27 @@ def scan_dir(root, serial=None):
     for s, path in dirs:
         errors = verify_checkpoint(path)
         manifest = read_manifest(path)
+        tensors = (manifest or {}).get('tensors', {})
         entry = {
             'serial': s,
             'path': path,
             'healthy': not errors,
             'errors': list(errors),
             'legacy_no_manifest': manifest is None,
-            'tensors': len((manifest or {}).get('tensors', {})),
+            'tensors': len(tensors),
             'files': len((manifest or {}).get('files', {})),
             'backend': (manifest or {}).get('backend'),
+            # sharded-manifest surface (RESILIENCE.md "Sharded
+            # checkpoints"): the recorded mesh topology + axis rules
+            # and the shard-table totals — what a restore on a
+            # different mesh (or tools/reshard_ckpt.py) keys off
+            'mesh': (manifest or {}).get('mesh'),
+            'rules': len((manifest or {}).get('rules') or []),
+            'shards': sum(len(m.get('shards') or ())
+                          for m in tensors.values()),
+            'sharded_tensors': sum(
+                1 for m in tensors.values()
+                if len(m.get('shards') or ()) > 1),
         }
         result['serials'].append(entry)
         result['corrupt' if errors else 'healthy'] += 1
@@ -98,6 +110,12 @@ def check_dir(root, serial=None, quiet=False):
         extra = ' [legacy: no manifest]' if entry['legacy_no_manifest'] \
             else ' (%d tensors, %d files, backend=%s)' % (
                 entry['tensors'], entry['files'], entry['backend'])
+        if entry.get('mesh'):
+            extra += ' [mesh %s, %d shards, %d sharded tensors]' % (
+                'x'.join('%s=%s' % (a, e) for a, e in
+                         zip(entry['mesh'].get('axes', ()),
+                             entry['mesh'].get('shape', ()))),
+                entry['shards'], entry['sharded_tensors'])
         say('OK       %s%s' % (label, extra))
     say('%d/%d serial(s) healthy'
         % (result['healthy'], len(result['serials'])))

@@ -19,7 +19,10 @@ step records must carry the ``feed_wait`` host-wait field; ``--require
 compiler`` for a run that must have gone through the compiler pass
 pipeline (``compile_pass`` records); ``--require partition`` for a run
 that must have placed work through the Partitioner (``partition``
-records, PARTITIONING.md); ``--require any`` for presence only).
+records, PARTITIONING.md); ``--require resilience`` for a run that
+must have exercised preemption saves or topology resharding
+(``preempt_save`` / ``reshard`` records, RESILIENCE.md); ``--require
+any`` for presence only).
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
 load run writes.
 """
@@ -29,7 +32,11 @@ import sys
 
 REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                'pipeline': 'step_end', 'compiler': 'compile_pass',
-               'partition': 'partition', 'any': None}
+               'partition': 'partition',
+               # a resilience run must show at least one preemption
+               # save OR one topology reshard (RESILIENCE.md)
+               'resilience': ('preempt_save', 'reshard'),
+               'any': None}
 
 
 def load_journal(path):
@@ -108,6 +115,33 @@ def _compiler_summary(by_ev):
                 for r in by_ev.get('tuning_preload', ())),
             'puts': len(by_ev.get('tuning_put', ())),
         },
+    }
+
+
+def _resilience_summary(by_ev):
+    """Resilience SLI (RESILIENCE.md "Sharded checkpoints & topology
+    portability"): preemption saves (SIGTERM/SIGINT chunk-boundary
+    commits) and restore-time topology reshards (from-mesh -> to-mesh,
+    vars placed, wall)."""
+    preempts = by_ev.get('preempt_save', ())
+    reshards = by_ev.get('reshard', ())
+    topologies = {}
+    for r in reshards:
+        key = '%s -> %s' % (r.get('from_mesh') or '?',
+                            r.get('to_mesh') or '?')
+        t = topologies.setdefault(key, {'count': 0, 'vars': 0,
+                                        'wall_s': 0.0})
+        t['count'] += 1
+        t['vars'] += r.get('vars', 0)
+        t['wall_s'] += r.get('dur_s', 0.0)
+    return {
+        'preempt_saves': len(preempts),
+        'preempt_signals': sorted({r.get('signal') for r in preempts
+                                   if r.get('signal') is not None}),
+        'reshards': len(reshards),
+        'reshard_vars': sum(r.get('vars', 0) for r in reshards),
+        'reshard_wall_s': sum(r.get('dur_s', 0.0) for r in reshards),
+        'topologies': topologies,
     }
 
 
@@ -210,6 +244,7 @@ def summarize(records, malformed=0):
         'pipeline': _pipeline_summary(steps, duration),
         'compiler': _compiler_summary(by_ev),
         'partition': _partition_summary(by_ev),
+        'resilience': _resilience_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -309,6 +344,16 @@ def render(summary, top=10):
         lines.append('ckpts:    %d saves, %d loads, %d corruption '
                      'fallbacks' % (ck['saves'], ck['loads'],
                                     ck['fallbacks']))
+    rz = s.get('resilience') or {}
+    if rz.get('preempt_saves') or rz.get('reshards'):
+        lines.append(
+            'resilience: %d preemption save(s), %d reshard(s) '
+            '(%d vars, %.3fs wall)'
+            % (rz['preempt_saves'], rz['reshards'],
+               rz['reshard_vars'], rz['reshard_wall_s']))
+        for topo, t in sorted(rz.get('topologies', {}).items()):
+            lines.append('  reshard %-22s x%d  vars=%d  %.3fs'
+                         % (topo, t['count'], t['vars'], t['wall_s']))
     if s['anomalies']:
         lines.append('anomaly:  %d guard trips' % s['anomalies'])
     lines.append('events:   %s' % ', '.join(
@@ -344,10 +389,12 @@ def check_journal(path, require='step'):
         problems.append('journal does not start with run_begin')
     need = REQUIRED_EV[require]
     if need is not None:
+        wanted = need if isinstance(need, tuple) else (need,)
         n = sum(1 for r in records
-                if r['ev'] == need and 'skipped' not in r)
+                if r['ev'] in wanted and 'skipped' not in r)
         if n == 0:
-            problems.append('journal contains zero %s records' % need)
+            problems.append('journal contains zero %s records'
+                            % ' / '.join(wanted))
         elif require == 'pipeline':
             n = sum(1 for r in records if r['ev'] == need
                     and 'skipped' not in r and 'feed_wait' in r)

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Offline checkpoint resharding: convert a checkpoint between mesh
+topologies without a live device mesh.
+
+    python tools/reshard_ckpt.py CKPT_DIR --out OUT --mesh 2
+    python tools/reshard_ckpt.py CKPT_DIR --out OUT --mesh dp=2,mp=2
+    python tools/reshard_ckpt.py CKPT_DIR --out OUT --mesh 1 --serial 3
+
+CKPT_DIR is a checkpoint root (``checkpoint_<N>`` serials) or a single
+serial directory; the newest healthy serial converts unless ``--serial``
+picks one. The payload is reassembled host-side (sharded / npz / orbax
+backends all readable), re-split per the TARGET mesh through the same
+spec resolution the live restore path uses
+(``resilience.sharded.resolve_spec`` — unknown axes and non-divisible
+dims degrade to replicated), and committed with the atomic manifest
+protocol (tmp dir -> fsync -> manifest -> rename). ``trainer_state``
+and axis rules carry over, so auto-resume works from the converted
+checkpoint exactly as from the original.
+
+``--verify`` (default) reassembles the converted payload and checks it
+bit-identical to the source. Exit codes: 0 converted (and verified),
+1 conversion/verification failed, 2 nothing checkpoint-shaped found.
+
+RESILIENCE.md "Sharded checkpoints & topology portability".
+"""
+import argparse
+import json
+import os
+import re
+import shutil
+import sys
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.resilience import checkpoint as _ckpt  # noqa: E402
+from paddle_tpu.resilience import sharded as _sharded  # noqa: E402
+
+_SERIAL_RE = re.compile(r'^checkpoint_(\d+)$')
+
+
+def parse_mesh(spec):
+    """'4' -> dp=4; 'dp=2,mp=2' -> ordered axes. Returns (axes,
+    extents dict, shape list)."""
+    spec = (spec or '').strip()
+    if re.match(r'^\d+$', spec):
+        n = int(spec)
+        return ('dp',), {'dp': n}, [n]
+    axes, extents, shape = [], {}, []
+    for part in spec.split(','):
+        if '=' not in part:
+            raise ValueError('bad mesh spec %r (want N or a=N,b=M)'
+                             % spec)
+        a, n = part.split('=', 1)
+        a = a.strip()
+        axes.append(a)
+        extents[a] = int(n)
+        shape.append(int(n))
+    return tuple(axes), extents, shape
+
+
+def _pick_serial(root, serial=None):
+    """(serial, serial_dir) — the newest HEALTHY serial (or the
+    requested one), mirroring load_checkpoint's preference."""
+    if os.path.isfile(os.path.join(root, _ckpt.MANIFEST_FILENAME)):
+        return None, root
+    if not os.path.isdir(root):
+        return None, None
+    found = []
+    for name in os.listdir(root):
+        m = _SERIAL_RE.match(name)
+        if m and os.path.isdir(os.path.join(root, name)):
+            found.append(int(m.group(1)))
+    if serial is not None:
+        return (serial, os.path.join(root, 'checkpoint_%d' % serial)) \
+            if serial in found else (None, None)
+    for s in sorted(found, reverse=True):
+        d = os.path.join(root, 'checkpoint_%d' % s)
+        if not _ckpt.verify_checkpoint(d):
+            return s, d
+    return None, None
+
+
+def load_source_state(serial_dir, manifest):
+    """name -> host array for any backend (sharded / npz / orbax)."""
+    backend = manifest.get('backend')
+    if backend == 'sharded':
+        return _sharded.load_state(serial_dir, manifest)
+    orbax_dir = os.path.join(serial_dir, '__orbax__')
+    if os.path.isdir(orbax_dir):
+        import orbax.checkpoint as ocp
+        restored = ocp.PyTreeCheckpointer().restore(orbax_dir)
+        return {n: np.asarray(v) for n, v in restored.items()}
+    npz = os.path.join(serial_dir, '__params__.npz')
+    with np.load(npz, allow_pickle=False) as data:
+        return {n: data[n] for n in data.files}
+
+
+def reshard(serial_dir, out_root, mesh_spec, serial=None, verify=True):
+    """Convert one serial dir into ``out_root/checkpoint_<serial>``
+    laid out for ``mesh_spec``. Returns a result dict (problems empty
+    == success)."""
+    result = {'source': serial_dir, 'problems': []}
+    manifest = _ckpt.read_manifest(serial_dir)
+    if manifest is None:
+        result['problems'].append(
+            '%s has no manifest (legacy checkpoints cannot reshard '
+            'offline)' % serial_dir)
+        return result
+    errors = _ckpt.verify_checkpoint(serial_dir)
+    if errors:
+        result['problems'].append('source corrupt: %s' % '; '.join(
+            errors[:3]))
+        return result
+    axes, extents, shape = parse_mesh(mesh_spec)
+    state = load_source_state(serial_dir, manifest)
+    specs = {n: (meta.get('spec') or [])
+             for n, meta in (manifest.get('tensors') or {}).items()}
+    rules = manifest.get('rules')
+    out_serial = serial if serial is not None else \
+        manifest.get('serial') or 0
+    os.makedirs(out_root, exist_ok=True)
+    tmp = os.path.join(out_root, '%scheckpoint_%d.%d'
+                       % (_ckpt.TMP_PREFIX, out_serial, os.getpid()))
+    final = os.path.join(out_root, 'checkpoint_%d' % out_serial)
+    t0 = time.monotonic()
+    try:
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        tensors = _sharded.write_resharded(tmp, state, specs, axes,
+                                           extents, rules=rules)
+        _ckpt.write_manifest(
+            tmp, tensors=tensors,
+            trainer_state=manifest.get('trainer_state'),
+            backend='sharded', serial=out_serial,
+            mesh={'axes': list(axes), 'shape': shape,
+                  'devices': int(np.prod(shape))},
+            rules=rules)
+        open(os.path.join(tmp, '_SUCCESS'), 'w').close()
+        _ckpt.fsync_tree(tmp)
+        if os.path.isdir(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+    finally:
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+    result.update({
+        'out': final,
+        'serial': out_serial,
+        'from_mesh': manifest.get('mesh'),
+        'to_mesh': {'axes': list(axes), 'shape': shape},
+        'tensors': len(tensors),
+        'shards': sum(len(m['shards']) for m in tensors.values()),
+        'sharded_tensors': sum(1 for m in tensors.values()
+                               if len(m['shards']) > 1),
+        'dur_s': round(time.monotonic() - t0, 6),
+    })
+    if verify:
+        errors = _ckpt.verify_checkpoint(final)
+        if errors:
+            result['problems'].append('converted checkpoint corrupt: '
+                                      '%s' % '; '.join(errors[:3]))
+        out_manifest = _ckpt.read_manifest(final)
+        back = _sharded.load_state(final, out_manifest)
+        for name, arr in state.items():
+            got = back.get(name)
+            if got is None:
+                result['problems'].append(
+                    'tensor %s missing after reshard' % name)
+            elif not np.array_equal(np.asarray(arr), got):
+                result['problems'].append(
+                    'tensor %s not bit-identical after reshard' % name)
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('ckpt_dir')
+    ap.add_argument('--out', required=True,
+                    help='output checkpoint root')
+    ap.add_argument('--mesh', required=True,
+                    help="target mesh: '4' (dp=4) or 'dp=2,mp=2'")
+    ap.add_argument('--serial', type=int, default=None)
+    ap.add_argument('--no-verify', action='store_true',
+                    help='skip the bit-exact reassembly check')
+    ap.add_argument('--json', default=None,
+                    help='write the result dict to this path')
+    args = ap.parse_args(argv)
+
+    serial, serial_dir = _pick_serial(args.ckpt_dir, args.serial)
+    if serial_dir is None:
+        print('error: no healthy checkpoint serial under %s'
+              % args.ckpt_dir, file=sys.stderr)
+        return 2
+    result = reshard(serial_dir, args.out, args.mesh, serial=serial,
+                     verify=not args.no_verify)
+    if args.json:
+        with open(args.json, 'w') as f:
+            json.dump(result, f, indent=2, sort_keys=True, default=repr)
+    if result['problems']:
+        print('RESHARD FAILED:', file=sys.stderr)
+        for p in result['problems']:
+            print('  - %s' % p, file=sys.stderr)
+        return 1
+    src = result.get('from_mesh') or {}
+    print('resharded %s -> %s' % (result['source'], result['out']))
+    print('mesh %s -> %s | %d tensors, %d shards (%d sharded) in %.3fs'
+          % ('x'.join(map(str, src.get('shape', ['?']))),
+             'x'.join(map(str, result['to_mesh']['shape'])),
+             result['tensors'], result['shards'],
+             result['sharded_tensors'], result['dur_s']))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
