@@ -671,6 +671,67 @@ def bench_decode(on_tpu):
             max(out['jitted_ms_per_sentence'], 1e-9), 2)
     log('decode jitted static-beam: %.2f ms/sentence (speedup %sx)' %
         (out['jitted_ms_per_sentence'], out.get('jitted_speedup', '?')))
+
+    # ---- continuous vs stop-and-wait batching (fleet tier) ----------
+    # ISSUE 9 / SERVING.md "Fleet tier & continuous batching": the
+    # same slotted step program under in-flight admission vs batch
+    # admission at a ragged length distribution (mostly-short
+    # sequences with one max-length straggler per slot group — the
+    # occupancy hole stop-and-wait pays for). Outputs are gated
+    # bit-identical between the two admission policies.
+    from paddle_tpu.fleet import DecodeEngine, recurrent_fc_cell
+    slots, n_seq, dec_max_len, seed = 8, 48, 32, 3
+    rng = np.random.RandomState(seed)
+    lengths = [int(rng.randint(1, dec_max_len // 4))
+               for _ in range(n_seq)]
+    for s in range(0, n_seq, slots):
+        lengths[s] = dec_max_len
+    hidden = 32
+    inits = [{'h': rng.randn(hidden).astype('float32')}
+             for _ in range(n_seq)]
+
+    def _run_admission(admission):
+        cell, specs = recurrent_fc_cell(dict_size=500, word_dim=32,
+                                        hidden=hidden)
+        eng = DecodeEngine(cell, specs, slots=slots,
+                           max_len=dec_max_len, end_id=None, seed=seed,
+                           admission=admission,
+                           place=ptfluid.TPUPlace(0) if on_tpu
+                           else ptfluid.CPUPlace())
+        eng.decode(init_states=inits[0], max_new_tokens=2)   # compile
+        t0 = time.perf_counter()
+        reqs = [eng.submit(init_states=inits[i],
+                           max_new_tokens=lengths[i])
+                for i in range(n_seq)]
+        outs = [r.result(timeout=600.0) for r in reqs]
+        wall = time.perf_counter() - t0
+        stats = eng.stats()
+        eng.close()
+        return outs, wall, stats
+
+    cont, cont_wall, cont_stats = _run_admission('continuous')
+    sw, sw_wall, sw_stats = _run_admission('stop_and_wait')
+    tokens = sum(lengths)
+    cont_tps = tokens / max(cont_wall, 1e-9)
+    sw_tps = tokens / max(sw_wall, 1e-9)
+    out['continuous_batching'] = {
+        'slots': slots, 'sequences': n_seq, 'tokens': tokens,
+        'ragged_lengths': {'min': min(lengths), 'max': max(lengths),
+                           'mean': round(sum(lengths) / n_seq, 1)},
+        'continuous_tokens_per_sec': round(cont_tps, 1),
+        'continuous_occupancy': round(cont_stats['mean_occupancy'], 4),
+        'stop_and_wait_tokens_per_sec': round(sw_tps, 1),
+        'stop_and_wait_occupancy': round(sw_stats['mean_occupancy'],
+                                         4),
+        'exact_match': bool(all(np.array_equal(a, b)
+                                for a, b in zip(cont, sw))),
+    }
+    out['continuous_speedup'] = round(cont_tps / max(sw_tps, 1e-9), 2)
+    log('decode continuous batching: %.0f tok/s (occ %.0f%%) vs '
+        'stop-and-wait %.0f tok/s (occ %.0f%%) -> %.2fx, exact=%s' %
+        (cont_tps, 100 * cont_stats['mean_occupancy'], sw_tps,
+         100 * sw_stats['mean_occupancy'], out['continuous_speedup'],
+         out['continuous_batching']['exact_match']))
     return out
 
 
@@ -1293,6 +1354,8 @@ def _headline(record):
                  row.get('speedup'), (int, float))),
             default=None),
         'decode_jit_speedup': _dig(record, 'decode', 'jitted_speedup'),
+        'decode_continuous_speedup': _dig(record, 'decode',
+                                          'continuous_speedup'),
         'input_pipeline_speedup': _dig(record, 'input_pipeline',
                                        'speedup'),
     }

@@ -53,6 +53,7 @@ from . import inferencer  # noqa
 from .inferencer import Inferencer  # noqa
 from . import serving  # noqa
 from .serving import ModelServer  # noqa
+from . import fleet  # noqa
 from . import debugger  # noqa
 from . import debugger as debuger  # noqa  (reference spelling)
 from . import graphviz  # noqa

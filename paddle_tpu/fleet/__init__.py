@@ -1,0 +1,44 @@
+"""paddle_tpu.fleet — the multi-replica serving tier.
+
+The serving package (PR 2-4) hardens ONE process: shape-bucketed
+micro-batching, circuit breakers, a watchdog, drain/swap. This package
+turns N of those cells into a fleet (SERVING.md "Fleet tier &
+continuous batching"):
+
+- :mod:`~paddle_tpu.fleet.router` — :class:`Router`: load-aware
+  routing over N ModelServer replicas (least ``load_score`` wins),
+  sticky model placement, quarantine of unhealthy replicas,
+  transparent requeue of requests whose replica died under them,
+  rolling zero-downtime ``rolling_swap`` deploys.
+- :mod:`~paddle_tpu.fleet.supervisor` — :class:`ReplicaSupervisor`:
+  the repair loop; restarts dead replicas from the factory and
+  replays model placements.
+- :mod:`~paddle_tpu.fleet.decode` — :class:`DecodeEngine`:
+  continuous (in-flight) batching for autoregressive decode over a
+  slotted KV-cache: new sequences are admitted into a running decode
+  batch at step boundaries and finished slots retire immediately, so
+  occupancy stays high under ragged sequence lengths instead of
+  stop-and-wait batching to the slowest sequence.
+- :mod:`~paddle_tpu.fleet.errors` — typed fleet failures
+  (:class:`NoHealthyReplica`, :class:`RequeueExhausted`), all
+  :class:`~paddle_tpu.serving.errors.ServingError` subclasses.
+
+Gate: ``tools/fleet_bench.py --replicas 3 --smoke`` (replica killed
+mid-load, zero dropped/untyped futures, p99 SLO held, bit-identical
+recovery, continuous decode exact + faster than stop-and-wait).
+"""
+from .errors import FleetError, NoHealthyReplica, RequeueExhausted  # noqa
+from .router import (Router, RoutedRequest, ACTIVE, QUARANTINED,  # noqa
+                     DEPLOYING, RESTARTING, DEAD, STATE_CODES)
+from .supervisor import ReplicaSupervisor  # noqa
+from .decode import (DecodeEngine, DecodeRequest,  # noqa
+                     recurrent_fc_cell, attention_history_cell)
+
+__all__ = [
+    'FleetError', 'NoHealthyReplica', 'RequeueExhausted',
+    'Router', 'RoutedRequest', 'ReplicaSupervisor',
+    'ACTIVE', 'QUARANTINED', 'DEPLOYING', 'RESTARTING', 'DEAD',
+    'STATE_CODES',
+    'DecodeEngine', 'DecodeRequest', 'recurrent_fc_cell',
+    'attention_history_cell',
+]

@@ -1,0 +1,436 @@
+"""Continuous (in-flight) batching for autoregressive decode.
+
+Stop-and-wait batching runs a decode batch to the length of its
+SLOWEST sequence: once occupancy drops (short sequences finish early),
+the remaining steps burn device time on retired rows. The
+:class:`DecodeEngine` keeps occupancy high under ragged sequence
+lengths by batching at the *slot* level instead of the *batch* level:
+
+- a fixed number of **slots** (the compiled batch dim — one XLA
+  program total, compiled once);
+- per-slot **state tensors** (`state_specs`) holding whatever the cell
+  carries between steps — an RNN hidden state, or a slotted KV-cache
+  ``[max_len, ...]`` written at the slot's current position;
+- per-slot **length masks**: the engine threads each slot's position
+  (``pos``) through the step program so an attention cell can mask its
+  KV prefix, and retires a slot the step its sequence finishes;
+- **in-flight admission**: new sequences enter free slots at step
+  boundaries — the running batch never waits for its slowest member.
+
+Exactness contract: the step program must be *row-independent* (no
+cross-slot ops — batch norm or batch-dim reductions would let a
+neighbouring slot's garbage leak in). Every stock layer the serving
+path uses (embedding, fc, activations, softmax over the feature axis,
+matmul) is row-wise, and under that contract a sequence decoded in a
+busy engine is bit-identical to the same sequence decoded alone —
+pinned by ``tests/test_fleet.py``.
+
+``admission='stop_and_wait'`` runs the SAME program with batch-level
+admission (only refill when every slot retired) — the baseline
+``bench.py bench_decode`` and ``tools/fleet_bench.py`` measure the
+continuous engine against.
+"""
+import collections
+import threading
+import time
+
+import numpy as np
+
+from .. import layers
+from .. import observability as _obs
+from .. import unique_name
+from ..core import places as _places
+from ..executor import Executor, Scope
+from ..framework import Program, program_guard
+from ..serving.errors import ServerClosed, ServingError
+
+__all__ = ['DecodeEngine', 'DecodeRequest', 'recurrent_fc_cell',
+           'attention_history_cell']
+
+
+class DecodeRequest(object):
+    """One sequence's future: resolves to the emitted token ids
+    (np.int64 array) once the slot retires."""
+
+    __slots__ = ('init_states', 'first_id', 'max_new_tokens',
+                 'submit_time', '_event', '_tokens', '_error')
+
+    def __init__(self, init_states, first_id, max_new_tokens):
+        self.init_states = init_states
+        self.first_id = first_id
+        self.max_new_tokens = max_new_tokens
+        self.submit_time = time.monotonic()
+        self._event = threading.Event()
+        self._tokens = None
+        self._error = None
+
+    def set_result(self, tokens):
+        self._tokens = tokens
+        self._event.set()
+
+    def set_error(self, error):
+        self._error = error
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                'decode result not ready within %.3fs' % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._tokens
+
+    def latency(self):
+        return time.monotonic() - self.submit_time
+
+
+class _Slot(object):
+    __slots__ = ('req', 'tokens')
+
+    def __init__(self, req):
+        self.req = req
+        self.tokens = []
+
+
+class DecodeEngine(object):
+    """Slotted continuous-batching greedy decoder over one compiled
+    step program.
+
+    Parameters
+    ----------
+    cell_fn : callable
+        ``cell_fn(pre_ids, states, pos) -> (probs, new_states)``.
+        Builds fluid ops for ONE decode step at batch dim ``slots``:
+        ``pre_ids`` [S, 1] int64 (previous token per slot), ``states``
+        a dict name -> Variable per ``state_specs``, ``pos`` [S, 1]
+        int64 (tokens already emitted by the slot — the per-slot
+        length a KV-cache cell masks with). ``probs`` [S, V] next-token
+        scores (greedy argmax picks the token); ``new_states`` must
+        cover every spec. Must be row-independent (see module doc).
+    state_specs : sequence of (name, shape[, dtype]) tuples
+        Per-slot state tensors. A shape like ``[max_len, d]`` is a
+        slotted KV-cache; ``[d]`` an RNN hidden state.
+    slots : int
+        Compiled batch dim — the fixed slot count (one bucket).
+    max_len : int
+        Hard per-sequence emission cap (and the KV-cache extent).
+    end_id : int or None
+        Token that retires a slot early; None decodes to the
+        per-request ``max_new_tokens`` only.
+    admission : 'continuous' | 'stop_and_wait'
+        Continuous admits into free slots every step boundary;
+        stop_and_wait only refills once EVERY slot retired (the
+        baseline policy).
+    """
+
+    def __init__(self, cell_fn, state_specs, slots=8, max_len=64,
+                 end_id=None, init_id=1, place=None, partitioner=None,
+                 seed=0, admission='continuous'):
+        if admission not in ('continuous', 'stop_and_wait'):
+            raise ValueError("admission must be 'continuous' or "
+                             "'stop_and_wait', got %r" % admission)
+        if slots < 1:
+            raise ValueError('slots must be >= 1')
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.end_id = end_id
+        self.init_id = int(init_id)
+        self.admission = admission
+        self.place = place or _places.CPUPlace()
+        self.specs = []
+        for spec in state_specs:
+            name, shape = spec[0], tuple(int(d) for d in spec[1])
+            dtype = spec[2] if len(spec) > 2 else 'float32'
+            self.specs.append((name, shape, dtype))
+        self.executor = Executor(self.place, partitioner=partitioner)
+        self.scope = Scope()
+        self._build(cell_fn, seed)
+        # host-side slot tensors (worker-thread owned after start)
+        S = self.slots
+        self._ids = np.full((S, 1), self.init_id, dtype=np.int64)
+        self._pos = np.zeros((S, 1), dtype=np.int64)
+        self._states = {
+            name: np.zeros((S,) + shape, dtype=dtype)
+            for name, shape, dtype in self.specs}
+        self._table = [None] * S          # slot index -> _Slot | None
+        self._pending = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        # stats (worker-thread only; snapshot via stats())
+        self._steps = 0
+        self._slot_steps = 0              # sum of live slots over steps
+        self._tokens_out = 0
+        self._admitted = 0
+        self._retired = 0
+        reg = _obs.default_registry()
+        self._g_occ = reg.gauge(
+            'decode_slot_occupancy',
+            'live fraction of the decode engine slot table')
+        self._worker = threading.Thread(target=self._loop,
+                                        name='decode-engine', daemon=True)
+        self._worker.start()
+
+    # ---- program construction --------------------------------------------
+    def _build(self, cell_fn, seed):
+        self._main, self._startup = Program(), Program()
+        self._startup.random_seed = seed
+        with program_guard(self._main, self._startup):
+            with unique_name.guard():
+                ids = layers.data(name='dec_ids', shape=[1],
+                                  dtype='int64')
+                pos = layers.data(name='dec_pos', shape=[1],
+                                  dtype='int64')
+                states = {}
+                for name, shape, dtype in self.specs:
+                    states[name] = layers.data(
+                        name='dec_state_%s' % name, shape=list(shape),
+                        dtype=dtype)
+                probs, new_states = cell_fn(ids, states, pos)
+                missing = [n for n, _, _ in self.specs
+                           if n not in (new_states or {})]
+                if missing:
+                    raise ValueError(
+                        'cell_fn must return a new state for every '
+                        'spec; missing %s' % missing)
+                _, next_ids = layers.topk(probs, k=1)
+        self._fetch = [next_ids] + [new_states[n]
+                                    for n, _, _ in self.specs]
+        self.executor.run(self._startup, scope=self.scope)
+
+    # ---- client surface --------------------------------------------------
+    def submit(self, init_states=None, max_new_tokens=None,
+               first_id=None):
+        """Enqueue one sequence; returns a :class:`DecodeRequest`.
+        ``init_states`` maps state name -> per-slot-shaped array
+        (missing states start as zeros); ``max_new_tokens`` caps this
+        sequence's emission (default: the engine's ``max_len``)."""
+        mnt = self.max_len if max_new_tokens is None \
+            else int(max_new_tokens)
+        if not 1 <= mnt <= self.max_len:
+            raise ValueError('max_new_tokens must be in [1, %d], got %d'
+                             % (self.max_len, mnt))
+        inits = {}
+        for name, shape, dtype in self.specs:
+            if init_states and name in init_states:
+                arr = np.asarray(init_states[name]).astype(
+                    dtype, copy=False)
+                if arr.shape != shape:
+                    raise ValueError(
+                        'init state %r has shape %s, spec wants %s'
+                        % (name, arr.shape, shape))
+                inits[name] = arr
+        unknown = set(init_states or ()) - {n for n, _, _ in self.specs}
+        if unknown:
+            raise ValueError('unknown init states %s' % sorted(unknown))
+        req = DecodeRequest(inits,
+                            self.init_id if first_id is None
+                            else int(first_id), mnt)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed('decode engine is shut down')
+            self._pending.append(req)
+            self._cond.notify()
+        return req
+
+    def decode(self, init_states=None, max_new_tokens=None,
+               first_id=None, timeout=60.0):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(init_states, max_new_tokens,
+                           first_id).result(timeout=timeout)
+
+    def stats(self):
+        with self._cond:
+            steps = self._steps
+            return {
+                'slots': self.slots,
+                'steps': steps,
+                'slot_steps': self._slot_steps,
+                'tokens': self._tokens_out,
+                'admitted': self._admitted,
+                'retired': self._retired,
+                'pending': len(self._pending),
+                'live': sum(1 for s in self._table if s is not None),
+                'mean_occupancy': (self._slot_steps /
+                                   (steps * self.slots)) if steps
+                else 0.0,
+            }
+
+    def close(self, drain=True, timeout=60.0):
+        """Shut down the engine. ``drain=True`` finishes every pending
+        and in-flight sequence first; ``drain=False`` fails them with
+        typed :class:`ServerClosed`."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                failed = list(self._pending)
+                self._pending.clear()
+                failed.extend(s.req for s in self._table
+                              if s is not None)
+                self._table = [None] * self.slots
+                for req in failed:
+                    req.set_error(ServerClosed(
+                        'decode engine closed before the sequence '
+                        'finished'))
+            self._cond.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ---- engine loop (worker thread) -------------------------------------
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._closed and not self._pending and \
+                        all(s is None for s in self._table):
+                    self._cond.wait(0.05)
+                if self._closed and not self._pending and \
+                        all(s is None for s in self._table):
+                    return
+            try:
+                with self._cond:
+                    admitted = self._admit_locked()
+                self._step(admitted)
+            except Exception as e:  # noqa: BLE001 — engine must not die
+                # silently: fail every in-flight/pending future typed.
+                with self._cond:
+                    failed = [s.req for s in self._table
+                              if s is not None]
+                    self._table = [None] * self.slots
+                    failed.extend(self._pending)
+                    self._pending.clear()
+                err = e if isinstance(e, ServingError) else \
+                    ServingError('decode step failed: %r' % (e,))
+                for req in failed:
+                    req.set_error(err)
+
+    def _admit_locked(self):
+        """Move pending requests into free slots (caller holds the
+        cond). Continuous mode refills any free slot; stop_and_wait
+        only refills a fully-retired table."""
+        if self.admission == 'stop_and_wait' and \
+                any(s is not None for s in self._table):
+            return 0
+        admitted = 0
+        for i in range(self.slots):
+            if not self._pending:
+                break
+            if self._table[i] is not None:
+                continue
+            req = self._pending.popleft()
+            self._table[i] = _Slot(req)
+            self._ids[i, 0] = req.first_id
+            self._pos[i, 0] = 0
+            for name, shape, dtype in self.specs:
+                init = req.init_states.get(name)
+                self._states[name][i] = init if init is not None \
+                    else np.zeros(shape, dtype=dtype)
+            admitted += 1
+        self._admitted += admitted
+        return admitted
+
+    def _step(self, admitted):
+        live = [i for i, s in enumerate(self._table) if s is not None]
+        if not live:
+            return
+        feed = {'dec_ids': self._ids, 'dec_pos': self._pos}
+        for name, _, _ in self.specs:
+            feed['dec_state_%s' % name] = self._states[name]
+        outs = self.executor.run(self._main, feed=feed,
+                                 fetch_list=self._fetch,
+                                 scope=self.scope)
+        next_ids = np.asarray(outs[0]).reshape(self.slots, -1)
+        for (name, _, _), out in zip(self.specs, outs[1:]):
+            # copy: fetches can be read-only views of device buffers,
+            # and admit() writes slot rows in place
+            self._states[name] = np.array(out)
+        retired = 0
+        for i in live:
+            slot = self._table[i]
+            tok = int(next_ids[i, 0])
+            slot.tokens.append(tok)
+            self._pos[i, 0] += 1
+            self._tokens_out += 1
+            done = len(slot.tokens) >= slot.req.max_new_tokens or \
+                (self.end_id is not None and tok == self.end_id)
+            if done:
+                self._table[i] = None
+                retired += 1
+                slot.req.set_result(
+                    np.asarray(slot.tokens, dtype=np.int64))
+            else:
+                self._ids[i, 0] = tok
+        self._steps += 1
+        self._slot_steps += len(live)
+        self._retired += retired
+        occupancy = len(live) / float(self.slots)
+        self._g_occ.set(occupancy)
+        _obs.emit('decode', step=self._steps, live=len(live),
+                  admitted=admitted, retired=retired,
+                  occupancy=round(occupancy, 4))
+
+
+# ---- stock cells ---------------------------------------------------------
+def recurrent_fc_cell(dict_size, word_dim=32, hidden=32):
+    """A row-wise GRU-flavoured cell: embed the previous token, mix it
+    with the hidden state through one fc, project to the vocabulary.
+    State spec: ``[('h', [hidden])]``."""
+    def cell(pre_ids, states, pos):
+        emb = layers.embedding(input=pre_ids, size=[dict_size, word_dim])
+        emb = layers.reshape(emb, shape=[-1, word_dim])
+        h = layers.fc(input=layers.concat([states['h'], emb], axis=-1),
+                      size=hidden, act='tanh')
+        probs = layers.fc(input=h, size=dict_size, act='softmax')
+        return probs, {'h': h}
+    return cell, [('h', [hidden])]
+
+
+def attention_history_cell(dict_size, word_dim=32, hidden=32,
+                           max_len=64):
+    """A slotted-KV-cache cell: every step writes the current token
+    embedding into its slot's ``kv`` cache at position ``pos`` (one-hot
+    outer product — pure row-wise ops) and attends over the valid
+    prefix with a per-slot length ``mask`` that is itself engine state.
+    State specs: ``[('kv', [max_len, word_dim]), ('mask', [max_len]),
+    ('h', [hidden])]``."""
+    def cell(pre_ids, states, pos):
+        kv, mask, h = states['kv'], states['mask'], states['h']
+        emb = layers.embedding(input=pre_ids, size=[dict_size, word_dim])
+        emb = layers.reshape(emb, shape=[-1, word_dim])
+        # write emb into kv[pos] : one_hot(pos) [S, L] (x) emb [S, D]
+        onehot = layers.one_hot(pos, depth=max_len)           # [S, L]
+        write = layers.matmul(
+            layers.reshape(onehot, shape=[-1, max_len, 1]),
+            layers.reshape(emb, shape=[-1, 1, word_dim]))     # [S, L, D]
+        kv = layers.elementwise_add(kv, write)
+        mask = layers.elementwise_add(mask, onehot)           # len mask
+        # attend the updated prefix with a query from (h, emb)
+        query = layers.fc(input=layers.concat([h, emb], axis=-1),
+                          size=word_dim, act='tanh')          # [S, D]
+        scores = layers.reshape(
+            layers.matmul(kv, layers.reshape(
+                query, shape=[-1, word_dim, 1])),
+            shape=[-1, max_len])                              # [S, L]
+        # invalid positions (mask==0) get -1e9 before the softmax
+        scores = layers.elementwise_add(
+            scores, layers.scale(mask, scale=1e9, bias=-1e9))
+        attn = layers.softmax(scores)
+        ctx = layers.reshape(
+            layers.matmul(layers.reshape(attn, shape=[-1, 1, max_len]),
+                          kv),
+            shape=[-1, word_dim])                             # [S, D]
+        h = layers.fc(input=layers.concat([h, ctx], axis=-1),
+                      size=hidden, act='tanh')
+        probs = layers.fc(input=h, size=dict_size, act='softmax')
+        return probs, {'kv': kv, 'mask': mask, 'h': h}
+    return cell, [('kv', [max_len, word_dim]), ('mask', [max_len]),
+                  ('h', [hidden])]

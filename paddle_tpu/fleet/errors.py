@@ -1,0 +1,33 @@
+"""Fleet-tier error taxonomy.
+
+Extends the serving taxonomy (:mod:`paddle_tpu.serving.errors`): every
+router-level failure a client can observe is a :class:`FleetError`,
+which is itself a :class:`~paddle_tpu.serving.errors.ServingError` so
+existing ``except ServingError`` client code keeps catching typed
+failures when it moves from one server to a fleet.
+"""
+from ..serving.errors import ServingError
+
+__all__ = ['FleetError', 'NoHealthyReplica', 'RequeueExhausted']
+
+
+class FleetError(ServingError):
+    """Base class for router/fleet-level errors."""
+
+
+class NoHealthyReplica(FleetError):
+    """Every replica placed for the model is quarantined, dead or
+    draining — the router has nowhere to send the request. Clients
+    should back off; the supervisor is restarting/probing replicas in
+    the background."""
+
+
+class RequeueExhausted(FleetError):
+    """The request failed on a replica with a requeueable (replica
+    infrastructure) error and the router ran out of requeue attempts
+    or alternative replicas. ``last_error`` carries the final
+    replica-side failure."""
+
+    def __init__(self, message, last_error=None):
+        super(RequeueExhausted, self).__init__(message)
+        self.last_error = last_error

@@ -1,0 +1,670 @@
+"""Replica router: one front-end spreading requests over N ModelServer
+replicas.
+
+The PR 2-4 ``ModelServer`` is the *cell* — micro-batching, breakers,
+watchdog, drain/swap inside one process. The :class:`Router` is the
+*fleet* layer over N such cells:
+
+- **load-aware routing**: every submit ranks the model's placed
+  replicas by :meth:`ModelServer.load_score` (one-lock queue-depth +
+  breaker snapshot) and picks the least loaded; an open breaker,
+  wedged worker or closed server scores ``inf`` and is never picked;
+- **quarantine**: replicas whose health degrades (open breaker, wedged
+  worker) are pulled out of the routing set by the supervisor and
+  restored once healthy — a half-open breaker keeps receiving (deprioritized)
+  traffic so its probes can re-close it;
+- **sticky placement**: each model is placed on a deterministic ring
+  of ``replication`` replicas keyed by the model name, and a request
+  carrying ``sticky_key`` prefers the same replica every time (cache
+  affinity) while still failing over when it is unhealthy;
+- **requeue on replica failure**: a request whose replica dies under
+  it (``ServerClosed``/``WatchdogTimeout``) is transparently requeued
+  onto another replica inside :meth:`RoutedRequest.result` — the
+  client future never resolves untyped and never silently drops;
+- **rolling deploys**: :meth:`rolling_swap` walks the placed replicas
+  one at a time (the rest keep serving), swapping each via the
+  server's atomic ``swap_model`` and rolling already-swapped replicas
+  back if a later one rejects the artifact;
+- **supervised restarts**: the :class:`~paddle_tpu.fleet.supervisor.
+  ReplicaSupervisor` polls health and rebuilds dead replicas from the
+  ``factory``, replaying every recorded model placement.
+
+Telemetry (OBSERVABILITY.md): ``fleet_replica_state{replica=}`` gauge
+(0 active / 1 quarantined / 2 deploying / 3 restarting / 4 dead),
+``router_routed_total{replica=}`` / ``router_requeued_total``
+counters, and ``fleet`` journal events for every state transition,
+requeue, swap, drain, kill and restart.
+"""
+import logging
+import threading
+import time
+import zlib
+
+from .. import observability as _obs
+from ..serving.errors import (DeadlineExceeded, ModelNotFound,
+                              ServerClosed, ServerOverloaded,
+                              ServingError, WatchdogTimeout)
+from .errors import NoHealthyReplica, RequeueExhausted
+
+__all__ = ['Router', 'RoutedRequest', 'ACTIVE', 'QUARANTINED',
+           'DEPLOYING', 'RESTARTING', 'DEAD', 'STATE_CODES']
+
+logger = logging.getLogger('paddle_tpu.fleet')
+
+ACTIVE = 'active'
+QUARANTINED = 'quarantined'
+DEPLOYING = 'deploying'
+RESTARTING = 'restarting'
+DEAD = 'dead'
+STATE_CODES = {ACTIVE: 0, QUARANTINED: 1, DEPLOYING: 2, RESTARTING: 3,
+               DEAD: 4}
+
+# replica-infrastructure failures: the replica (not the request) is at
+# fault, so the router retries the SAME request elsewhere. Model-level
+# errors (bad feed, deadline, model bug) propagate to the client.
+REQUEUEABLE = (ServerClosed, WatchdogTimeout)
+
+
+def _ring_hash(key):
+    return zlib.crc32(str(key).encode('utf-8')) & 0xffffffff
+
+
+class _Replica(object):
+    __slots__ = ('id', 'server', 'state', 'generation', 'restarts',
+                 'unhealthy_polls')
+
+    def __init__(self, rid, server):
+        self.id = rid
+        self.server = server
+        self.state = ACTIVE
+        self.generation = 0
+        self.restarts = 0
+        self.unhealthy_polls = 0
+
+
+class RoutedRequest(object):
+    """A fleet-level future. ``result()`` waits on the replica-side
+    future and transparently requeues onto another replica when the
+    one it was routed to fails the request with a replica-infra error
+    — bounded by ``router.max_requeues`` and the original deadline, so
+    it always resolves (value or typed error), never hangs past its
+    timeout, and never surfaces an untyped drop."""
+
+    __slots__ = ('model', 'sticky_key', 'replicas_tried', 'requeues',
+                 '_router', '_feeds', '_deadline_abs', '_req')
+
+    def __init__(self, router, model, feeds, deadline_abs, req,
+                 replica_id, sticky_key=None):
+        self._router = router
+        self.model = model
+        self._feeds = feeds
+        self._deadline_abs = deadline_abs
+        self._req = req
+        self.replicas_tried = [replica_id]
+        self.requeues = 0
+        self.sticky_key = sticky_key
+
+    @property
+    def replica_id(self):
+        return self.replicas_tried[-1]
+
+    def done(self):
+        return self._req.done()
+
+    def result(self, timeout=30.0):
+        end = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if end is None \
+                else max(0.0, end - time.monotonic())
+            try:
+                return self._req.result(timeout=remaining)
+            except REQUEUEABLE as e:
+                self._router._note_replica_error(self.replica_id, e)
+                if self.requeues >= self._router.max_requeues:
+                    raise RequeueExhausted(
+                        'request for model %r failed on %d replica(s) '
+                        '(%s requeues exhausted): %r'
+                        % (self.model, len(self.replicas_tried),
+                           self.requeues, e), last_error=e)
+                self._requeue(e, end)
+
+    def _remaining_deadline(self):
+        if self._deadline_abs is None:
+            return None
+        left = self._deadline_abs - time.monotonic()
+        if left <= 0:
+            raise DeadlineExceeded(
+                'deadline passed while requeueing after a replica '
+                'failure')
+        return left
+
+    def _requeue(self, cause, end):
+        router = self._router
+        router._m_requeued.inc()
+        _obs.emit('fleet', action='requeue', model=self.model,
+                  replica=self.replica_id)
+        give_up = time.monotonic() + router.requeue_wait
+        if end is not None:
+            give_up = min(give_up, end)
+        last = cause
+        while True:
+            try:
+                req, rid = router._submit_once(
+                    self.model, self._feeds, self._remaining_deadline(),
+                    self.sticky_key, excluded={self.replica_id})
+            except (NoHealthyReplica, ServerOverloaded) as e:
+                last = e
+                if time.monotonic() >= give_up:
+                    raise RequeueExhausted(
+                        'no replica accepted the requeued request for '
+                        'model %r: %r' % (self.model, last),
+                        last_error=cause)
+                time.sleep(min(0.02, router.poll_interval))
+            else:
+                self.requeues += 1
+                self.replicas_tried.append(rid)
+                self._req = req
+                return
+
+
+class Router(object):
+    """Front-end over N ModelServer replicas.
+
+    Parameters
+    ----------
+    factory : callable
+        ``factory(replica_id) -> ModelServer``; also used by the
+        supervisor to rebuild dead replicas. Give each replica its own
+        Partitioner here to shard replicas over disjoint device groups
+        (:func:`paddle_tpu.partition.dp_partitioners`).
+    replicas : int
+        Fleet size.
+    replication : int, optional
+        Replicas each model is placed on (default: all). Placement is
+        a deterministic ring keyed by the model name — sticky across
+        restarts and across processes.
+    supervise : bool
+        Start a :class:`ReplicaSupervisor` (health polling, restarts).
+    poll_interval : float
+        Supervisor scan cadence (seconds).
+    max_requeues : int, optional
+        Per-request cap on replica failovers (default:
+        ``2 * replicas``).
+    wedge_restart_after : int
+        Consecutive unhealthy supervisor polls before a quarantined
+        replica is force-restarted instead of waiting it out.
+    """
+
+    def __init__(self, factory, replicas=2, replication=None,
+                 supervise=True, poll_interval=0.2, max_requeues=None,
+                 requeue_wait=5.0, warmup_on_load=True,
+                 wedge_restart_after=20):
+        if replicas < 1:
+            raise ValueError('replicas must be >= 1')
+        if replication is not None and \
+                not 1 <= replication <= replicas:
+            raise ValueError('replication must be in [1, replicas]')
+        self.factory = factory
+        self.replication = replication
+        self.poll_interval = poll_interval
+        self.max_requeues = max_requeues if max_requeues is not None \
+            else 2 * replicas
+        self.requeue_wait = requeue_wait
+        self.warmup_on_load = warmup_on_load
+        self.wedge_restart_after = wedge_restart_after
+        self._lock = threading.RLock()
+        self._placements = {}        # model -> placement record
+        self._closed = False
+        reg = _obs.default_registry()
+        self._m_requeued = reg.counter(
+            'router_requeued_total',
+            'requests requeued onto another replica after a replica '
+            'failure')
+        self._m_routed = {}
+        self._replicas = {}
+        for rid in range(replicas):
+            self._replicas[rid] = _Replica(rid, factory(rid))
+            self._publish_state(rid, ACTIVE)
+        _obs.emit('fleet', action='create', replicas=replicas)
+        self.supervisor = None
+        if supervise:
+            from .supervisor import ReplicaSupervisor
+            self.supervisor = ReplicaSupervisor(
+                self, poll_interval=poll_interval)
+            self.supervisor.start()
+
+    # ---- state bookkeeping -----------------------------------------------
+    def _publish_state(self, rid, state):
+        _obs.default_registry().gauge(
+            'fleet_replica_state',
+            'replica routing state: 0 active / 1 quarantined / '
+            '2 deploying / 3 restarting / 4 dead',
+            replica=str(rid)).set(STATE_CODES[state])
+
+    def _set_state(self, rep, state, reason=''):
+        with self._lock:
+            prev, rep.state = rep.state, state
+        if prev != state:
+            self._publish_state(rep.id, state)
+            _obs.emit('fleet', action=state, replica=rep.id,
+                      reason=reason)
+            logger.info('replica %d: %s -> %s (%s)', rep.id, prev,
+                        state, reason)
+
+    def _routed_counter(self, rid):
+        c = self._m_routed.get(rid)
+        if c is None:
+            c = _obs.default_registry().counter(
+                'router_routed_total',
+                'requests routed to a replica', replica=str(rid))
+            self._m_routed[rid] = c
+        return c
+
+    # ---- placement -------------------------------------------------------
+    def _place_ids(self, name):
+        """Deterministic ring placement: ``replication`` consecutive
+        replica ids starting at hash(name) — the same model name lands
+        on the same replicas every time (sticky placement)."""
+        ids = sorted(self._replicas)
+        k = self.replication or len(ids)
+        start = _ring_hash(name) % len(ids)
+        return [ids[(start + i) % len(ids)] for i in range(k)]
+
+    def load_model(self, name, dirname, model_filename=None,
+                   params_filename=None, warmup=None):
+        """Place + load a ``save_inference_model`` artifact on the
+        model's replica ring. Dead/restarting replicas are skipped —
+        the restart replay loads the recorded artifact into them."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('router is shut down')
+            ids = self._place_ids(name)
+            self._placements[name] = {
+                'kind': 'artifact', 'dirname': dirname,
+                'model_filename': model_filename,
+                'params_filename': params_filename, 'ids': ids,
+                'warmup': self.warmup_on_load if warmup is None
+                else warmup}
+            reps = [self._replicas[rid] for rid in ids]
+        for rep in reps:
+            if rep.state in (DEAD, RESTARTING):
+                continue
+            self._load_into(rep.server, name, self._placements[name])
+        _obs.emit('fleet', action='load', model=name, replicas=ids)
+        return ids
+
+    def register_model(self, name, builder, warmup=None):
+        """Place an in-memory model: ``builder()`` must return a fresh
+        ``(program, feed_names, fetch_vars, scope)`` tuple per call —
+        each replica (and each restart) gets its own scope, because
+        server workers donate their scope's buffers."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('router is shut down')
+            ids = self._place_ids(name)
+            self._placements[name] = {
+                'kind': 'builder', 'builder': builder, 'ids': ids,
+                'warmup': self.warmup_on_load if warmup is None
+                else warmup}
+            reps = [self._replicas[rid] for rid in ids]
+        for rep in reps:
+            if rep.state in (DEAD, RESTARTING):
+                continue
+            self._load_into(rep.server, name, self._placements[name])
+        _obs.emit('fleet', action='load', model=name, replicas=ids)
+        return ids
+
+    def _load_into(self, server, name, rec):
+        if rec['kind'] == 'artifact':
+            server.load_model(name, rec['dirname'],
+                              model_filename=rec['model_filename'],
+                              params_filename=rec['params_filename'])
+        else:
+            program, feed_names, fetch_vars, scope = rec['builder']()
+            server.register_model(name, program, feed_names,
+                                  fetch_vars, scope)
+        if rec['warmup']:
+            server.warmup(name)
+
+    def models(self):
+        with self._lock:
+            return sorted(self._placements)
+
+    def placement(self, name):
+        with self._lock:
+            rec = self._placements.get(name)
+            if rec is None:
+                raise ModelNotFound('no model placed as %r (have: %s)'
+                                    % (name, sorted(self._placements)
+                                       or '-'))
+            return list(rec['ids'])
+
+    def replica(self, rid):
+        with self._lock:
+            return self._replicas[rid]
+
+    # ---- routing ---------------------------------------------------------
+    def _candidates(self, name, excluded=()):
+        """(load_score, replica) pairs for the model's routable
+        replicas, cheapest first. Scores come from the server's
+        one-lock :meth:`load_score` snapshot; ``inf`` (open breaker,
+        wedged, closed) is dropped here so the router can never pick
+        an unroutable replica even before the supervisor quarantines
+        it."""
+        with self._lock:
+            rec = self._placements.get(name)
+            if rec is None:
+                raise ModelNotFound('no model placed as %r (have: %s)'
+                                    % (name, sorted(self._placements)
+                                       or '-'))
+            reps = [self._replicas[rid] for rid in rec['ids']
+                    if rid not in excluded and
+                    self._replicas[rid].state == ACTIVE]
+        scored = []
+        for rep in reps:
+            try:
+                score = rep.server.load_score(name)
+            except Exception:  # noqa: BLE001 — scoring must not throw
+                continue
+            if score != float('inf'):
+                scored.append((score, rep.id, rep))
+        scored.sort(key=lambda t: (t[0], t[1]))
+        return [(s, rep) for s, _, rep in scored]
+
+    def _submit_once(self, name, feeds, deadline, sticky_key,
+                     excluded=()):
+        """One routing decision + submit. Tries candidates cheapest
+        first (sticky preference up front), stepping past replicas
+        that refuse admission. Raises typed: the last ServerOverloaded
+        when every candidate is merely full, NoHealthyReplica when
+        there was nothing to try."""
+        cands = self._candidates(name, excluded=excluded)
+        if sticky_key is not None and len(cands) > 1:
+            with self._lock:
+                ids = self._placements[name]['ids']
+            preferred = ids[_ring_hash(sticky_key) % len(ids)]
+            cands.sort(key=lambda t: (t[1].id != preferred,))
+        overloaded = None
+        for _score, rep in cands:
+            try:
+                req = rep.server.submit(name, feeds, deadline=deadline)
+            except ServerOverloaded as e:
+                overloaded = e
+                continue
+            except ServingError as e:
+                # replica-level refusal (closed/draining/breaker):
+                # note it and keep trying the next candidate
+                self._note_replica_error(rep.id, e)
+                continue
+            self._routed_counter(rep.id).inc()
+            return req, rep.id
+        if overloaded is not None:
+            raise overloaded
+        raise NoHealthyReplica(
+            'model %r: no routable replica (placed on %s)'
+            % (name, self.placement(name)))
+
+    def submit(self, name, feeds, deadline=None, sticky_key=None):
+        """Route one request; returns a :class:`RoutedRequest`.
+        ``deadline`` is relative seconds covering the whole fleet-side
+        lifetime (requeues included). ``sticky_key`` biases routing to
+        a stable replica for that key (cache affinity) without
+        sacrificing failover."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('router is shut down')
+        deadline_abs = None if deadline is None \
+            else time.monotonic() + deadline
+        req, rid = self._submit_once(name, feeds, deadline, sticky_key)
+        return RoutedRequest(self, name, feeds, deadline_abs, req, rid,
+                             sticky_key=sticky_key)
+
+    def infer(self, name, feeds, deadline=None, sticky_key=None,
+              timeout=30.0):
+        """Synchronous convenience: submit + wait (+ requeue)."""
+        return self.submit(name, feeds, deadline=deadline,
+                           sticky_key=sticky_key).result(timeout=timeout)
+
+    # ---- failure handling ------------------------------------------------
+    def _note_replica_error(self, rid, error):
+        """A client or the router observed a replica-level error:
+        re-evaluate that replica's health NOW instead of waiting for
+        the next supervisor poll."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None or rep.state in (DEAD, RESTARTING,
+                                            DEPLOYING):
+                return
+        self.check_replica(rep)
+
+    def check_replica(self, rep):
+        """One health evaluation pass (also the supervisor's): marks
+        the replica DEAD (closed server / dead worker), QUARANTINED
+        (open breaker / wedged worker) or restores it to ACTIVE."""
+        try:
+            health = rep.server.health()
+        except Exception as e:  # noqa: BLE001 — a throwing health()
+            # check means the replica is gone for routing purposes
+            self._set_state(rep, DEAD, reason='health() raised %r' % e)
+            return DEAD
+        if health['status'] == 'closed':
+            self._set_state(rep, DEAD, reason='server closed')
+            return DEAD
+        models = health['models']
+        if any(not m['worker_alive'] for m in models.values()
+               if m['state'] != 'draining'):
+            self._set_state(rep, DEAD, reason='worker thread dead')
+            return DEAD
+        unhealthy = [n for n, m in models.items()
+                     if m['state'] == 'open' or m['wedged']]
+        with self._lock:
+            state = rep.state
+        if unhealthy:
+            rep.unhealthy_polls += 1
+            if rep.unhealthy_polls >= self.wedge_restart_after and \
+                    any(models[n]['wedged'] for n in unhealthy):
+                self._set_state(
+                    rep, DEAD,
+                    reason='wedged for %d polls, forcing restart'
+                    % rep.unhealthy_polls)
+                return DEAD
+            if state == ACTIVE:
+                self._set_state(rep, QUARANTINED,
+                                reason='unhealthy models: %s'
+                                % sorted(unhealthy))
+            return QUARANTINED
+        rep.unhealthy_polls = 0
+        if state == QUARANTINED:
+            self._set_state(rep, ACTIVE, reason='healthy again')
+        return ACTIVE
+
+    def restart_replica(self, rid):
+        """Rebuild a dead replica from the factory and replay every
+        model placed on it (the supervisor's repair path; also a
+        manual ops hook). The old server is closed with a short bound
+        first so a wedged worker cannot hold the restart hostage."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('router is shut down')
+            rep = self._replicas[rid]
+            if rep.state == RESTARTING:
+                return rep
+            old_server = rep.server
+            placements = {name: dict(rec)
+                          for name, rec in self._placements.items()
+                          if rid in rec['ids']}
+        self._set_state(rep, RESTARTING)
+        t0 = time.monotonic()
+        try:
+            try:
+                old_server.close(timeout=1.0)
+            except Exception:  # noqa: BLE001 — already-broken server
+                pass
+            server = self.factory(rid)
+            for name, rec in sorted(placements.items()):
+                self._load_into(server, name, rec)
+            with self._lock:
+                rep.server = server
+                rep.generation += 1
+                rep.restarts += 1
+            self._set_state(rep, ACTIVE, reason='restarted')
+            _obs.emit('fleet', action='restart', replica=rid,
+                      models=sorted(placements),
+                      dur_s=round(time.monotonic() - t0, 6))
+            return rep
+        except Exception as e:
+            self._set_state(rep, DEAD, reason='restart failed: %r' % e)
+            raise
+
+    def kill_replica(self, rid, abrupt=True):
+        """Ops/chaos hook: take a replica down. ``abrupt=True`` models
+        a crash — in-flight and queued futures fail typed
+        (ServerClosed) and clients requeue; the supervisor restarts
+        it."""
+        with self._lock:
+            rep = self._replicas[rid]
+        _obs.emit('fleet', action='kill', replica=rid, abrupt=abrupt)
+        try:
+            rep.server.close(timeout=0.0 if abrupt else 30.0)
+        finally:
+            self._set_state(rep, DEAD, reason='killed')
+        return rep
+
+    # ---- fleet-wide ops --------------------------------------------------
+    def rolling_swap(self, name, dirname, model_filename=None,
+                     params_filename=None, warmup=None):
+        """Zero-downtime deploy: swap the model's replicas one at a
+        time — each replica is pulled from routing only for its own
+        swap while the rest keep serving, and the server-side
+        ``swap_model`` keeps even that replica's queue intact. A
+        rejected artifact (validation failure) rolls already-swapped
+        replicas back to the previous artifact so the fleet converges
+        on ONE version either way. The placement record is updated
+        first, so a replica restarting mid-deploy comes back on the
+        new artifact."""
+        with self._lock:
+            rec = self._placements.get(name)
+            if rec is None:
+                raise ModelNotFound('no model placed as %r' % name)
+            if rec['kind'] != 'artifact':
+                raise ValueError(
+                    'rolling_swap needs a disk artifact; model %r was '
+                    'registered in-memory' % name)
+            old = dict(rec)
+            rec.update(dirname=dirname, model_filename=model_filename,
+                       params_filename=params_filename)
+            ids = list(rec['ids'])
+            do_warmup = rec['warmup'] if warmup is None else warmup
+        swapped = []
+        for rid in ids:
+            with self._lock:
+                rep = self._replicas[rid]
+                prev_state = rep.state
+            if prev_state in (DEAD, RESTARTING):
+                continue      # restart replay already uses the record
+            self._set_state(rep, DEPLOYING, reason='rolling swap')
+            t0 = time.monotonic()
+            try:
+                rep.server.swap_model(
+                    name, dirname, model_filename=model_filename,
+                    params_filename=params_filename)
+                if do_warmup:
+                    rep.server.warmup(name)
+            except Exception:
+                self._set_state(rep, prev_state,
+                                reason='swap failed, rolled back')
+                with self._lock:
+                    self._placements[name] = old
+                for back in swapped:
+                    try:
+                        self._replicas[back].server.swap_model(
+                            name, old['dirname'],
+                            model_filename=old['model_filename'],
+                            params_filename=old['params_filename'])
+                    except Exception:  # noqa: BLE001 — best effort;
+                        logger.exception(
+                            'rollback of replica %d failed', back)
+                raise
+            self._set_state(rep, prev_state, reason='swap complete')
+            swapped.append(rid)
+            _obs.emit('fleet', action='swap', model=name, replica=rid,
+                      dirname=dirname,
+                      dur_s=round(time.monotonic() - t0, 6))
+        return swapped
+
+    def drain(self, name, timeout=None):
+        """Rolling fleet-wide drain: complete each replica's queue for
+        the model, unload it everywhere, forget the placement."""
+        ids = self.placement(name)
+        for rid in ids:
+            with self._lock:
+                rep = self._replicas[rid]
+            if rep.state in (DEAD, RESTARTING):
+                continue
+            try:
+                rep.server.drain(name, timeout=timeout)
+            except ModelNotFound:
+                pass
+            _obs.emit('fleet', action='drain', model=name, replica=rid)
+        with self._lock:
+            self._placements.pop(name, None)
+        return ids
+
+    # ---- introspection ---------------------------------------------------
+    def health(self):
+        """Fleet-wide readiness: router status, per-replica state +
+        the replica's own ``health()`` document, model placements."""
+        with self._lock:
+            closed = self._closed
+            reps = dict(self._replicas)
+            placements = {name: list(rec['ids'])
+                          for name, rec in self._placements.items()}
+        replicas = {}
+        for rid, rep in sorted(reps.items()):
+            entry = {'state': rep.state, 'generation': rep.generation,
+                     'restarts': rep.restarts}
+            if rep.state not in (DEAD, RESTARTING):
+                try:
+                    entry['server'] = rep.server.health()
+                except Exception as e:  # noqa: BLE001 — report, not die
+                    entry['server_error'] = repr(e)
+            replicas[rid] = entry
+        active = sum(1 for r in replicas.values()
+                     if r['state'] == ACTIVE)
+        return {'status': 'closed' if closed else
+                ('serving' if active else 'unavailable'),
+                'active_replicas': active,
+                'replicas': replicas,
+                'placements': placements}
+
+    def stats(self):
+        with self._lock:
+            return {
+                'replicas': {rid: {'state': rep.state,
+                                   'generation': rep.generation,
+                                   'restarts': rep.restarts}
+                             for rid, rep in self._replicas.items()},
+                'models': sorted(self._placements),
+            }
+
+    def close(self, timeout=30.0):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            reps = list(self._replicas.values())
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        for rep in reps:
+            try:
+                rep.server.close(timeout=timeout)
+            except Exception:  # noqa: BLE001 — close everything
+                logger.exception('closing replica %d failed', rep.id)
+        _obs.emit('fleet', action='close')
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

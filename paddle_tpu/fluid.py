@@ -38,6 +38,7 @@ from .resilience import AnomalyGuard, AnomalyError  # noqa
 from .inferencer import Inferencer  # noqa
 from . import serving  # noqa
 from .serving import ModelServer  # noqa
+from . import fleet  # noqa
 from . import debugger  # noqa
 from . import debugger as debuger  # noqa
 from . import memory  # noqa

@@ -10,10 +10,10 @@ fallback: plain ``jax.jit``, bit-identical to the classic executor.
 """
 from .partitioner import (Partitioner, pjit_with_cpu_fallback,  # noqa
                           with_sharding_constraint, mesh_axis_extent,
-                          first_divisible_dim)
+                          first_divisible_dim, dp_partitioners)
 from .rules import (AxisNames, standard_logical_axis_rules)  # noqa
 
 __all__ = ['Partitioner', 'pjit_with_cpu_fallback',
            'with_sharding_constraint', 'mesh_axis_extent',
-           'first_divisible_dim', 'AxisNames',
+           'first_divisible_dim', 'dp_partitioners', 'AxisNames',
            'standard_logical_axis_rules']

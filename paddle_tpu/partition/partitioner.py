@@ -60,6 +60,28 @@ def first_divisible_dim(shape, extent):
     return None
 
 
+def dp_partitioners(replicas, devices_each=1, devices=None):
+    """Carve the visible devices into ``replicas`` DISJOINT dp meshes
+    of ``devices_each`` devices and return one :class:`Partitioner`
+    per group — the fleet tier's placement primitive: N ModelServer
+    replicas behind one Router each get their own sub-mesh, so a
+    replica's sharded batches never contend with a neighbour's
+    devices and a replica restart re-lands on the same group
+    (SERVING.md "Fleet tier & continuous batching")."""
+    from jax.sharding import Mesh
+    devs = list(devices if devices is not None else jax.devices())
+    need = replicas * devices_each
+    if len(devs) < need:
+        raise ValueError(
+            '%d replica(s) x %d device(s) need %d devices but only %d '
+            'are visible' % (replicas, devices_each, need, len(devs)))
+    return [
+        Partitioner(mesh=Mesh(
+            np.asarray(devs[i * devices_each:(i + 1) * devices_each]),
+            ('dp',)))
+        for i in range(replicas)]
+
+
 def pjit_with_cpu_fallback(fun, in_shardings=None, out_shardings=None,
                            donate_argnums=(), mesh=None):
     """jit wrapper with the T5X fallback: a single-device (or absent)

@@ -421,38 +421,73 @@ class ModelServer(object):
         ``open`` (breaker open: admission sheds), ``draining`` (drain
         in progress). The same signal feeds the
         ``serving_breaker_state`` / ``serving_watchdog_trips_total``
-        metrics, so a scraper and this call never disagree."""
+        metrics, so a scraper and this call never disagree.
+
+        The whole per-model row — queue depth, breaker state, wedged
+        flag — is read under ONE server-lock pass (the breaker and
+        batcher locks are leaves acquired inside it), so a router
+        polling ``health()`` never routes on a torn read where the
+        depth belongs to one instant and the breaker to another."""
+        models = {}
+        names = self.registry.names()
         with self._lock:
             closed = self._closed
-            draining = set(self._draining)
-            wedged = set(self._wedged)
-            trip_counts = dict(self._trip_counts)
-            batchers = dict(self._batchers)
-            workers = dict(self._workers)
-        models = {}
-        for name in self.registry.names():
-            breaker = self._breakers.get(name)
-            bstate = breaker.state if breaker is not None else CLOSED
-            if name in draining:
-                state = 'draining'
-            elif bstate == OPEN:
-                state = 'open'
-            elif bstate == HALF_OPEN or name in wedged:
-                state = 'degraded'
-            else:
-                state = 'ready'
-            batcher = batchers.get(name)
-            worker = workers.get(name)
-            models[name] = {
-                'state': state,
-                'breaker': bstate,
-                'queue_depth': batcher.depth() if batcher else 0,
-                'worker_alive': bool(worker and worker.is_alive()),
-                'wedged': name in wedged,
-                'watchdog_trips': trip_counts.get(name, 0),
-            }
+            for name in names:
+                breaker = self._breakers.get(name)
+                bstate = breaker.state if breaker is not None else CLOSED
+                if name in self._draining:
+                    state = 'draining'
+                elif bstate == OPEN:
+                    state = 'open'
+                elif bstate == HALF_OPEN or name in self._wedged:
+                    state = 'degraded'
+                else:
+                    state = 'ready'
+                batcher = self._batchers.get(name)
+                worker = self._workers.get(name)
+                models[name] = {
+                    'state': state,
+                    'breaker': bstate,
+                    'queue_depth': batcher.depth() if batcher else 0,
+                    'worker_alive': bool(worker and worker.is_alive()),
+                    'wedged': name in self._wedged,
+                    'watchdog_trips': self._trip_counts.get(name, 0),
+                }
         return {'status': 'closed' if closed else 'serving',
                 'models': models}
+
+    def load_score(self, model_name=None):
+        """Cheap routing signal for a fleet front-end: the queued work
+        a new request would sit behind, or ``inf`` when this server
+        should not be routed to at all (closed, model draining or
+        unloaded, breaker open, worker wedged or dead). A half-open
+        breaker adds ``max_queue_depth`` so probing replicas rank
+        behind every healthy one without being unroutable. With
+        ``model_name=None`` the scores of all served models are
+        summed (server-level load). One lock pass, same consistency
+        contract as :meth:`health`."""
+        with self._lock:
+            if self._closed:
+                return float('inf')
+            names = [model_name] if model_name is not None \
+                else list(self._batchers)
+            score = 0.0
+            for name in names:
+                batcher = self._batchers.get(name)
+                if batcher is None or name in self._draining:
+                    return float('inf')
+                worker = self._workers.get(name)
+                if name in self._wedged or \
+                        (worker is not None and not worker.is_alive()):
+                    return float('inf')
+                breaker = self._breakers.get(name)
+                bstate = breaker.state if breaker is not None else CLOSED
+                if bstate == OPEN:
+                    return float('inf')
+                score += batcher.depth()
+                if bstate == HALF_OPEN:
+                    score += self.max_queue_depth
+            return score
 
     # ---- guardrail callbacks ---------------------------------------------
     def _on_breaker_transition(self, name, to_state, reason):

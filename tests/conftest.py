@@ -72,6 +72,12 @@ def pytest_configure(config):
         'sharded serving load (tier-1; filter with -m "not partition")')
     config.addinivalue_line(
         'markers',
+        'fleet: tests of the paddle_tpu.fleet serving tier — replica '
+        'router (load-aware routing, quarantine, requeue, rolling '
+        'swap, supervised restart) and continuous-batching decode '
+        '(tier-1; filter with -m "not fleet")')
+    config.addinivalue_line(
+        'markers',
         'elastic: tests of partition-aware resilience — sharded '
         'checkpoints, topology-portable restore (N-device save -> '
         'M-device resume), SIGTERM preemption safety, mesh-degraded '

@@ -1,0 +1,457 @@
+#!/usr/bin/env python
+"""Scaled-out load generator + chaos gate for the fleet serving tier
+(SERVING.md "Fleet tier & continuous batching").
+
+Two phases, both seeded and deterministic in shape:
+
+1. **Fleet chaos load**: N client threads hammer a ``Router`` over
+   ``--replicas`` ModelServer replicas; one replica is killed abruptly
+   mid-load (in-flight futures fail typed and are transparently
+   requeued by the router) and the supervisor restarts it. Gates:
+
+   - zero dropped or untyped futures — every submitted request
+     resolves with a result or a typed ServingError;
+   - every successful result is bit-identical to a fault-free
+     single-executor reference;
+   - the p99 request latency holds the ``--slo`` bound *through* the
+     kill;
+   - the killed replica comes back (supervisor restart) and serves
+     bit-identical outputs post-recovery.
+
+2. **Continuous-batching decode**: the same ragged sequence set is
+   decoded through a continuous-admission :class:`DecodeEngine` and a
+   stop-and-wait one (identical compiled step program). Gates: tokens
+   bit-identical to each other AND to a per-sequence (one slot at a
+   time) decode; continuous tokens/s beats stop-and-wait.
+
+``--smoke`` runs a short schedule of both phases, writes an
+observability journal and validates it via ``obs_report.py --require
+fleet`` semantics, exiting nonzero if any invariant breaks — the CI
+gate alongside ``chaos_bench.py --smoke`` and
+``serve_bench.py --smoke``.
+
+    python tools/fleet_bench.py --replicas 3            # full run
+    python tools/fleet_bench.py --replicas 3 --smoke    # CI gate
+    python tools/fleet_bench.py --replicas 2 --mesh 2   # sharded
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import numpy as np  # noqa: E402
+
+IN_DIM, OUT_DIM = 16, 4
+
+
+def _force_cpu():
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
+
+def _build_artifact(workdir, seed=7):
+    import paddle_tpu.fluid as fluid
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[IN_DIM],
+                                  dtype='float32')
+            h = fluid.layers.fc(input=x, size=32, act='relu')
+            y = fluid.layers.fc(input=h, size=OUT_DIM, act=None)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = os.path.join(workdir, 'model')
+        fluid.io.save_inference_model(d, ['x'], [y], exe,
+                                      main_program=main)
+    return d
+
+
+def _reference_fn(model_dir):
+    import paddle_tpu.fluid as fluid
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    prog, _, fetch_vars = fluid.io.load_inference_model(
+        model_dir, exe, scope=scope)
+
+    def run(x):
+        out, = exe.run(prog, feed={'x': x}, fetch_list=fetch_vars,
+                       scope=scope)
+        return np.asarray(out)
+    return run
+
+
+def _percentile(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def run_fleet_chaos(replicas=3, n_requests=120, clients=4, max_batch=8,
+                    seed=1, slo_p99=2.5, mesh=1, kill=True):
+    """Phase 1. Returns a result dict with ``problems`` (empty == all
+    invariants held)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fleet import Router
+    from paddle_tpu.serving import ModelServer, ServingError
+
+    problems = []
+    rng = np.random.RandomState(seed)
+    inputs = [rng.randn(int(rng.randint(1, max_batch + 1)),
+                        IN_DIM).astype('float32')
+              for _ in range(n_requests)]
+    partitioners = [None] * replicas
+    if mesh and mesh > 1:
+        from paddle_tpu.partition import dp_partitioners
+        partitioners = dp_partitioners(replicas, mesh)
+
+    with tempfile.TemporaryDirectory(prefix='fleet_bench_') as workdir:
+        artifact = _build_artifact(workdir)
+        reference = _reference_fn(artifact)
+        expected = [reference(x) for x in inputs]
+
+        def factory(rid):
+            return ModelServer(place=fluid.CPUPlace(),
+                               max_batch_size=max_batch,
+                               max_queue_depth=max(64, n_requests),
+                               partitioner=partitioners[rid],
+                               watchdog_poll=0.02)
+
+        router = Router(factory, replicas=replicas, poll_interval=0.05)
+        outcomes = [None] * n_requests
+        latencies = [None] * n_requests
+        kill_at = n_requests // 2
+        submitted = threading.Semaphore(0)
+        t_start = time.monotonic()
+        with router:
+            router.load_model('m', artifact)
+
+            def client(cid):
+                for i in range(cid, n_requests, clients):
+                    t0 = time.monotonic()
+                    give_up = t0 + 30.0
+                    req = None
+                    while req is None:
+                        try:
+                            req = router.submit('m', {'x': inputs[i]})
+                        except ServingError:
+                            if time.monotonic() > give_up:
+                                outcomes[i] = ('stuck', None)
+                                break
+                            time.sleep(0.01)
+                    submitted.release()
+                    if req is None:
+                        continue
+                    try:
+                        out, = req.result(timeout=60.0)
+                        outcomes[i] = ('ok', np.asarray(out))
+                    except ServingError as e:
+                        outcomes[i] = ('typed_error', e)
+                    except Exception as e:  # noqa: BLE001 — judged
+                        outcomes[i] = ('untyped_error', e)
+                    latencies[i] = time.monotonic() - t0
+
+            threads = [threading.Thread(target=client, args=(c,),
+                                        daemon=True)
+                       for c in range(clients)]
+            for t in threads:
+                t.start()
+            victim = None
+            if kill:
+                # wait until half the load is in flight, then yank a
+                # placed replica out from under it
+                for _ in range(kill_at):
+                    submitted.acquire()
+                victim = router.placement('m')[0]
+                router.kill_replica(victim)
+            for t in threads:
+                t.join(120.0)
+            wall = time.monotonic() - t_start
+
+            # post-recovery: the supervisor must bring the victim back
+            recovered_exact = None
+            if victim is not None:
+                give_up = time.monotonic() + 30.0
+                while time.monotonic() < give_up and \
+                        router.replica(victim).state != 'active':
+                    time.sleep(0.05)
+                rep = router.replica(victim)
+                if rep.state != 'active':
+                    problems.append(
+                        'killed replica %d never restarted (state %r)'
+                        % (victim, rep.state))
+                    recovered_exact = False
+                else:
+                    out, = rep.server.infer('m', {'x': inputs[0]},
+                                            timeout=30.0)
+                    recovered_exact = np.array_equal(
+                        np.asarray(out), expected[0])
+                    if not recovered_exact:
+                        problems.append(
+                            'restarted replica %d output differs from '
+                            'the reference' % victim)
+            fleet_stats = router.stats()
+            health = router.health()
+
+        # ---- invariants --------------------------------------------------
+        ok = sum(1 for o in outcomes if o and o[0] == 'ok')
+        typed = sum(1 for o in outcomes if o and o[0] == 'typed_error')
+        untyped = [repr(o[1]) for o in outcomes
+                   if o and o[0] == 'untyped_error']
+        dropped = sum(1 for o in outcomes if o is None) + \
+            sum(1 for o in outcomes if o and o[0] == 'stuck')
+        if untyped:
+            problems.append('untyped client errors: %s' % untyped[:3])
+        if dropped:
+            problems.append('%d request(s) dropped/stuck' % dropped)
+        if typed:
+            # the router requeues replica failures internally; a typed
+            # error surfacing means it ran out of healthy replicas,
+            # which a 1-kill schedule over >=2 replicas must not hit
+            problems.append(
+                '%d request(s) failed typed despite %d surviving '
+                'replica(s)' % (typed, replicas - 1))
+        mismatches = sum(
+            1 for i, o in enumerate(outcomes)
+            if o and o[0] == 'ok' and
+            not np.array_equal(o[1], expected[i]))
+        if mismatches:
+            problems.append(
+                '%d result(s) differ from the fault-free reference'
+                % mismatches)
+        lats = [l for l in latencies if l is not None]
+        p50, p99 = _percentile(lats, 0.50), _percentile(lats, 0.99)
+        if p99 > slo_p99:
+            problems.append(
+                'p99 latency %.3fs exceeds the %.2fs SLO through the '
+                'kill' % (p99, slo_p99))
+
+    requeues = sum(r['restarts'] for r in
+                   fleet_stats['replicas'].values())
+    return {
+        'config': {'replicas': replicas, 'n_requests': n_requests,
+                   'clients': clients, 'max_batch': max_batch,
+                   'seed': seed, 'slo_p99': slo_p99, 'mesh': mesh or 1,
+                   'killed_replica': victim},
+        'outcomes': {'ok': ok, 'typed_errors': typed,
+                     'untyped_errors': len(untyped),
+                     'dropped': dropped,
+                     'recovered_bit_identical': recovered_exact,
+                     'replica_restarts': requeues},
+        'latency': {'p50_s': round(p50, 4), 'p99_s': round(p99, 4),
+                    'max_s': round(max(lats), 4) if lats else 0.0},
+        'throughput_rps': round(len(lats) / wall, 2) if wall else 0.0,
+        'fleet': fleet_stats,
+        'final_status': health['status'],
+        'problems': problems,
+    }
+
+
+def run_decode_phase(slots=8, n_sequences=48, max_len=32, seed=3,
+                     min_speedup=1.0):
+    """Phase 2: continuous vs stop-and-wait decode over one ragged
+    sequence set; exactness + tokens/s gates."""
+    from paddle_tpu.fleet import DecodeEngine, recurrent_fc_cell
+
+    problems = []
+    rng = np.random.RandomState(seed)
+    # heavily ragged: mostly short sequences, a long straggler per
+    # slot-group — the occupancy hole stop-and-wait pays for
+    lengths = [int(rng.randint(1, max_len // 4)) for _ in
+               range(n_sequences)]
+    for i in range(0, n_sequences, slots):
+        lengths[i] = max_len
+    hidden = 32
+    inits = [{'h': rng.randn(hidden).astype('float32')}
+             for _ in range(n_sequences)]
+
+    def run_mode(admission):
+        cell, specs = recurrent_fc_cell(dict_size=200, word_dim=16,
+                                        hidden=hidden)
+        eng = DecodeEngine(cell, specs, slots=slots, max_len=max_len,
+                           end_id=None, seed=seed, admission=admission)
+        eng.decode(init_states=inits[0], max_new_tokens=2)   # warm
+        t0 = time.monotonic()
+        reqs = [eng.submit(init_states=inits[i],
+                           max_new_tokens=lengths[i])
+                for i in range(n_sequences)]
+        outs = [r.result(timeout=300.0) for r in reqs]
+        wall = time.monotonic() - t0
+        stats = eng.stats()
+        eng.close()
+        return outs, wall, stats
+
+    cont, cont_wall, cont_stats = run_mode('continuous')
+    sw, sw_wall, sw_stats = run_mode('stop_and_wait')
+
+    # per-sequence reference: each sequence decoded alone
+    cell, specs = recurrent_fc_cell(dict_size=200, word_dim=16,
+                                    hidden=hidden)
+    with DecodeEngine(cell, specs, slots=slots, max_len=max_len,
+                      end_id=None, seed=seed) as eng:
+        ref = [eng.decode(init_states=inits[i],
+                          max_new_tokens=lengths[i], timeout=300.0)
+               for i in range(n_sequences)]
+
+    if not all(np.array_equal(a, b) for a, b in zip(cont, ref)):
+        problems.append('continuous decode differs from per-sequence '
+                        'decode')
+    if not all(np.array_equal(a, b) for a, b in zip(sw, ref)):
+        problems.append('stop-and-wait decode differs from '
+                        'per-sequence decode')
+    tokens = sum(lengths)
+    cont_tps = tokens / cont_wall if cont_wall else 0.0
+    sw_tps = tokens / sw_wall if sw_wall else 0.0
+    speedup = cont_tps / sw_tps if sw_tps else 0.0
+    if speedup <= min_speedup:
+        problems.append(
+            'continuous decode %.1f tok/s is not faster than '
+            'stop-and-wait %.1f tok/s (speedup %.2fx <= %.2fx) at a '
+            'ragged length distribution'
+            % (cont_tps, sw_tps, speedup, min_speedup))
+    return {
+        'config': {'slots': slots, 'sequences': n_sequences,
+                   'max_len': max_len, 'seed': seed,
+                   'tokens': tokens},
+        'continuous': {'tokens_per_sec': round(cont_tps, 1),
+                       'steps': cont_stats['steps'],
+                       'mean_occupancy':
+                       round(cont_stats['mean_occupancy'], 4)},
+        'stop_and_wait': {'tokens_per_sec': round(sw_tps, 1),
+                          'steps': sw_stats['steps'],
+                          'mean_occupancy':
+                          round(sw_stats['mean_occupancy'], 4)},
+        'speedup': round(speedup, 2),
+        'exact_vs_per_sequence': not problems,
+        'problems': problems,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('--replicas', type=int, default=3)
+    ap.add_argument('--requests', type=int, default=240)
+    ap.add_argument('--clients', type=int, default=4)
+    ap.add_argument('--max-batch', type=int, default=8)
+    ap.add_argument('--seed', type=int, default=1)
+    ap.add_argument('--slo', type=float, default=2.5,
+                    help='p99 request-latency bound (seconds), held '
+                         'through the replica kill')
+    ap.add_argument('--mesh', type=int, default=1,
+                    help='devices per replica: shard each replica '
+                         'over its own disjoint dp mesh')
+    ap.add_argument('--no-kill', action='store_true',
+                    help='skip the chaos kill (pure load run)')
+    ap.add_argument('--no-decode-phase', action='store_true')
+    ap.add_argument('--smoke', action='store_true',
+                    help='short seeded schedule; exit nonzero if any '
+                         'fleet or decode invariant breaks')
+    ap.add_argument('--journal', default=None, metavar='PATH',
+                    help='write an observability run journal here '
+                         '(default under --smoke: a temp file, gated '
+                         'via obs_report --require fleet)')
+    ap.add_argument('--json', default=None,
+                    help='write the full result dict to this path')
+    args = ap.parse_args(argv)
+    if args.replicas < 2 and not args.no_kill:
+        ap.error('--replicas must be >= 2 for the kill phase '
+                 '(use --no-kill)')
+    need = args.replicas * args.mesh
+    if args.mesh > 1 and 'xla_force_host_platform_device_count' not in \
+            os.environ.get('XLA_FLAGS', ''):
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            ' --xla_force_host_platform_device_count=%d' % need).strip()
+    _force_cpu()
+
+    from paddle_tpu import observability
+
+    journal_path = args.journal
+    if args.smoke and journal_path is None:
+        fd, journal_path = tempfile.mkstemp(prefix='fleet_bench_',
+                                            suffix='.jsonl')
+        os.close(fd)
+
+    jctx = observability.journal(journal_path) if journal_path \
+        else None
+    try:
+        if jctx is not None:
+            jctx.__enter__()
+        if args.smoke:
+            fleet = run_fleet_chaos(
+                replicas=args.replicas, n_requests=96,
+                clients=args.clients, max_batch=args.max_batch,
+                seed=args.seed, slo_p99=args.slo, mesh=args.mesh,
+                kill=not args.no_kill)
+            decode = None if args.no_decode_phase else \
+                run_decode_phase(slots=8, n_sequences=32, max_len=24,
+                                 seed=3)
+        else:
+            fleet = run_fleet_chaos(
+                replicas=args.replicas, n_requests=args.requests,
+                clients=args.clients, max_batch=args.max_batch,
+                seed=args.seed, slo_p99=args.slo, mesh=args.mesh,
+                kill=not args.no_kill)
+            decode = None if args.no_decode_phase else \
+                run_decode_phase(slots=8, n_sequences=64, max_len=32,
+                                 seed=3)
+    finally:
+        if jctx is not None:
+            jctx.__exit__(None, None, None)
+
+    problems = list(fleet['problems'])
+    if decode is not None:
+        problems += decode['problems']
+    if journal_path:
+        print('journal written to %s' % journal_path)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from obs_report import check_journal
+        problems += check_journal(journal_path, require='fleet')
+
+    results = {'fleet': fleet, 'decode': decode, 'problems': problems}
+    if args.json:
+        with open(args.json, 'w') as f:
+            json.dump(results, f, indent=2, sort_keys=True,
+                      default=repr)
+
+    o, l = fleet['outcomes'], fleet['latency']
+    print('fleet%s: %d ok, %d typed, %d untyped, %d dropped | '
+          'p50 %.0fms p99 %.0fms | %.1f req/s | restarts %d, '
+          'recovered_bit_identical=%s'
+          % (' (mesh=%d)' % args.mesh if args.mesh > 1 else '',
+             o['ok'], o['typed_errors'], o['untyped_errors'],
+             o['dropped'], l['p50_s'] * 1e3, l['p99_s'] * 1e3,
+             fleet['throughput_rps'], o['replica_restarts'],
+             o['recovered_bit_identical']))
+    if decode is not None:
+        print('decode: continuous %.1f tok/s (occ %.0f%%) vs '
+              'stop-and-wait %.1f tok/s (occ %.0f%%) -> %.2fx, '
+              'exact=%s'
+              % (decode['continuous']['tokens_per_sec'],
+                 100 * decode['continuous']['mean_occupancy'],
+                 decode['stop_and_wait']['tokens_per_sec'],
+                 100 * decode['stop_and_wait']['mean_occupancy'],
+                 decode['speedup'], decode['exact_vs_per_sequence']))
+    if problems:
+        print('FLEET INVARIANTS BROKEN:', file=sys.stderr)
+        for p in problems:
+            print('  - %s' % p, file=sys.stderr)
+        return 1
+    print('fleet OK (kill mid-load held every invariant)')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

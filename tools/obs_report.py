@@ -22,7 +22,9 @@ that must have placed work through the Partitioner (``partition``
 records, PARTITIONING.md); ``--require resilience`` for a run that
 must have exercised preemption saves or topology resharding
 (``preempt_save`` / ``reshard`` records, RESILIENCE.md); ``--require
-any`` for presence only).
+fleet`` for a run through the replica router / continuous-batching
+decode engine (``fleet`` / ``decode`` records, SERVING.md);
+``--require any`` for presence only).
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
 load run writes.
 """
@@ -36,6 +38,10 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # a resilience run must show at least one preemption
                # save OR one topology reshard (RESILIENCE.md)
                'resilience': ('preempt_save', 'reshard'),
+               # a fleet run must show router/replica lifecycle events
+               # OR continuous-batching decode steps (SERVING.md
+               # "Fleet tier & continuous batching")
+               'fleet': ('fleet', 'decode'),
                'any': None}
 
 
@@ -174,6 +180,35 @@ def _partition_summary(by_ev):
     }
 
 
+def _fleet_summary(by_ev):
+    """Fleet SLI (SERVING.md "Fleet tier & continuous batching"):
+    replica lifecycle (quarantines, kills, restarts, swaps) from
+    ``fleet`` events, continuous-batching decode behavior (steps,
+    occupancy, admissions/retirements) from ``decode`` events."""
+    events = by_ev.get('fleet', ())
+    actions = {}
+    for r in events:
+        actions[r.get('action', '?')] = \
+            actions.get(r.get('action', '?'), 0) + 1
+    decode = by_ev.get('decode', ())
+    occ = [r['occupancy'] for r in decode if 'occupancy' in r]
+    return {
+        'events': len(events),
+        'actions': actions,
+        'requeues': actions.get('requeue', 0),
+        'restarts': actions.get('restart', 0),
+        'swaps': actions.get('swap', 0),
+        'decode': {
+            'steps': len(decode),
+            'mean_occupancy': _mean(occ),
+            'min_occupancy': min(occ) if occ else 0.0,
+            'admitted': sum(r.get('admitted', 0) for r in decode),
+            'retired': sum(r.get('retired', 0) for r in decode),
+            'slot_steps': sum(r.get('live', 0) for r in decode),
+        },
+    }
+
+
 def summarize(records, malformed=0):
     """Aggregate a record list into a JSON-ready summary dict."""
     by_ev = {}
@@ -245,6 +280,7 @@ def summarize(records, malformed=0):
         'compiler': _compiler_summary(by_ev),
         'partition': _partition_summary(by_ev),
         'resilience': _resilience_summary(by_ev),
+        'fleet': _fleet_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -354,6 +390,25 @@ def render(summary, top=10):
         for topo, t in sorted(rz.get('topologies', {}).items()):
             lines.append('  reshard %-22s x%d  vars=%d  %.3fs'
                          % (topo, t['count'], t['vars'], t['wall_s']))
+    fl = s.get('fleet') or {}
+    if fl.get('events') or fl.get('decode', {}).get('steps'):
+        if fl.get('events'):
+            lines.append(
+                'fleet:    %d events | %d requeues, %d restarts, '
+                '%d swaps | %s'
+                % (fl['events'], fl['requeues'], fl['restarts'],
+                   fl['swaps'],
+                   ', '.join('%s=%d' % kv for kv in sorted(
+                       fl['actions'].items())) or '-'))
+        dc = fl.get('decode') or {}
+        if dc.get('steps'):
+            lines.append(
+                'decode:   %d steps, %d slot-steps | occupancy mean '
+                '%.1f%% min %.1f%% | %d admitted, %d retired'
+                % (dc['steps'], dc['slot_steps'],
+                   100.0 * dc['mean_occupancy'],
+                   100.0 * dc['min_occupancy'], dc['admitted'],
+                   dc['retired']))
     if s['anomalies']:
         lines.append('anomaly:  %d guard trips' % s['anomalies'])
     lines.append('events:   %s' % ', '.join(
