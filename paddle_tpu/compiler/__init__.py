@@ -27,6 +27,7 @@ from .pass_base import (Pass, PassContext, PassResult, PassRegistry,  # noqa
                         registered_passes)
 from . import passes  # noqa  (registers canonical passes + fused kernel)
 from . import tuning  # noqa
+from . import zero  # noqa  (registers the ZeRO-2 grad-tail pass)
 from .passes import DEFAULT_PASSES, INFERENCE_PASSES  # noqa
 
 __all__ = ['Pass', 'PassContext', 'PassResult', 'PassRegistry',
@@ -34,7 +35,7 @@ __all__ = ['Pass', 'PassContext', 'PassResult', 'PassRegistry',
            'registered_passes', 'enabled', 'set_enabled', 'disabled',
            'default_pipeline', 'inference_pipeline',
            'set_default_passes', 'pipeline_signature', 'cache_token',
-           'optimize', 'optimize_inference', 'tuning']
+           'optimize', 'optimize_inference', 'tuning', 'zero']
 
 _STATE = {'enabled': True, 'pass_names': tuple(DEFAULT_PASSES),
           'pipeline': None}

@@ -1,0 +1,412 @@
+"""ZeRO-2 data-parallel execution mode (PERF.md "ZeRO-2 and collective
+overlap"; PAPERS.md 2004.13336, 2112.01075).
+
+The replicated data-parallel step all-reduces every full gradient and
+runs a fully-replicated optimizer update. ZeRO-2 replaces that tail:
+
+1. **Reduce-scatter in the backward** — :class:`ZeroShardGradients`
+   rewrites the grad-op tail so each eligible gradient is
+   reduce-scattered over the ``dp`` mesh axis; small gradients are
+   coalesced into size-capped buckets (``bucket_bytes``, default 4 MB)
+   so many tiny tensors share ONE collective (2112.01075's portable-
+   collective framing). Because the whole step lowers to one fused XLA
+   program, each bucket's collective is scheduled at the point its
+   gradients become available — interleaved with the remaining backward
+   compute rather than one all-reduce barrier after it.
+2. **Sharded update** — optimizer update ops consume the local gradient
+   shard plus the ZeRO-sliced optimizer state
+   (:func:`shard_optimizer_state`) and the updated parameter shards are
+   all-gathered back to replicated (2004.13336: the weight update
+   itself is cross-replica sharded). Per-device optimizer memory and
+   update flops both drop by the dp extent.
+
+Two collective dialects, one math:
+
+- Under jit-SPMD (the product executors) the sum over replicas is
+  implicit — the bucket collective is expressed as a
+  ``with_sharding_constraint`` onto a dp-sharded layout, and XLA's SPMD
+  partitioner materializes the reduction AT that layout. TPU/GPU
+  pipelines emit a ``reduce-scatter`` HLO; XLA CPU (this image) folds
+  the same schedule into an all-reduce feeding partition-local slices
+  — identical math, identical per-device update shapes.
+- Under a manual mapped context (``shard_map``/pmap, axis bound) the
+  same :func:`bucket_reduce_scatter` issues a REAL
+  ``jax.lax.psum_scatter`` over the partial gradients — the literal
+  reduce-scatter HLO, pinned by tests/test_zero.py.
+
+Both paths are exact: the rewrite is the identity on every gradient's
+global value (layout/ownership changes only), so ZeRO-2 losses, params
+and Adam moments are bit-identical to the replicated path
+(tests/test_zero.py pins dp=2).
+"""
+import os
+
+import numpy as np
+
+from .. import observability as _obs
+from .pass_base import Pass, PassResult, register_pass
+
+__all__ = ['DEFAULT_BUCKET_BYTES', 'default_stage', 'plan_buckets',
+           'bucket_reduce_scatter', 'shard_optimizer_state',
+           'ZeroShardGradients', 'apply_zero', 'zero_stage_of',
+           'grad_shard_bytes', 'OPTIMIZER_UPDATE_OPS']
+
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+# Optimizer update op -> accumulator-state input slots (the vars ZeRO
+# stage >= 1 slices; the reference pserver held exactly these on the
+# param slices, distribute_transpiler.py::_create_table_optimize_block).
+OPTIMIZER_STATE_SLOTS = {
+    'momentum': ('Velocity',),
+    'adam': ('Moment1', 'Moment2'),
+    'adamax': ('Moment', 'InfNorm'),
+    'adagrad': ('Moment',),
+    'decayed_adagrad': ('Moment',),
+    'adadelta': ('AvgSquaredGrad', 'AvgSquaredUpdate'),
+    'rmsprop': ('MeanSquare', 'Moment'),
+    'ftrl': ('SquaredAccumulator', 'LinearAccumulator'),
+}
+
+# Every update op whose Grad input stage 2 reduce-scatters (SGD carries
+# no accumulator state but its gradient still buckets/shards).
+OPTIMIZER_UPDATE_OPS = frozenset(OPTIMIZER_STATE_SLOTS) | {'sgd'}
+
+_DTYPE_BYTES = {'float64': 8, 'int64': 8, 'uint64': 8, 'float32': 4,
+                'int32': 4, 'uint32': 4, 'float16': 2, 'bfloat16': 2,
+                'int16': 2, 'uint16': 2, 'int8': 1, 'uint8': 1,
+                'bool': 1}
+
+
+def default_stage():
+    """The ZeRO stage data-parallel paths apply when none is given:
+    ``PADDLE_TPU_ZERO_STAGE`` (default 2 — sharded optimizer state +
+    reduce-scattered gradients). 0 disables."""
+    try:
+        return int(os.environ.get('PADDLE_TPU_ZERO_STAGE', '2'))
+    except ValueError:
+        return 2
+
+
+def zero_stage_of(program):
+    """The stage :func:`apply_zero` last applied to ``program`` (0 when
+    untouched)."""
+    return int(getattr(program, '_zero_stage', 0) or 0)
+
+
+def _dtype_bytes(dtype):
+    return _DTYPE_BYTES.get(str(dtype), 4)
+
+
+def plan_buckets(payload_bytes, cap=DEFAULT_BUCKET_BYTES):
+    """Greedy size-capped coalescing: group consecutive tensors until
+    adding the next would push the bucket past ``cap``. A tensor larger
+    than ``cap`` gets a bucket of its own; an exact cap multiple closes
+    the bucket at the boundary. Returns a list of index lists covering
+    ``range(len(payload_bytes))`` in order (pinned by
+    tests/test_zero.py bucketing-boundary cases)."""
+    cap = int(cap) if cap and int(cap) > 0 else DEFAULT_BUCKET_BYTES
+    buckets, cur, cur_bytes = [], [], 0
+    for i, b in enumerate(payload_bytes):
+        b = int(b)
+        if cur and cur_bytes + b > cap:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += b
+        if cur_bytes >= cap:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucket_reduce_scatter(grads, shard_dims, dp, axis='dp',
+                          manual=False):
+    """One coalesced gradient collective over the ``axis`` mesh axis.
+
+    Each gradient's shard dim is moved to the front and the flattened
+    ``(dp, numel/dp)`` views are concatenated into ONE bucket, so the
+    whole group rides a single collective; the per-gradient pieces are
+    then sliced back out along the UNsharded dim (a local op) and
+    restored to the parameter's layout.
+
+    ``manual=False`` (jit-SPMD): inputs are GLOBAL gradient values; the
+    collective is a sharding constraint — the SPMD partitioner owns the
+    reduction and the return values are the same global gradients in
+    dp-sharded layout (exact identity on values).
+
+    ``manual=True`` (inside shard_map/pmap with ``axis`` bound): inputs
+    are the per-device PARTIAL gradients; the bucket goes through a
+    real ``jax.lax.psum_scatter`` and the return values are each
+    device's OWNER SHARD, shaped ``[s[d]/dp, ...]`` in the parameter's
+    axis order — the literal ZeRO-2 reduce-scatter.
+    """
+    import jax
+    import jax.numpy as jnp
+    grads = list(grads)
+    if not grads:
+        return []
+    if not manual:
+        # Bit-exactness fence: without it the SPMD partitioner sees the
+        # sharded-layout consumer THROUGH the gradient-producing
+        # reduction and may re-tile it (measured: one layer_norm scale
+        # grad drifting 1-2 ulp per step on the transformer block).
+        # Pinning the gradient replicated first — the layout it has on
+        # the all-reduce baseline — plus an optimization barrier makes
+        # the producing kernel identical to the replicated path; the
+        # collective below is then purely a relayout, so ZeRO-2
+        # losses/params/moments stay bit-identical (the bench gate).
+        from ..core.lowering import active_sharding_mesh
+        mesh, _res = active_sharding_mesh()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(mesh, P())
+            grads = [jax.lax.with_sharding_constraint(
+                jnp.asarray(g), rep) for g in grads]
+        grads = list(jax.lax.optimization_barrier(tuple(
+            jnp.asarray(g) for g in grads)))
+    moved_shapes, pieces = [], []
+    for g, d in zip(grads, shard_dims):
+        x = jnp.moveaxis(jnp.asarray(g), int(d), 0)
+        moved_shapes.append(x.shape)
+        pieces.append(x.reshape(dp, -1))
+    bucket = pieces[0] if len(pieces) == 1 else \
+        jnp.concatenate(pieces, axis=1)
+    from ..partition import with_sharding_constraint
+    if manual:
+        from ..parallel.collective import reduce_scatter
+        bucket = reduce_scatter(bucket, axis, axis=0)
+    else:
+        bucket = with_sharding_constraint(bucket, (axis, None))
+    outs, off = [], 0
+    for g, d, mshape, piece in zip(grads, shard_dims, moved_shapes,
+                                   pieces):
+        k = piece.shape[1]
+        chunk = jax.lax.slice_in_dim(bucket, off, off + k, axis=1)
+        off += k
+        if manual:
+            local = (int(mshape[0]) // int(dp),) + tuple(mshape[1:])
+            outs.append(jnp.moveaxis(chunk.reshape(local), 0, int(d)))
+            continue
+        out = jnp.moveaxis(chunk.reshape(mshape), 0, int(d))
+        spec = (None,) * int(d) + (axis,)
+        outs.append(with_sharding_constraint(out, spec))
+    return outs
+
+
+def _grad_tail(program):
+    """(block, marker, update_ops) of a training program's global
+    block, or (block, None, []) when it has no optimizer tail."""
+    block = program.global_block()
+    marker = None
+    updates = []
+    for op in block.ops:
+        if op.type == 'backward_marker':
+            marker = op
+        elif op.type in OPTIMIZER_UPDATE_OPS and op.inputs.get('Grad'):
+            updates.append(op)
+    return block, marker, updates
+
+
+def shard_optimizer_state(program, dp):
+    """ZeRO stage 1: annotate every optimizer accumulator Variable
+    sharded over ``dp`` on its first divisible dim — per TENSOR, with a
+    replicated fallback for tensors no dim of which divides (odd
+    leading dims, scalar beta-pow accumulators): one awkward tensor
+    must never force the whole state dict replicated. Returns
+    ``(sliced_names, replicated_names)``. Explicit (e.g. tp) shardings
+    are kept untouched."""
+    from ..partition import first_divisible_dim
+    sliced, replicated = [], []
+    if dp <= 1:
+        return sliced, replicated
+    block = program.global_block()
+    for op in block.ops:
+        slots = OPTIMIZER_STATE_SLOTS.get(op.type)
+        if not slots:
+            continue
+        for slot in slots:
+            for name in op.inputs.get(slot, []):
+                var = block._find_var_recursive(name)
+                if var is None:
+                    continue
+                if var.sharding is not None:
+                    continue  # keep explicit (e.g. tp) shardings
+                d = first_divisible_dim(var.shape, dp)
+                if d is None:
+                    # per-tensor fallback: THIS tensor stays
+                    # replicated; the rest of the state still slices
+                    replicated.append(name)
+                    continue
+                var.sharding = (None,) * d + ('dp',)
+                sliced.append(name)
+    if sliced:
+        program._bump_version()
+    return sliced, replicated
+
+
+def grad_shard_bytes(program, dp):
+    """Per-device bytes of the local gradient shards a ZeRO-2 program
+    holds through its update tail (the ``zero_grad_shard_bytes``
+    gauge)."""
+    total = 0
+    block = program.global_block()
+    for op in block.ops:
+        if op.type != 'zero_reduce_scatter':
+            continue
+        for name in op.inputs.get('X', []):
+            var = block._find_var_recursive(name)
+            if var is None or not var.shape:
+                continue
+            numel = int(np.prod([max(int(s), 1) for s in var.shape]))
+            total += numel * _dtype_bytes(var.dtype) // max(dp, 1)
+    return total
+
+
+@register_pass
+class ZeroShardGradients(Pass):
+    """Rewrite the grad-op tail for ZeRO-2: insert one
+    ``zero_reduce_scatter`` op per size-capped bucket immediately
+    before the optimizer update tail, and annotate the gradient vars
+    dp-sharded so the lowering pins their layout.
+
+    Buckets are planned in REVERSE update order — the last parameter's
+    gradient completes first in the backward, so its bucket's
+    collective can start while earlier layers' grads are still being
+    computed (XLA schedules the fused program by dataflow; the op-list
+    position only fixes env-binding order).
+
+    Placement before the update tail (not at the backward marker) keeps
+    gradient-clip / regularizer ops reading REPLICATED gradients —
+    their reductions stay bit-identical to the replicated path; the
+    collective still overlaps the backward because nothing between the
+    marker and the tail forces materialization.
+
+    Per-tensor eligibility: dense gradient (sparse SelectedRows
+    carriers are skipped), some dim divisible by ``dp``
+    (``partition.first_divisible_dim`` — the SAME rule the optimizer-
+    state slicing and the Partitioner's degradation use). Ineligible
+    tensors keep the replicated all-reduce, per-tensor.
+    """
+
+    name = 'zero_shard_grads'
+    preserves_semantics = True
+    idempotent = True
+
+    def __init__(self, dp=None, bucket_bytes=None, axis='dp'):
+        self.dp = dp
+        self.bucket_bytes = int(bucket_bytes or DEFAULT_BUCKET_BYTES)
+        self.axis = axis
+
+    def run(self, program, ctx):
+        from ..partition import first_divisible_dim
+        res = PassResult(self.name)
+        dp = int(self.dp or 0)
+        if dp <= 1:
+            return res
+        block, marker, updates = _grad_tail(program)
+        if marker is None or not updates:
+            return res
+        if any(op.type == 'zero_reduce_scatter' for op in block.ops):
+            return res        # idempotent: tail already rewritten
+        sparse = set((marker.attrs.get('sparse') or {}))
+        # reverse update order = backward completion order (see class
+        # docstring); each entry: (grad name, shard dim, payload bytes)
+        entries, seen = [], set()
+        for op in reversed(updates):
+            gname = op.inputs['Grad'][0]
+            pname = op.inputs.get('Param', [None])[0]
+            if pname in sparse or gname in seen:
+                continue
+            pvar = block._find_var_recursive(pname)
+            if pvar is not None and pvar.sharding is not None:
+                # explicitly sharded param (tp/mp): its gradient keeps
+                # the param's natural layout — ZeRO over dp only
+                # handles the REPLICATED parameters
+                continue
+            var = block._find_var_recursive(gname) or pvar
+            shape = tuple(getattr(var, 'shape', None) or ())
+            if not shape or any(int(s) <= 0 for s in shape):
+                continue
+            d = first_divisible_dim(shape, dp)
+            if d is None:
+                continue      # per-tensor replicated fallback
+            seen.add(gname)
+            numel = int(np.prod([int(s) for s in shape]))
+            entries.append((gname, d,
+                            numel * _dtype_bytes(
+                                getattr(var, 'dtype', 'float32'))))
+        if not entries:
+            return res
+        buckets = plan_buckets([e[2] for e in entries],
+                               self.bucket_bytes)
+        first_update = min(i for i, op in enumerate(block.ops)
+                           if op in updates)
+        for b_id, idxs in enumerate(buckets):
+            names = [entries[i][0] for i in idxs]
+            dims = [entries[i][1] for i in idxs]
+            block.insert_op(
+                first_update, type='zero_reduce_scatter',
+                inputs={'X': names}, outputs={'Out': names},
+                attrs={'shard_dims': dims, 'dp': dp,
+                       'axis_name': self.axis, 'bucket_id': b_id,
+                       'bucket_bytes': sum(entries[i][2]
+                                           for i in idxs)})
+            first_update += 1
+            for gname, d in zip(names, dims):
+                gvar = block._find_var_recursive(gname)
+                if gvar is not None and gvar.sharding is None:
+                    gvar.sharding = (None,) * d + (self.axis,)
+        program._bump_version()
+        res.changed = True
+        res.ops_fused = len(entries)
+        res.note = '%d grads -> %d bucket(s)' % (len(entries),
+                                                 len(buckets))
+        return res
+
+
+def apply_zero(program, dp, stage=None, bucket_bytes=None):
+    """Apply ZeRO to a training program, end to end: stage >= 1 slices
+    the optimizer state per-tensor over ``dp``
+    (:func:`shard_optimizer_state`), stage >= 2 additionally rewrites
+    the gradient tail with bucketed reduce-scatters
+    (:class:`ZeroShardGradients`). Idempotent per (program, dp, stage);
+    a 1-extent mesh is a structural no-op, so the same call sites run
+    unchanged on one device. Returns a summary dict (journaled as a
+    ``zero`` event)."""
+    stage = default_stage() if stage is None else int(stage)
+    dp = int(dp or 1)
+    summary = {'stage': stage, 'dp': dp, 'sliced': 0, 'replicated': 0,
+               'buckets': 0, 'grads': 0, 'shard_bytes': 0}
+    if stage <= 0 or dp <= 1:
+        return summary
+    if zero_stage_of(program) >= stage and \
+            getattr(program, '_zero_dp', None) == dp:
+        return summary        # already applied at this (stage, dp)
+    sliced, replicated = shard_optimizer_state(program, dp)
+    summary['sliced'], summary['replicated'] = len(sliced), \
+        len(replicated)
+    summary['sliced_names'] = sliced
+    summary['replicated_names'] = replicated
+    if stage >= 2:
+        res = ZeroShardGradients(dp=dp, bucket_bytes=bucket_bytes).run(
+            program, None)
+        if res.changed:
+            block = program.global_block()
+            summary['buckets'] = sum(
+                1 for op in block.ops
+                if op.type == 'zero_reduce_scatter')
+            summary['grads'] = res.ops_fused
+            summary['shard_bytes'] = grad_shard_bytes(program, dp)
+    program._zero_stage = stage
+    program._zero_dp = dp
+    reg = _obs.default_registry()
+    reg.gauge('zero_grad_shard_bytes',
+              'per-device bytes of ZeRO-2 local gradient shards'
+              ).set(summary['shard_bytes'])
+    if _obs.journal_active():
+        _obs.emit('zero', action='apply', **{
+            k: v for k, v in summary.items()
+            if not k.endswith('_names')})
+    return summary

@@ -610,6 +610,17 @@ def lower_block_chained(program, block, feed_names, fetch_names,
     Not valid for dynamic (eager) programs, per-op profiling, or
     checkify NaN-guard mode — the executor falls back to sequential
     single-step runs for those.
+
+    ZeRO-2 collective overlap (PERF.md "ZeRO-2 and collective
+    overlap"): when the step carries ``zero_reduce_scatter`` bucket
+    ops, those collectives live INSIDE the scan body, so each
+    iteration's bucketed gradient collectives and the parameter
+    all-gather are scheduled by XLA against the same iteration's
+    remaining backward and the carry hand-off — no host barrier ever
+    separates a microbatch's collectives from the next microbatch's
+    compute. The sharded optimizer state (``Variable.sharding`` on the
+    accumulators) threads the donated carry, so moment shards stay
+    resident per-device across all K steps.
     """
     step = lower_block(program, block, feed_names, fetch_names,
                        state_in_names, state_out_names,

@@ -16,6 +16,7 @@ from . import ctc_ops  # noqa
 from . import search_ops  # noqa
 from . import detection_ops  # noqa
 from . import collective_ops  # noqa
+from . import zero_ops  # noqa
 from . import misc_ops  # noqa
 
 from ..core.registry import registered_ops  # noqa

@@ -1,0 +1,35 @@
+"""ZeRO-2 gradient-tail kernel (PERF.md "ZeRO-2 and collective
+overlap").
+
+``zero_reduce_scatter`` is the op :class:`compiler.zero.
+ZeroShardGradients` plants before the optimizer update tail: one
+coalesced reduce-scatter per gradient bucket. Like the collective ops
+(collective_ops.py) it is dialect-dual — a real ``psum_scatter`` when
+the dp axis is bound (shard_map/pmap), a sharding-constraint-expressed
+collective under plain jit SPMD where XLA owns the reduction, and the
+identity on a single device. Either way the op is EXACT on every
+gradient's global value: only layout/ownership changes, which is what
+keeps ZeRO-2 bit-identical to the replicated path.
+"""
+from ..core.registry import register_kernel
+from ..core.lowering import SparseRows
+from .collective_ops import _axis_bound
+
+
+@register_kernel('zero_reduce_scatter')
+def _zero_reduce_scatter(ctx):
+    from ..compiler.zero import bucket_reduce_scatter
+    names = ctx.op.inputs['X']
+    grads = [ctx.env[n] for n in names]
+    dims = list(ctx.attr('shard_dims') or [0] * len(names))
+    dp = int(ctx.attr('dp', 1))
+    ax = ctx.attr('axis_name', 'dp')
+    if dp <= 1 or any(isinstance(g, SparseRows) for g in grads):
+        # degenerate mesh / sparse carrier slipped through: identity
+        for i, g in enumerate(grads):
+            ctx.set_output('Out', g, i)
+        return
+    outs = bucket_reduce_scatter(grads, dims, dp, axis=ax,
+                                 manual=_axis_bound(ax))
+    for i, g in enumerate(outs):
+        ctx.set_output('Out', g, i)

@@ -145,7 +145,17 @@ class Optimizer(object):
         return optimize_ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, zero_stage=None, bucket_bytes=None):
+        """``zero_stage`` opts this program into ZeRO at build time
+        (PERF.md "ZeRO-2 and collective overlap"): stage >= 1 shards
+        the accumulator state created above over the active dp mesh
+        axis; stage >= 2 also rewrites the gradient tail so each
+        update op consumes its local reduce-scattered gradient shard
+        and the updated parameter shards all-gather back to
+        replicated. Data-parallel runtimes (ParallelExecutor,
+        ``Trainer.train``) apply the same mode by default on a dp
+        mesh, so this knob mostly serves raw-executor scripts and
+        stage overrides."""
         self._main_program = loss.block.program
         self._startup_program = startup_program
         params_grads = append_backward(loss, parameter_list, no_grad_set,
@@ -155,6 +165,13 @@ class Optimizer(object):
                                                  self.regularization)
         optimize_ops = self._create_optimization_pass(
             params_grads, loss, startup_program)
+        if zero_stage is not None and int(zero_stage) > 0:
+            from .compiler import zero as _zero
+            from .parallel.mesh import _current_mesh
+            from .partition import mesh_axis_extent
+            _zero.apply_zero(self._main_program,
+                             mesh_axis_extent(_current_mesh, 'dp'),
+                             stage=zero_stage, bucket_bytes=bucket_bytes)
         return optimize_ops, params_grads
 
 

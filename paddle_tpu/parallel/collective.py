@@ -7,11 +7,43 @@ wrappers over jax.lax collectives for use inside shard_map'ed model code
 (ring attention, ZeRO gathers, pipeline sends). Under plain jit SPMD you
 normally don't call these — XLA inserts the collectives from shardings.
 """
+import contextlib
+import time
+
 import jax
 import jax.numpy as jnp
 
 __all__ = ['all_reduce', 'all_gather', 'reduce_scatter', 'broadcast',
-           'ring_permute', 'barrier', 'axis_index', 'axis_size']
+           'ring_permute', 'barrier', 'axis_index', 'axis_size',
+           'observe_collective', 'timed_collective']
+
+
+def observe_collective(op, seconds, payload_bytes=None):
+    """Record one collective's measured wall into
+    ``collective_seconds{op=}`` (OBSERVABILITY.md). The collective
+    functions below only ever run under a trace — XLA owns their
+    runtime wall — so the observations come from the call sites that
+    CAN measure: standalone collective micro-timings in
+    ``tools/partition_bench.py --zero`` (the overlap-fraction
+    denominator) and host-side resharding paths."""
+    from .. import observability as _obs
+    reg = _obs.default_registry()
+    reg.histogram('collective_seconds',
+                  'measured wall per collective dispatch',
+                  op=op).observe(seconds)
+    if payload_bytes is not None:
+        reg.counter('collective_bytes_total',
+                    'payload bytes through measured collectives',
+                    op=op).inc(int(payload_bytes))
+
+
+@contextlib.contextmanager
+def timed_collective(op, payload_bytes=None):
+    """Time a block (a dispatched + blocked-on collective) into
+    ``collective_seconds{op=}``."""
+    t0 = time.perf_counter()
+    yield
+    observe_collective(op, time.perf_counter() - t0, payload_bytes)
 
 
 def all_reduce(x, axis_name='dp', op='sum'):

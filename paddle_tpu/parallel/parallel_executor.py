@@ -68,7 +68,8 @@ class ParallelExecutor(object):
                  share_vars_from=None, num_threads=None,
                  allow_op_delay=False, use_tpu=True, num_devices=None,
                  mesh=None, partitioner=None, exec_strategy=None,
-                 build_strategy=None):
+                 build_strategy=None, zero_stage=None,
+                 zero_bucket_bytes=None):
         self._program = main_program or default_main_program()
         if partitioner is None:
             partitioner = Partitioner(mesh=mesh, num_devices=num_devices)
@@ -83,6 +84,19 @@ class ParallelExecutor(object):
             self._scope = share_vars_from._scope
         else:
             self._scope = global_scope()
+        # ZeRO-2 by default on a dp mesh (PERF.md "ZeRO-2 and
+        # collective overlap"): a TRAINING ParallelExecutor
+        # (loss_name given, real dp extent) shards the optimizer state
+        # and reduce-scatters the bucketed gradient tail. The rewrite
+        # is the exact identity on every fetched value — the replicated
+        # path stays available with zero_stage=0.
+        self._zero = {'stage': 0, 'dp': 1}
+        dp = partitioner.axis_extent('dp')
+        if loss_name is not None and dp > 1:
+            from ..compiler import zero as _zero
+            self._zero = _zero.apply_zero(
+                self._program, dp, stage=zero_stage,
+                bucket_bytes=zero_bucket_bytes)
 
     @property
     def partitioner(self):

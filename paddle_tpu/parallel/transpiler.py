@@ -23,21 +23,8 @@ from ..framework import Program, default_main_program
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerSimple',
            'InferenceTranspiler', 'memory_optimize', 'release_memory']
 
-# Optimizer update ops -> their accumulator-state input slots.
-# (ref: the pserver held exactly these vars — its optimize blocks ran on
-# param slices, distribute_transpiler.py::_create_table_optimize_block)
-_OPTIM_STATE_SLOTS = {
-    'momentum': ('Velocity',),
-    'adam': ('Moment1', 'Moment2'),
-    'adamax': ('Moment', 'InfNorm'),
-    'adagrad': ('Moment',),
-    'decayed_adagrad': ('Moment',),
-    'adadelta': ('AvgSquaredGrad', 'AvgSquaredUpdate'),
-    'rmsprop': ('MeanSquare', 'Moment'),
-    'ftrl': ('SquaredAccumulator', 'LinearAccumulator'),
-}
-
-
+# The optimizer update-op -> accumulator-slot table moved to
+# compiler.zero.OPTIMIZER_STATE_SLOTS (the ZeRO engine owns it).
 class DistributeTranspiler(object):
     def __init__(self):
         self.trainer_id = 0
@@ -46,10 +33,11 @@ class DistributeTranspiler(object):
         self.sync_mode = True
         self._program = None
         self.sliced_vars = []
+        self.replicated_vars = []
 
     def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
                   trainers=1, sync_mode=True, split_method=None,
-                  slice_var_up=True):
+                  slice_var_up=True, zero_stage=None, bucket_bytes=None):
         self.trainer_id = trainer_id
         self.trainers = trainers
         self.pserver_endpoints = [e for e in pservers.split(",") if e]
@@ -77,7 +65,8 @@ class DistributeTranspiler(object):
                 coordinator_address=self.pserver_endpoints[0],
                 num_processes=trainers, process_id=trainer_id)
         if slice_var_up:
-            self._slice_optimizer_state()
+            self._slice_optimizer_state(zero_stage=zero_stage,
+                                        bucket_bytes=bucket_bytes)
         return self
 
     def _dp_size(self):
@@ -91,47 +80,34 @@ class DistributeTranspiler(object):
             return mesh_axis_extent(_current_mesh, 'dp')
         return max(self.trainers, 1)
 
-    def _slice_optimizer_state(self):
-        """ZeRO-style optimizer-state sharding — the TPU mapping of the
-        reference's param-slice-per-pserver layout.
+    def _slice_optimizer_state(self, zero_stage=None, bucket_bytes=None):
+        """ZeRO sharding — the TPU mapping of the reference's
+        param-slice-per-pserver layout.
 
         The reference slices each parameter round-robin over pservers and
         runs the optimizer remotely on the slice, so each host holds
         1/N of the optimizer state (ref: python/paddle/fluid/transpiler/
-        distribute_transpiler.py::transpile, slice_var_up). Here the same
-        memory win comes from marking each accumulator Variable sharded
-        over the 'dp' mesh axis on dim 0: XLA SPMD keeps the moment
-        buffers resident as [N/dp, ...] shards, partitions the elementwise
-        update, and gathers only the param output (params stay replicated,
-        matching trainer semantics). Consumed by
-        ParallelExecutor._var_sharding.
-        """
-        from ..partition import first_divisible_dim
+        distribute_transpiler.py::transpile, slice_var_up). Here the
+        whole mode lives in ``compiler.zero.apply_zero`` (PERF.md
+        "ZeRO-2 and collective overlap"): stage >= 1 marks each
+        accumulator Variable sharded over the 'dp' mesh axis on its
+        first divisible dim — per TENSOR, falling back to replicated
+        only for tensors no dim of which divides — and stage >= 2
+        (the default) additionally rewrites the gradient tail so every
+        eligible gradient rides a bucketed reduce-scatter and the
+        update runs on local shards before the parameter all-gather.
+        ``self.sliced_vars`` / ``self.replicated_vars`` record the
+        per-tensor outcome."""
+        from ..compiler import zero as _zero
         dp = self._dp_size()
         self.sliced_vars = []
+        self.replicated_vars = []
         if dp <= 1:
             return
-        block = self._program.global_block()
-        for op in block.ops:
-            slots = _OPTIM_STATE_SLOTS.get(op.type)
-            if not slots:
-                continue
-            for slot in slots:
-                for name in op.inputs.get(slot, []):
-                    var = block._find_var_recursive(name)
-                    if var is None or var.sharding is not None:
-                        continue  # keep explicit (e.g. tp) shardings
-                    # slice over the FIRST dp-divisible dim (r3: was
-                    # dim-0-only, which left odd-leading-dim
-                    # accumulators — biases, embeddings with ragged
-                    # vocab — fully replicated); the same divisibility
-                    # rule the Partitioner resolves specs with, so an
-                    # annotation placed here never degrades later
-                    d = first_divisible_dim(var.shape, dp)
-                    if d is not None:
-                        var.sharding = (None,) * d + ('dp',)
-                        self.sliced_vars.append(name)
-        self._program._bump_version()
+        summary = _zero.apply_zero(self._program, dp, stage=zero_stage,
+                                   bucket_bytes=bucket_bytes)
+        self.sliced_vars = summary.get('sliced_names', [])
+        self.replicated_vars = summary.get('replicated_names', [])
 
     def get_trainer_program(self):
         """The trainer program is the original program: gradient exchange

@@ -220,6 +220,24 @@ class Partitioner(object):
                     out[d] = None
         return out
 
+    def grad_shard_spec(self, shape, axis='dp'):
+        """The ZeRO-2 spec a gradient (or accumulator) of ``shape``
+        shards under on this mesh: ``axis`` on the first divisible dim,
+        or None (replicated) when no dim divides — the SAME
+        ``first_divisible_dim`` rule the transpiler's state slicing and
+        :meth:`resolve_spec`'s degradation use, so a spec decided at
+        transpile time can never degrade differently at partition
+        time. Shard buffers resolved through this spec ride the state
+        dict, so they are donated across steps like every other
+        persistable (PERF.md "ZeRO-2 and collective overlap")."""
+        extent = self.axis_extent(axis)
+        if extent <= 1:
+            return None
+        d = first_divisible_dim(shape, extent)
+        if d is None:
+            return None
+        return (None,) * d + (axis,)
+
     def named_sharding(self, spec=()):
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.mesh, P(*spec))

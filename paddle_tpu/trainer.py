@@ -142,7 +142,7 @@ class Trainer(object):
     def train(self, num_epochs, event_handler, reader=None,
               feed_order=None, checkpoint_config=None,
               anomaly_guard=None, prefetch=0, steps_per_dispatch=1,
-              sync_interval=1):
+              sync_interval=1, zero_stage=None, zero_bucket_bytes=None):
         """``checkpoint_config``: a resilience.CheckpointConfig — save
         progress every ``step_interval`` steps / ``epoch_interval``
         epochs through the atomic checkpoint protocol and auto-resume
@@ -169,7 +169,18 @@ class Trainer(object):
         ``sync_interval=M``: materialize fetched losses only every M
         steps — between syncs, ``EndStepEvent.metrics`` carry LAZY
         device values (``np.asarray`` them to force). Ignored (forced
-        to 1) when an ``anomaly_guard`` must inspect every loss."""
+        to 1) when an ``anomaly_guard`` must inspect every loss.
+
+        ``zero_stage`` (PERF.md "ZeRO-2 and collective overlap"):
+        ZeRO mode for the data-parallel path — default (None) is
+        stage 2 on a dp mesh: optimizer state sharded per-tensor over
+        dp, gradients reduce-scattered in size-capped buckets during
+        the backward, update ops consuming local shards, parameters
+        all-gathered back. Bit-identical to the replicated path
+        (tests/test_zero.py); ``zero_stage=0`` restores the replicated
+        all-reduce tail. ``zero_bucket_bytes`` caps a gradient
+        bucket's payload (default ~4 MB). Structural no-op on a
+        single device."""
         if checkpoint_config is not None and not isinstance(
                 checkpoint_config, CheckpointConfig):
             raise TypeError('checkpoint_config must be a '
@@ -189,6 +200,8 @@ class Trainer(object):
         self._prefetch = int(prefetch)
         self._steps_per_dispatch = int(steps_per_dispatch)
         self._sync_interval = int(sync_interval)
+        self._zero_stage = zero_stage
+        self._zero_bucket_bytes = zero_bucket_bytes
         if self.parallel:
             self._train_by_parallel_executor(num_epochs, event_handler,
                                              reader, feed_order)
@@ -222,6 +235,15 @@ class Trainer(object):
         with self._prog_and_scope_guard():
             feeder = self._feeder(self.train_program, feed_order)
             exe = executor.Executor(self.place)
+            # ZeRO on the plain-executor path: real only when the
+            # executor's partitioner spans a dp mesh (a place-backed
+            # Executor is a 1-device fallback — structural no-op)
+            from .compiler import zero as _zero
+            _zero.apply_zero(self.train_program,
+                             exe.partitioner.axis_extent('dp'),
+                             stage=getattr(self, '_zero_stage', None),
+                             bucket_bytes=getattr(
+                                 self, '_zero_bucket_bytes', None))
             self._train_loop(event_handler, exe, num_epochs, reader,
                              feeder)
 
@@ -657,7 +679,10 @@ class Trainer(object):
         if self._get_parallel_executor() is None:
             self.parallel_executor = parallel_executor.ParallelExecutor(
                 use_cuda=False, main_program=self.train_program,
-                loss_name=self.train_func_outputs[0].name)
+                loss_name=self.train_func_outputs[0].name,
+                zero_stage=getattr(self, '_zero_stage', None),
+                zero_bucket_bytes=getattr(self, '_zero_bucket_bytes',
+                                          None))
         return self._get_parallel_executor()
 
 

@@ -83,3 +83,9 @@ def pytest_configure(config):
         'M-device resume), SIGTERM preemption safety, mesh-degraded '
         'autoresume, concurrent-saver locking (tier-1; filter with '
         '-m "not elastic")')
+    config.addinivalue_line(
+        'markers',
+        'zero: tests of the ZeRO-2 data-parallel trainer — bucketed '
+        'reduce-scatter gradient tail, sharded optimizer update, '
+        'replicated-path bit-exactness, chained-dispatch overlap '
+        '(tier-1; filter with -m "not zero")')

@@ -324,8 +324,12 @@ def test_zero_slicing_byte_accounting_at_scale():
         with fluid.scope_guard(scope):
             exe = fluid.Executor(fluid.CPUPlace())
             exe.run(startup)
+            # ZeRO-2 is the dp-mesh DEFAULT now (PERF.md "ZeRO-2 and
+            # collective overlap"); the replicated baseline leg must
+            # opt out explicitly or it would measure sliced state too
             pexe = fluid.ParallelExecutor(
-                use_cuda=False, loss_name=loss.name, main_program=main)
+                use_cuda=False, loss_name=loss.name, main_program=main,
+                zero_stage=0 if mode == 'replicated' else None)
             feed = {'x': np.zeros((8, 4096), 'float32'),
                     'y': np.zeros((8, 1), 'float32')}
             stats[mode] = pexe.compile_stats([loss], feed)

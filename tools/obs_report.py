@@ -42,6 +42,10 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # OR continuous-batching decode steps (SERVING.md
                # "Fleet tier & continuous batching")
                'fleet': ('fleet', 'decode'),
+               # a ZeRO-2 run must show the mode being applied
+               # (bucketed grad tail / sliced state — PERF.md "ZeRO-2
+               # and collective overlap") or a measured collective
+               'zero': ('zero', 'collective'),
                'any': None}
 
 
@@ -180,6 +184,44 @@ def _partition_summary(by_ev):
     }
 
 
+def _zero_summary(by_ev):
+    """ZeRO-2 SLI (PERF.md "ZeRO-2 and collective overlap"): mode
+    applications from ``zero`` events (buckets, sliced/replicated state
+    tensors, per-device grad-shard bytes) and measured collective walls
+    from ``collective`` events — ``overlap_fraction`` is the share of
+    the standalone collective wall HIDDEN under compute (1.0 = the
+    sharded step pays nothing visible over the replicated step)."""
+    events = by_ev.get('zero', ())
+    applies = [r for r in events if r.get('action') == 'apply']
+    colls = by_ev.get('collective', ())
+    total_coll_s = sum(r.get('standalone_s', 0.0) for r in colls)
+    visible_s = sum(r.get('visible_s', 0.0) for r in colls)
+    overlap = None
+    if total_coll_s > 0:
+        overlap = max(0.0, min(1.0, 1.0 - visible_s / total_coll_s))
+    return {
+        'events': len(events),
+        'applied': len(applies),
+        'buckets': sum(r.get('buckets', 0) for r in applies),
+        'grads': sum(r.get('grads', 0) for r in applies),
+        'sliced_state': sum(r.get('sliced', 0) for r in applies),
+        'replicated_state': sum(r.get('replicated', 0)
+                                for r in applies),
+        'shard_bytes': max((r.get('shard_bytes', 0) for r in applies),
+                           default=0),
+        'collectives': {
+            'measured': len(colls),
+            'standalone_wall_s': total_coll_s,
+            'visible_wall_s': visible_s,
+            'overlap_fraction': overlap,
+            'by_op': {
+                op: sum(r.get('standalone_s', 0.0) for r in colls
+                        if r.get('op') == op)
+                for op in sorted({r.get('op', '?') for r in colls})},
+        },
+    }
+
+
 def _fleet_summary(by_ev):
     """Fleet SLI (SERVING.md "Fleet tier & continuous batching"):
     replica lifecycle (quarantines, kills, restarts, swaps) from
@@ -281,6 +323,7 @@ def summarize(records, malformed=0):
         'partition': _partition_summary(by_ev),
         'resilience': _resilience_summary(by_ev),
         'fleet': _fleet_summary(by_ev),
+        'zero': _zero_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -390,6 +433,24 @@ def render(summary, top=10):
         for topo, t in sorted(rz.get('topologies', {}).items()):
             lines.append('  reshard %-22s x%d  vars=%d  %.3fs'
                          % (topo, t['count'], t['vars'], t['wall_s']))
+    zr = s.get('zero') or {}
+    if zr.get('applied') or zr.get('collectives', {}).get('measured'):
+        lines.append(
+            'zero:     %d application(s) | %d grads -> %d bucket(s) | '
+            'state sliced=%d replicated=%d | shard bytes/device %d'
+            % (zr['applied'], zr['grads'], zr['buckets'],
+               zr['sliced_state'], zr['replicated_state'],
+               zr['shard_bytes']))
+        zc = zr['collectives']
+        if zc['measured']:
+            line = ('collective: %d measured, %.3fs standalone wall'
+                    % (zc['measured'], zc['standalone_wall_s']))
+            if zc['overlap_fraction'] is not None:
+                line += (' | %.0f%% hidden under compute'
+                         % (100.0 * zc['overlap_fraction']))
+            lines.append(line)
+            for op, wall in sorted(zc['by_op'].items()):
+                lines.append('  %-16s %8.3fms' % (op, wall * 1e3))
     fl = s.get('fleet') or {}
     if fl.get('events') or fl.get('decode', {}).get('steps'):
         if fl.get('events'):

@@ -1,22 +1,35 @@
 #!/usr/bin/env python
-"""Partition bench worker (PARTITIONING.md): the SAME pipelined
+"""Partition bench worker (PARTITIONING.md / PERF.md "ZeRO-2 and
+collective overlap").
+
+``--mode partition`` (default): the SAME pipelined
 ``Trainer.train(prefetch=2, steps_per_dispatch=4)`` loop through the
 ParallelExecutor at mesh=1 (the Partitioner's plain-jit CPU fallback)
 vs mesh=N (sharded pjit over N host CPU devices), reporting steps/s
 and loss parity as JSON on stdout.
 
-Runs as a SUBPROCESS of ``bench.py bench_partition`` because the host
-CPU device count (XLA_FLAGS) must be fixed before jax initializes —
-the parent process has usually already brought a backend up. Feeds the
-MULTICHIP_r0*.json trajectory alongside the in-process multichip
-dryruns.
+``--mode zero``: replicated all-reduce (zero_stage=0) vs ZeRO-2
+(bucketed reduce-scatter tail + sharded update) on the SAME dp mesh —
+steps/s, per-device optimizer-state bytes (model + compile-time
+argument-byte accounting), bit-exact loss parity, the lowered-HLO
+collective census, standalone collective walls
+(``collective_seconds{op=}``) and the overlap fraction, journaled for
+the ``obs_report --require zero`` gate.
+
+Runs as a SUBPROCESS of ``bench.py bench_partition`` /
+``bench.py bench_zero`` because the host CPU device count (XLA_FLAGS)
+must be fixed before jax initializes — the parent process has usually
+already brought a backend up. Feeds the MULTICHIP_r0*.json trajectory
+alongside the in-process multichip dryruns.
 
     python tools/partition_bench.py --devices 2 --steps 12
+    python tools/partition_bench.py --mode zero --devices 2 --steps 20
 """
 import argparse
 import json
 import os
 import sys
+import time
 
 # runnable from anywhere: the repo root (tools/..) hosts paddle_tpu
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -24,11 +37,244 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 
+def _bench_zero(args):
+    """Replicated vs ZeRO-2 on one dp mesh (PERF.md).
+
+    The model is a transformer encoder block stack scaled to what a
+    host-CPU dp mesh can train in bench budget (the flagship-geometry
+    d_ff = 4 x d_model blocks with attention + layer_norm; real-chip
+    runs raise --d-model to the flagship 1024)."""
+    import re
+
+    import numpy as np
+    from jax.sharding import Mesh
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import nets, unique_name
+    from paddle_tpu import observability as obs
+    from paddle_tpu.compiler import zero as zmod
+    from paddle_tpu.partition import Partitioner
+    from paddle_tpu.parallel.collective import observe_collective
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import obs_report
+
+    dp, steps, batch = args.devices, args.steps, args.batch
+    d_model, seq = args.d_model, args.seq
+    rng = np.random.RandomState(0)
+    feeds = [{'x': rng.randn(batch, seq, d_model).astype('float32'),
+              'y': rng.randn(batch, 1).astype('float32')}
+             for _ in range(steps)]
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup), unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[seq, d_model],
+                                  dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1],
+                                  dtype='float32')
+            h = x
+            for _ in range(args.blocks):
+                att = nets.scaled_dot_product_attention(
+                    h, h, h, num_heads=args.heads)
+                h = fluid.layers.layer_norm(h + att,
+                                            begin_norm_axis=2)
+                ff = fluid.layers.fc(h, size=4 * d_model, act='relu',
+                                     num_flatten_dims=2)
+                ff = fluid.layers.fc(ff, size=d_model,
+                                     num_flatten_dims=2)
+                h = fluid.layers.layer_norm(h + ff,
+                                            begin_norm_axis=2)
+            pooled = fluid.layers.reduce_mean(h, dim=1)
+            pred = fluid.layers.fc(pooled, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        return main, startup, loss
+
+    def state_bytes(main, dp_extent):
+        """Per-device optimizer-state bytes from the program's own
+        annotations — the exact model of what XLA keeps resident
+        (cross-checked against compile_stats argument bytes below)."""
+        block = main.global_block()
+        repl = dev = 0
+        seen = set()
+        for op in block.ops:
+            slots = zmod.OPTIMIZER_STATE_SLOTS.get(op.type)
+            for slot in (slots or ()):
+                for name in op.inputs.get(slot, []):
+                    if name in seen:
+                        continue
+                    seen.add(name)
+                    var = block._find_var_recursive(name)
+                    n = int(np.prod([int(s) for s in var.shape])) * 4
+                    repl += n
+                    spec = var.sharding or ()
+                    dev += n // dp_extent if 'dp' in spec else n
+        return repl, dev
+
+    def run_leg(stage):
+        main, startup, loss = build()
+        scope = fluid.Scope()
+        part = Partitioner(mesh=Mesh(
+            np.asarray(jax.devices()[:dp]), ('dp',)))
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            pe = fluid.ParallelExecutor(
+                use_cuda=False, loss_name=loss.name, main_program=main,
+                partitioner=part, zero_stage=stage)
+            losses, walls = [], []
+            for i, f in enumerate(feeds):
+                t0 = time.perf_counter()
+                out = pe.run([loss.name], feed=f)
+                losses.append(float(np.asarray(out[0]).ravel()[0]))
+                walls.append(time.perf_counter() - t0)
+            stats = pe.compile_stats([loss.name], dict(feeds[0]))
+            # lowered-HLO collective census of the real step
+            from paddle_tpu.core.lowering import lower_block
+            fetch, pf, s_in, s_out, senv = exe._prep_lowering(
+                main, dict(feeds[0]), [loss.name], scope)
+            fn = lower_block(main, main.global_block(),
+                             sorted(pf.keys()), fetch, s_in, s_out,
+                             static_env=senv)
+            jitted = part.partition(
+                part.trace_wrap(fn),
+                in_shardings=(part.feed_shardings(pf),
+                              part.state_shardings(main, s_in)),
+                out_shardings=(part.replicated,
+                               part.state_shardings(main, s_out)))
+            state = {n: scope.raw(n) for n in s_in}
+            with part.run_context():
+                hlo = jitted.lower(pf, state).compile().as_text()
+        census = {p.replace('-', '_'): len(re.findall(p, hlo))
+                  for p in ('all-reduce', 'reduce-scatter',
+                            'all-gather', 'partition-id')}
+        # steady-state wall: drop the compiling first step
+        steady = walls[1:] or walls
+        repl_b, dev_b = state_bytes(main, dp)
+        return {
+            'losses': losses,
+            'steps_per_sec': round(len(steady) / sum(steady), 2),
+            'mean_step_ms': round(1e3 * sum(steady) / len(steady), 2),
+            'argument_bytes_per_device': stats['argument_bytes'],
+            'optimizer_state_bytes_replicated': repl_b,
+            'optimizer_state_bytes_per_device': dev_b,
+            'hlo_collectives': census,
+            'zero': {k: v for k, v in (getattr(pe, '_zero', {}) or
+                                       {}).items()
+                     if not k.endswith('_names')},
+            '_main': main, '_part': part,
+        }
+
+    jpath = args.journal or os.path.join(
+        os.environ.get('TMPDIR', '/tmp'), 'zero_bench.jsonl')
+    with obs.journal(jpath):
+        rep = run_leg(0)
+        zro = run_leg(None)       # dp-mesh default = ZeRO-2
+
+        # standalone collective walls: jit JUST the bucket collectives
+        # + the parameter all-gather shapes of the ZeRO program, time
+        # them on the mesh -> collective_seconds{op=} and the overlap
+        # denominator (obs_report's zero section).
+        main, part = zro.pop('_main'), zro.pop('_part')
+        rep.pop('_main'), rep.pop('_part')
+        block = main.global_block()
+        standalone = {'reduce_scatter': 0.0, 'all_gather': 0.0}
+        payload = 0
+        with part.run_context():
+            for op in block.ops:
+                if op.type != 'zero_reduce_scatter':
+                    continue
+                shapes = [tuple(block._find_var_recursive(n).shape)
+                          for n in op.inputs['X']]
+                dims = list(op.attrs['shard_dims'])
+                vals = [jax.device_put(np.zeros(s, 'float32'),
+                                       part.replicated)
+                        for s in shapes]
+
+                def coll(vs, _d=tuple(dims)):
+                    return zmod.bucket_reduce_scatter(
+                        vs, list(_d), dp, manual=False)
+
+                jc = jax.jit(coll)
+                jax.block_until_ready(jc(vals))    # compile
+                t0 = time.perf_counter()
+                jax.block_until_ready(jc(vals))
+                standalone['reduce_scatter'] += \
+                    time.perf_counter() - t0
+                payload += sum(int(np.prod(s)) * 4 for s in shapes)
+                # the matching parameter re-gather (shard -> replicated)
+                spec_vals = [jax.device_put(
+                    np.zeros(s, 'float32'),
+                    part.named_sharding(part.grad_shard_spec(s) or ()))
+                    for s in shapes]
+
+                def gath(vs):
+                    return [jax.device_put(v, part.replicated)
+                            for v in vs]
+                t0 = time.perf_counter()
+                jax.block_until_ready(gath(spec_vals))
+                standalone['all_gather'] += time.perf_counter() - t0
+        for op_name, wall in standalone.items():
+            observe_collective(op_name, wall, payload)
+        total_standalone = sum(standalone.values())
+        visible = max(0.0, (1.0 / max(zro['steps_per_sec'], 1e-9)) -
+                      (1.0 / max(rep['steps_per_sec'], 1e-9)))
+        obs.emit('collective', op='zero_tail',
+                 standalone_s=round(total_standalone, 6),
+                 visible_s=round(min(visible, total_standalone), 6))
+        overlap = None
+        if total_standalone > 0:
+            overlap = max(0.0, min(1.0, 1.0 - min(
+                visible, total_standalone) / total_standalone))
+
+    gate_ok = obs_report.check_journal(jpath, require='zero') == []
+    out = {
+        'mode': 'zero',
+        'devices': dp, 'batch_size': batch, 'steps': steps,
+        'model': ('transformer_block x%d (d_model=%d, heads=%d, '
+                  'seq=%d, d_ff=%d)' % (args.blocks, d_model,
+                                        args.heads, seq, 4 * d_model)),
+        'replicated': rep,
+        'zero2': zro,
+        'losses_bitwise_equal': rep['losses'] == zro['losses'],
+        'steps_per_sec_ratio': round(
+            zro['steps_per_sec'] / max(rep['steps_per_sec'], 1e-9), 3),
+        'optimizer_state_bytes_ratio': round(
+            zro['optimizer_state_bytes_per_device'] /
+            max(rep['optimizer_state_bytes_per_device'], 1), 4),
+        'argument_bytes_saved_per_device':
+            rep['argument_bytes_per_device'] -
+            zro['argument_bytes_per_device'],
+        'collective_standalone_s': {k: round(v, 6)
+                                    for k, v in standalone.items()},
+        'overlap_fraction': overlap,
+        'journal_gate_ok': gate_ok,
+        'journal': jpath if args.journal else None,
+    }
+    for leg in ('replicated', 'zero2'):
+        out[leg] = {k: v for k, v in out[leg].items()
+                    if k not in ('losses', 'zero')}
+    json.dump(out, sys.stdout)
+    print()
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument('--mode', choices=('partition', 'zero'),
+                    default='partition')
     ap.add_argument('--devices', type=int, default=2)
     ap.add_argument('--steps', type=int, default=12)
     ap.add_argument('--batch', type=int, default=64)
+    ap.add_argument('--d-model', type=int, default=128)
+    ap.add_argument('--seq', type=int, default=32)
+    ap.add_argument('--heads', type=int, default=4)
+    ap.add_argument('--blocks', type=int, default=2)
+    ap.add_argument('--journal', default=None)
     args = ap.parse_args()
 
     os.environ['JAX_PLATFORMS'] = 'cpu'
@@ -40,7 +286,8 @@ def main():
             % args.devices).strip()
     import jax
     jax.config.update('jax_platforms', 'cpu')
-    import time
+    if args.mode == 'zero':
+        return _bench_zero(args)
 
     import numpy as np
     from jax.sharding import Mesh
