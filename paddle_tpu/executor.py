@@ -942,10 +942,7 @@ class Executor(object):
             for v in program.global_block().vars.values())
         part = self.partitioner
         if k == 1 or dynamic or nan_checks_enabled() or \
-                _prof.op_profiling_enabled() or has_reader or \
-                (part.active and part.multiprocess):
-            # multi-process chaining would need per-step globalize
-            # inside the scan; sequential runs are correct and simple
+                _prof.op_profiling_enabled() or has_reader:
             return _sequential()
 
         fetch_names = [f.name if isinstance(f, Variable) else f
@@ -1034,27 +1031,52 @@ class Executor(object):
         (self._m_misses if was_miss else self._m_hits).inc()
 
         state = {n: scope.raw(n) for n in state_in_names}
+        multiproc = part.active and part.multiprocess
+        if multiproc:
+            # multi-process chain: the stacked [K, local_batch, ...]
+            # feeds ARE the per-step process-local shards, so one
+            # globalize of the stack threads per-step globalize through
+            # the scan (make_array_from_process_local_data scales the
+            # batch dim by the process span; the K axis is unsharded).
+            # Anything globalize can't express falls back LOUDLY to
+            # sequential run() — never a silently mis-shaped feed.
+            try:
+                stacked, state = part.globalize(stacked, state,
+                                                stacked_s, state_s)
+            except Exception as e:  # noqa: BLE001 — any globalize
+                import warnings
+                warnings.warn(
+                    'run_chained: multi-process globalize of the '
+                    '%d-step chunk failed (%r); falling back to %d '
+                    'sequential run() dispatches' % (k, e, k),
+                    RuntimeWarning, stacklevel=2)
+                _obs.emit('multihost', action='chain_fallback',
+                          steps=k, error=repr(e))
+                return _sequential()
         t_run = time.perf_counter()
         with part.run_context() if part.active else \
                 jax.default_device(self.place.jax_device()):
-            # commit the state to its run placement BEFORE the first
-            # call: prefetch-staged feeds arrive committed, while fresh
-            # startup state is uncommitted — without this the second
-            # chunk's jit signature differs (state now = committed jit
-            # outputs) and silently retraces+recompiles the whole
-            # K-step program once more. The Partitioner owns the
-            # placement: single device on the fallback mesh, per-var
-            # NamedSharding on a real one (the PR-5 "single-device
-            # commits fight pjit's NamedSharding" conflict dissolves
-            # here). device_put on already-committed matching arrays is
-            # a no-op.
-            state = part.commit_state(state, state_s)
-            if part.active:
-                # device-stacked prefetch-staged feeds come out of
-                # jnp.stack committed with whatever sharding XLA
-                # propagated; re-commit any that drifted from the
-                # declared in_shardings
-                stacked = part.reconcile(stacked, stacked_s)
+            if not multiproc:
+                # commit the state to its run placement BEFORE the
+                # first call: prefetch-staged feeds arrive committed,
+                # while fresh startup state is uncommitted — without
+                # this the second chunk's jit signature differs (state
+                # now = committed jit outputs) and silently
+                # retraces+recompiles the whole K-step program once
+                # more. The Partitioner owns the placement: single
+                # device on the fallback mesh, per-var NamedSharding on
+                # a real one (the PR-5 "single-device commits fight
+                # pjit's NamedSharding" conflict dissolves here).
+                # device_put on already-committed matching arrays is a
+                # no-op. (Multi-process state is already committed
+                # global by globalize above.)
+                state = part.commit_state(state, state_s)
+                if part.active:
+                    # device-stacked prefetch-staged feeds come out of
+                    # jnp.stack committed with whatever sharding XLA
+                    # propagated; re-commit any that drifted from the
+                    # declared in_shardings
+                    stacked = part.reconcile(stacked, stacked_s)
             fetches, new_state = jitted(stacked, state)
         run_wall = time.perf_counter() - t_run
         self._m_run.observe(run_wall)

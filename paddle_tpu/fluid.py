@@ -39,6 +39,7 @@ from .inferencer import Inferencer  # noqa
 from . import serving  # noqa
 from .serving import ModelServer  # noqa
 from . import fleet  # noqa
+from . import multihost  # noqa
 from . import debugger  # noqa
 from . import debugger as debuger  # noqa
 from . import memory  # noqa

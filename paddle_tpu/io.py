@@ -437,11 +437,122 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
         checkpoint_dir = os.getcwd()
     part = partitioner if partitioner is not None \
         else getattr(executor, 'partitioner', None)
+    import jax
+    if jax.process_count() > 1 and backend in ('auto', 'sharded'):
+        # multi-host pod: every process writes its addressable shards
+        # concurrently; only process 0 commits the manifest
+        return _save_checkpoint_multiprocess(
+            executor, checkpoint_dir, max_num_checkpoints,
+            save_interval_secs, main_program, trainer_state, part)
     with _commit_lock(checkpoint_dir):
         return _save_checkpoint_locked(
             executor, checkpoint_dir, max_num_checkpoints,
             save_interval_secs, main_program, backend, trainer_state,
             part)
+
+
+def _save_checkpoint_multiprocess(executor, checkpoint_dir,
+                                  max_num_checkpoints,
+                                  save_interval_secs, main_program,
+                                  trainer_state, part):
+    """Concurrent multi-host sharded save over shared storage
+    (PARTITIONING.md "Multi-host meshes").
+
+    Protocol: process 0 picks the serial under the flock (rate limit
+    included) and broadcasts it; every process then writes ITS owned
+    shards of every tensor into one deterministic shared tmp dir
+    (shard file names carry globally agreed ordinals, so writers never
+    collide) plus a partial manifest table; after a pod barrier,
+    process 0 alone merges the partials, writes the manifest, fsyncs
+    and renames — the same all-or-nothing commit as the single-process
+    path, with the flock still serializing the directory-level scan /
+    rename / prune against any OTHER saver sharing the dir."""
+    import jax
+    from .multihost import barrier as _mh_barrier
+    from .multihost import broadcast_int as _mh_broadcast
+    from .resilience import sharded as _sharded
+    pid = jax.process_index()
+    t_save = _time.monotonic()
+    serial = -1
+    if pid == 0:
+        with _commit_lock(checkpoint_dir):
+            serials = _get_checkpoint_serials(checkpoint_dir)
+            serial = (max(serials) + 1) if serials else 0
+            if serials and save_interval_secs:
+                last_dir = _serial_dir(checkpoint_dir, max(serials))
+                try:
+                    if _time.time() - _manifest_mtime(last_dir) < \
+                            save_interval_secs:
+                        serial = -1   # rate-limited: skip this save
+                except OSError:
+                    pass
+    serial = _mh_broadcast('ckpt_serial', serial)
+    if serial < 0:
+        serials = _get_checkpoint_serials(checkpoint_dir)
+        return _serial_dir(checkpoint_dir, max(serials))
+    cur_dir = _serial_dir(checkpoint_dir, serial)
+    tmp_dir = os.path.join(
+        checkpoint_dir, '%s%s_%d.shared'
+        % (resilience.checkpoint.TMP_PREFIX, CHECKPOINT_PREFIX,
+           serial))
+    if pid == 0:
+        if os.path.isdir(cur_dir):
+            shutil.rmtree(cur_dir)
+        if os.path.isdir(tmp_dir):
+            shutil.rmtree(tmp_dir)
+        os.makedirs(os.path.join(tmp_dir, _sharded.SHARD_DIR))
+    _mh_barrier('ckpt_tmp_ready_%d' % serial)
+    faultinject.maybe_fault(faultinject.SITE_CKPT_WRITE)
+    state = _collect_persistable_state(main_program)
+    tensors = _sharded.write_state_multiprocess(tmp_dir, state, pid)
+    with open(os.path.join(
+            tmp_dir, _sharded.PARTIAL_MANIFEST_FMT % pid), 'w') as f:
+        json.dump(tensors, f)
+    _mh_barrier('ckpt_payload_%d' % serial)
+    if pid == 0:
+        parts = []
+        for name in sorted(os.listdir(tmp_dir)):
+            if not (name.startswith('partial_manifest_') and
+                    name.endswith('.json')):
+                continue
+            path = os.path.join(tmp_dir, name)
+            with open(path) as f:
+                parts.append(json.load(f))
+            os.remove(path)
+        merged = _sharded.merge_partial_tables(parts)
+        resilience.write_manifest(
+            tmp_dir, tensors=merged, trainer_state=trainer_state,
+            backend='sharded', serial=serial,
+            mesh=part.mesh_meta() if part is not None else None,
+            rules=part.rules if part is not None else None)
+        open(os.path.join(tmp_dir, SUCCESS_MARK_FILENAME),
+             'w').close()
+        resilience.fsync_tree(tmp_dir)
+        faultinject.maybe_fault(faultinject.SITE_CKPT_COMMIT)
+        with _commit_lock(checkpoint_dir):
+            os.rename(tmp_dir, cur_dir)
+            resilience.checkpoint._fsync_path(checkpoint_dir)
+            survivors = sorted(
+                _get_checkpoint_serials(checkpoint_dir),
+                reverse=True)[:max(max_num_checkpoints, 1)]
+            for s in _get_checkpoint_serials(checkpoint_dir):
+                if s not in survivors and s != serial:
+                    shutil.rmtree(_serial_dir(checkpoint_dir, s),
+                                  ignore_errors=True)
+        dur = _time.monotonic() - t_save
+        reg = _obs.default_registry()
+        reg.counter('checkpoint_saves_total',
+                    'atomic checkpoint commits').inc()
+        reg.histogram('checkpoint_save_seconds',
+                      'payload + fsync + rename wall').observe(dur)
+        _obs.emit('checkpoint_save', serial=serial, dir=cur_dir,
+                  backend='sharded', processes=jax.process_count(),
+                  dur_s=round(dur, 6))
+    # every host leaves only after the commit is visible (a killed
+    # host between payload and commit is the launcher's problem — the
+    # incomplete tmp dir is invisible to readers and cleaned later)
+    _mh_barrier('ckpt_commit_%d' % serial)
+    return cur_dir
 
 
 def _save_checkpoint_locked(executor, checkpoint_dir,

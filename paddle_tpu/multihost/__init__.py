@@ -1,0 +1,46 @@
+"""paddle_tpu.multihost — the multi-process (pod) runtime.
+
+Makes "N hosts" a first-class, failure-tolerant deployment unit
+(PARTITIONING.md "Multi-host meshes", RESILIENCE.md "Surviving host
+loss"):
+
+- :mod:`bootstrap` — bounded-timeout retrying
+  ``jax.distributed.initialize`` (typed :class:`BootstrapTimeout`
+  instead of a silent hang), pod barriers, and cross-host agreement
+  checks (:func:`agreement_check` — program fingerprint + mesh + rules
+  hashed and allgathered; a divergent host fails fast with
+  :class:`HostMismatch` naming it).
+- :mod:`heartbeat` — stdlib-only mtime heartbeat files in a shared
+  dir; :class:`HostMonitor` classifies hosts alive/stale/missing
+  within a bounded window.
+- :mod:`launcher` — the ``tools/launch.py`` engine: spawn one process
+  per host, supervise exits + heartbeats, kill survivors out of hung
+  collectives on a host loss, relaunch a degraded generation that
+  resumes from the newest sharded checkpoint.
+- :mod:`remote` — a ModelServer cell in a REMOTE process behind a
+  socket proxy, so ``fleet.Router`` survives whole-host loss of its
+  replicas (``tools/chaos_bench.py --kill-host``).
+
+The in-script surface stays reference-compatible:
+``DistributeTranspiler.transpile`` routes through
+:func:`bootstrap.initialize`, so existing multi-trainer scripts gain
+the bounded handshake without changes.
+"""
+from .errors import (MultihostError, BootstrapTimeout,  # noqa: F401
+                     HostMismatch, HostLost)
+from .bootstrap import (initialize, barrier, broadcast_int,  # noqa
+                        agreement_check)
+from .heartbeat import (HeartbeatWriter, HostMonitor,  # noqa: F401
+                        start_heartbeat, stop_heartbeat,
+                        heartbeat_path)
+from .launcher import launch, free_port, LaunchResult  # noqa: F401
+from .events import mh_emit, JOURNAL_ENV  # noqa: F401
+
+__all__ = [
+    'MultihostError', 'BootstrapTimeout', 'HostMismatch', 'HostLost',
+    'initialize', 'barrier', 'broadcast_int', 'agreement_check',
+    'HeartbeatWriter', 'HostMonitor', 'start_heartbeat',
+    'stop_heartbeat', 'heartbeat_path',
+    'launch', 'free_port', 'LaunchResult',
+    'mh_emit', 'JOURNAL_ENV',
+]

@@ -1,0 +1,224 @@
+"""Multi-host bootstrap: bounded-timeout handshake, pod barriers and
+cross-host agreement checks (PARTITIONING.md "Multi-host meshes").
+
+``jax.distributed.initialize`` with no guard rails hangs forever when
+the coordinator never comes up — the worst possible failure mode for a
+supervised pod (the launcher sees a silent, live, useless process).
+:func:`initialize` wraps it in a bounded, retrying handshake that
+raises a typed :class:`~.errors.BootstrapTimeout` instead, validates
+the (process_id, num_processes) pair up front, records the
+``multihost_peers`` gauge and a ``multihost`` ``bootstrap`` journal
+event, and starts this host's heartbeat when a launcher provided a
+shared heartbeat dir.
+
+:func:`agreement_check` is the "same program everywhere" guard: each
+host hashes its program fingerprint + mesh identity + logical-axis
+rules, digests are compared via ``multihost_utils.process_allgather``,
+and any divergent host fails fast with a typed
+:class:`~.errors.HostMismatch` NAMING the minority hosts — a pod that
+would otherwise wedge inside mismatched collectives dies at startup
+with the culprit in the message.
+"""
+import hashlib
+import os
+import time
+
+import numpy as np
+
+from .. import observability as _obs
+from .errors import BootstrapTimeout, HostMismatch
+from .events import mh_emit
+from .heartbeat import start_heartbeat
+
+__all__ = ['initialize', 'barrier', 'broadcast_int',
+           'agreement_check']
+
+_BOOTSTRAPPED = False
+
+
+def _already_initialized(err):
+    return 'already initialized' in str(err).lower()
+
+
+def _distributed_client_up():
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:  # noqa: BLE001 — private layout moved
+        return False
+
+
+def _wait_coordinator(coordinator_address, deadline):
+    """Poll a TCP connect to the coordinator until ``deadline``.
+
+    jaxlib's coordination client does not raise on a handshake
+    deadline — it LOG(FATAL)s the whole process (client.h:80) — so a
+    worker must prove the coordinator is reachable BEFORE handing
+    control to ``jax.distributed.initialize``; only then can an
+    unreachable coordinator surface as a catchable, typed error."""
+    import socket
+    host, _, port = coordinator_address.rpartition(':')
+    port = int(port)
+    host = host or '127.0.0.1'
+    last = None
+    while time.monotonic() < deadline:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.settimeout(max(0.1, min(1.0, deadline - time.monotonic())))
+        try:
+            s.connect((host, port))
+            return None
+        except OSError as e:
+            last = e
+            time.sleep(0.25)
+        finally:
+            s.close()
+    return last or TimeoutError('coordinator never reachable')
+
+
+def initialize(coordinator_address, num_processes, process_id,
+               timeout=None, attempts=None, local_device_ids=None):
+    """Join (or host) the pod's coordination service.
+
+    Bounded handshake: each attempt gives ``jax.distributed`` an
+    ``initialization_timeout`` of ``timeout`` seconds; after
+    ``attempts`` failures a :class:`BootstrapTimeout` carries the
+    coordinator address, rank and last underlying error. Defaults come
+    from ``PTPU_BOOTSTRAP_TIMEOUT`` / ``PTPU_BOOTSTRAP_ATTEMPTS`` (60s,
+    2 attempts). A single-process "pod" is a validated no-op. Returns
+    True when a multi-process runtime is (or already was) up."""
+    global _BOOTSTRAPPED
+    num_processes = int(num_processes)
+    process_id = int(process_id)
+    if num_processes < 1:
+        raise ValueError('num_processes must be >= 1, got %d'
+                         % num_processes)
+    if not 0 <= process_id < num_processes:
+        raise ValueError(
+            'trainer_id/process_id must be in [0, %d) but is %d — each '
+            'launched process needs a distinct rank below the trainer '
+            'count' % (num_processes, process_id))
+    if num_processes == 1:
+        return False
+    import jax
+    # NB: probe the distributed client, NOT jax.process_count() — the
+    # latter initializes the backend, which with gloo collectives
+    # configured fails hard before jax.distributed.initialize has run.
+    if _BOOTSTRAPPED or _distributed_client_up():
+        return True
+    timeout = float(os.environ.get('PTPU_BOOTSTRAP_TIMEOUT', 60.0)
+                    if timeout is None else timeout)
+    attempts = int(os.environ.get('PTPU_BOOTSTRAP_ATTEMPTS', 2)
+                   if attempts is None else attempts)
+    attempts = max(1, attempts)
+    t0 = time.monotonic()
+    last = None
+    for attempt in range(1, attempts + 1):
+        if process_id != 0:
+            # rank 0 hosts the coordination service itself; every
+            # other rank first proves it can reach rank 0's socket
+            err = _wait_coordinator(coordinator_address,
+                                    time.monotonic() + timeout)
+            if err is not None:
+                last = err
+                continue
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id,
+                local_device_ids=local_device_ids,
+                initialization_timeout=max(1, int(round(timeout))))
+        except Exception as e:  # noqa: BLE001 — jaxlib raises several
+            if _already_initialized(e):
+                _BOOTSTRAPPED = True
+                return True
+            last = e
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort reset
+                pass
+            continue
+        _BOOTSTRAPPED = True
+        dur = time.monotonic() - t0
+        _obs.default_registry().gauge(
+            'multihost_peers',
+            'hosts currently inside the heartbeat window'
+        ).set(num_processes)
+        mh_emit('bootstrap', host=process_id, world=num_processes,
+                coordinator=str(coordinator_address), attempt=attempt,
+                dur_s=round(dur, 6))
+        start_heartbeat()
+        return True
+    mh_emit('bootstrap_timeout', host=process_id, world=num_processes,
+            coordinator=str(coordinator_address), attempts=attempts,
+            timeout_s=timeout)
+    raise BootstrapTimeout(coordinator_address, process_id,
+                           num_processes, attempts, timeout,
+                           cause=last)
+
+
+def barrier(name):
+    """Pod-wide barrier (``multihost_utils.sync_global_devices``);
+    no-op single-process. Emits a ``multihost`` ``barrier`` event."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    t0 = time.monotonic()
+    multihost_utils.sync_global_devices(name)
+    mh_emit('barrier', tag=name, world=jax.process_count(),
+            dur_s=round(time.monotonic() - t0, 6))
+
+
+def broadcast_int(name, value):
+    """Process 0's ``value`` on every process (int); identity
+    single-process. Used by the concurrent checkpoint path to agree on
+    a serial before any host writes a shard."""
+    import jax
+    if jax.process_count() <= 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+    out = multihost_utils.broadcast_one_to_all(
+        np.asarray(int(value), dtype=np.int64))
+    return int(np.asarray(out))
+
+
+def agreement_check(program=None, partitioner=None, extra=None,
+                    tag='startup'):
+    """Fail fast unless every host agrees on what it is about to run.
+
+    The local digest covers the program fingerprint (when given), the
+    partitioner's mesh identity + logical-axis rules (when given; the
+    global device count otherwise) and any ``extra`` value. Digests are
+    allgathered; hosts diverging from the majority (ties break toward
+    process 0) raise :class:`HostMismatch` naming the divergent ranks.
+    Returns the agreed digest hex. Single-process: local digest, no
+    sync."""
+    import jax
+    payload = []
+    if program is not None:
+        payload.append(('program', str(program.fingerprint())))
+    if partitioner is not None:
+        payload.append(('mesh', repr(partitioner.mesh_meta())))
+        payload.append(('rules', repr(partitioner.rules)))
+    else:
+        payload.append(('devices', str(len(jax.devices()))))
+    if extra is not None:
+        payload.append(('extra', repr(extra)))
+    digest = hashlib.sha256(repr(sorted(payload)).encode()).digest()[:16]
+    if jax.process_count() <= 1:
+        return digest.hex()
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(multihost_utils.process_allgather(
+        np.frombuffer(digest, dtype=np.uint8)))
+    hexes = [bytes(bytearray(gathered[i])).hex()
+             for i in range(gathered.shape[0])]
+    majority = max(hexes, key=lambda h: (hexes.count(h),
+                                         h == hexes[0]))
+    divergent = [i for i, h in enumerate(hexes) if h != majority]
+    if divergent:
+        mh_emit('agreement_fail', tag=tag, divergent=divergent,
+                digests=hexes)
+        raise HostMismatch(tag, divergent, hexes)
+    mh_emit('barrier', tag='agreement:%s' % tag, world=len(hexes),
+            digest=hexes[0][:12])
+    return hexes[0]

@@ -1,0 +1,68 @@
+"""Typed errors of the multi-host runtime (RESILIENCE.md "Surviving
+host loss").
+
+Every failure mode a pod launcher or a bootstrap handshake can hit has
+a named exception carrying the identifying facts (host id, coordinator
+address, divergent digests) — supervisors branch on TYPE, log messages
+stay for humans. ``BootstrapTimeout`` replaces the silent hang a
+worker used to sit in when the coordinator never came up.
+"""
+
+__all__ = ['MultihostError', 'BootstrapTimeout', 'HostMismatch',
+           'HostLost']
+
+
+class MultihostError(RuntimeError):
+    """Base of every multi-host runtime failure."""
+
+
+class BootstrapTimeout(MultihostError):
+    """jax.distributed.initialize could not reach (or barrier with)
+    the coordinator within the bounded handshake window."""
+
+    def __init__(self, coordinator, process_id, num_processes,
+                 attempts, timeout, cause=None):
+        self.coordinator = coordinator
+        self.process_id = int(process_id)
+        self.num_processes = int(num_processes)
+        self.attempts = int(attempts)
+        self.timeout = float(timeout)
+        self.cause = cause
+        super(BootstrapTimeout, self).__init__(
+            'multi-host bootstrap timed out: process %d/%d could not '
+            'join coordinator %s within %.1fs (%d attempt(s))%s'
+            % (self.process_id, self.num_processes, coordinator,
+               self.timeout, self.attempts,
+               '; last error: %r' % (cause,) if cause else ''))
+
+
+class HostMismatch(MultihostError):
+    """Cross-host agreement check failed: the named hosts computed a
+    different (program fingerprint, mesh, rules) digest than the rest
+    of the pod — running them together would wedge or silently diverge,
+    so the job fails fast instead."""
+
+    def __init__(self, tag, divergent, digests):
+        self.tag = tag
+        self.divergent = list(divergent)
+        self.digests = list(digests)
+        super(HostMismatch, self).__init__(
+            'multi-host agreement check %r failed: host(s) %s diverge '
+            'from the pod (digests: %s)'
+            % (tag, ', '.join(str(h) for h in self.divergent),
+               ', '.join('%d=%s' % (i, d[:12])
+                         for i, d in enumerate(self.digests))))
+
+
+class HostLost(MultihostError):
+    """A supervised host died (nonzero exit) or stalled (stale
+    heartbeat) — raised/recorded by the launcher supervisor."""
+
+    def __init__(self, host, reason, age=None):
+        self.host = int(host)
+        self.reason = reason
+        self.age = age
+        super(HostLost, self).__init__(
+            'host %d lost: %s%s' % (self.host, reason,
+                                    '' if age is None
+                                    else ' (heartbeat age %.2fs)' % age))

@@ -1,0 +1,38 @@
+"""``multihost`` journal events shared by workers AND the launcher.
+
+The in-process journal (observability.journal) covers one process; a
+pod is many. When ``PTPU_MULTIHOST_JOURNAL`` names a file on shared
+storage, every emit ALSO appends one JSON line there (open-append-close
+per record: O_APPEND writes under the pipe-buffer size are atomic, so
+concurrent writers interleave whole lines, never bytes). The merged
+stream is what ``tools/obs_report.py --require multihost`` gates on:
+bootstrap / barrier / host_lost / relaunch events across the whole pod
+in one place.
+"""
+import json
+import os
+import time
+
+from .. import observability as _obs
+
+__all__ = ['JOURNAL_ENV', 'mh_emit']
+
+JOURNAL_ENV = 'PTPU_MULTIHOST_JOURNAL'
+
+
+def mh_emit(action, **fields):
+    """Emit a ``multihost`` event into the in-process journal (if one
+    is installed) and the shared pod journal (if configured)."""
+    _obs.emit('multihost', action=action, **fields)
+    path = os.environ.get(JOURNAL_ENV)
+    if not path:
+        return
+    rec = {'ev': 'multihost', 'action': action, 'pid': os.getpid(),
+           'ts': round(time.time(), 6)}
+    rec.update(fields)
+    try:
+        with open(path, 'a') as f:
+            f.write(json.dumps(rec, sort_keys=True, default=repr)
+                    + '\n')
+    except OSError:
+        pass  # telemetry must never take down the run

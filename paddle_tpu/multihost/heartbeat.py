@@ -1,0 +1,172 @@
+"""Host heartbeats: mtime-based liveness files, stdlib-only
+(RESILIENCE.md "Surviving host loss").
+
+Each worker process runs a :class:`HeartbeatWriter` daemon thread that
+touches ``host_<id>.hb`` in a shared directory every interval; the
+launcher's :class:`HostMonitor` reads nothing but file mtimes, so the
+mechanism works over any shared filesystem and needs no sockets, no
+collectives and no cooperation from a wedged worker — a host stuck in
+a hung cross-host collective simply stops touching its file and ages
+out within the bounded window.
+
+Telemetry: ``host_heartbeat_age_seconds{host=...}`` gauge per scanned
+host and the ``multihost_peers`` gauge (hosts currently inside the
+window), both refreshed by :meth:`HostMonitor.scan`.
+"""
+import os
+import re
+import threading
+import time
+
+from .. import observability as _obs
+
+__all__ = ['DEFAULT_INTERVAL', 'heartbeat_path', 'HeartbeatWriter',
+           'HostMonitor', 'start_heartbeat', 'stop_heartbeat']
+
+DEFAULT_INTERVAL = 0.5
+_HB_RE = re.compile(r'^host_(\d+)\.hb$')
+
+
+def heartbeat_path(dirname, host_id):
+    return os.path.join(dirname, 'host_%03d.hb' % int(host_id))
+
+
+class HeartbeatWriter(object):
+    """Touches this host's heartbeat file every ``interval`` seconds
+    from a daemon thread. ``start`` writes the first beat inline so a
+    freshly spawned worker is visible before its first tick."""
+
+    def __init__(self, dirname, host_id, interval=DEFAULT_INTERVAL):
+        self.dirname = dirname
+        self.host_id = int(host_id)
+        self.interval = float(interval)
+        self.path = heartbeat_path(dirname, host_id)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def beat(self):
+        with open(self.path, 'w') as f:
+            f.write('%d %.6f\n' % (os.getpid(), time.time()))
+        # an explicit utime survives filesystems with coarse write
+        # timestamps
+        os.utime(self.path, None)
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        os.makedirs(self.dirname, exist_ok=True)
+        self.beat()
+
+        def _loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.beat()
+                except OSError:
+                    pass  # transient shared-fs hiccup: retry next tick
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name='ptpu-heartbeat')
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1.0)
+            self._thread = None
+
+
+class HostMonitor(object):
+    """Supervisor-side scanner: classifies every expected host as
+    alive, stale (heartbeat older than ``window``) or missing (no
+    heartbeat file yet). ``expected`` defaults to whatever host files
+    exist — pass the rank list for a launcher that must also notice a
+    worker that never wrote its first beat."""
+
+    def __init__(self, dirname, window=10.0, expected=None):
+        self.dirname = dirname
+        self.window = float(window)
+        self.expected = None if expected is None \
+            else sorted(int(h) for h in expected)
+        reg = _obs.default_registry()
+        self._g_peers = reg.gauge(
+            'multihost_peers',
+            'hosts currently inside the heartbeat window')
+        self._reg = reg
+
+    def ages(self, now=None):
+        """host id -> heartbeat age in seconds, for every host file
+        present in the directory."""
+        now = time.time() if now is None else now
+        out = {}
+        try:
+            names = os.listdir(self.dirname)
+        except OSError:
+            return out
+        for name in names:
+            m = _HB_RE.match(name)
+            if not m:
+                continue
+            try:
+                mtime = os.path.getmtime(
+                    os.path.join(self.dirname, name))
+            except OSError:
+                continue  # racing a concurrent rewrite
+            out[int(m.group(1))] = max(0.0, now - mtime)
+        return out
+
+    def scan(self, now=None):
+        """One supervision pass: ``{'alive': [...], 'stale': [...],
+        'missing': [...], 'ages': {host: age}}`` + gauge refresh."""
+        ages = self.ages(now=now)
+        expected = self.expected if self.expected is not None \
+            else sorted(ages)
+        alive, stale, missing = [], [], []
+        for h in expected:
+            age = ages.get(h)
+            if age is None:
+                missing.append(h)
+            elif age > self.window:
+                stale.append(h)
+            else:
+                alive.append(h)
+        for h, age in sorted(ages.items()):
+            self._reg.gauge(
+                'host_heartbeat_age_seconds',
+                'seconds since a host last touched its heartbeat',
+                host=str(h)).set(round(age, 6))
+        self._g_peers.set(len(alive))
+        return {'alive': alive, 'stale': stale, 'missing': missing,
+                'ages': ages}
+
+
+_WRITER = None
+
+
+def start_heartbeat(dirname=None, host_id=None, interval=None):
+    """Start (once) this process's heartbeat from explicit args or the
+    launcher-provided env (``PTPU_HB_DIR`` / ``PTPU_PROC_ID`` /
+    ``PTPU_HB_INTERVAL``). Returns the writer, or None when no
+    heartbeat directory is configured."""
+    global _WRITER
+    if _WRITER is not None:
+        return _WRITER
+    dirname = dirname if dirname is not None \
+        else os.environ.get('PTPU_HB_DIR')
+    if not dirname:
+        return None
+    host_id = int(host_id if host_id is not None
+                  else os.environ.get('PTPU_PROC_ID', 0))
+    interval = float(interval if interval is not None
+                     else os.environ.get('PTPU_HB_INTERVAL',
+                                         DEFAULT_INTERVAL))
+    _WRITER = HeartbeatWriter(dirname, host_id,
+                              interval=interval).start()
+    return _WRITER
+
+
+def stop_heartbeat():
+    global _WRITER
+    if _WRITER is not None:
+        _WRITER.stop()
+        _WRITER = None

@@ -1,0 +1,197 @@
+"""Pod launcher + supervisor: spawn N host processes, watch their
+heartbeats, survive whole-host loss (RESILIENCE.md "Surviving host
+loss").
+
+``launch`` is the engine behind ``tools/launch.py --nproc N``: it
+spawns one worker process per "host" (CPU host devices via
+``--xla_force_host_platform_device_count``), wires the rank/coordinator
+/heartbeat env contract every worker reads, and then SUPERVISES:
+
+- a worker exiting nonzero, or dying to a signal (kill -9), is caught
+  by ``Popen.poll`` within one poll interval;
+- a worker that is alive but WEDGED (stuck in a hung collective after a
+  peer died, or spinning) stops touching its heartbeat file and ages
+  past the bounded window (:class:`~.heartbeat.HostMonitor`).
+
+Either way the supervisor declares the host lost (``host_lost`` journal
+event with the detection latency), kills the remaining processes out of
+their now-hung collectives, and — when relaunches remain — starts a new
+GENERATION over the surviving host count with ``PTPU_RESUME=1``, so
+workers restore the newest healthy sharded checkpoint on the degraded
+mesh (``resilience.partitioner_for_manifest`` picks the mesh that fits
+the smaller world).
+
+Env contract exported to every worker (generation ``g``, rank ``r`` of
+``w``): ``PTPU_NPROC=w``, ``PTPU_PROC_ID=r``,
+``PTPU_COORD=host:port``, ``PTPU_HB_DIR``, ``PTPU_HB_INTERVAL``,
+``PTPU_GENERATION=g``, ``PADDLE_TPU_DISTRIBUTED=1`` and (g > 0)
+``PTPU_RESUME=1``.
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from .events import mh_emit
+from .heartbeat import DEFAULT_INTERVAL, HostMonitor
+
+__all__ = ['free_port', 'launch', 'LaunchResult']
+
+
+def free_port(host='127.0.0.1'):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class LaunchResult(object):
+    """Outcome of a (possibly multi-generation) launch: final exit
+    code, plus one record per generation (world size, failed hosts and
+    why, detection latency)."""
+
+    def __init__(self, returncode, generations):
+        self.returncode = int(returncode)
+        self.generations = generations
+
+    def __repr__(self):
+        return 'LaunchResult(rc=%d, generations=%r)' % (
+            self.returncode, self.generations)
+
+
+def _spawn(cmd, rank, world, gen, port, hb_dir, hb_interval,
+           devices_per_host, base_env, log_dir, extra_env):
+    env = dict(base_env)
+    env.update({
+        'PTPU_NPROC': str(world),
+        'PTPU_PROC_ID': str(rank),
+        'PTPU_TRAINER_ID': str(rank),
+        'PTPU_COORD': '127.0.0.1:%d' % port,
+        'PTPU_HB_DIR': hb_dir,
+        'PTPU_HB_INTERVAL': str(hb_interval),
+        'PTPU_GENERATION': str(gen),
+        'PADDLE_TPU_DISTRIBUTED': '1',
+    })
+    env.setdefault('JAX_PLATFORMS', 'cpu')
+    flags = env.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=%d'
+            % devices_per_host).strip()
+    if gen > 0:
+        env['PTPU_RESUME'] = '1'
+    env.update(extra_env or {})
+    out = None
+    if log_dir:
+        out = open(os.path.join(
+            log_dir, 'worker_g%d_r%d.log' % (gen, rank)), 'wb')
+    proc = subprocess.Popen(cmd, env=env, stdout=out,
+                            stderr=subprocess.STDOUT if out else None)
+    proc._ptpu_log = out
+    return proc
+
+
+def _kill_all(procs, grace=5.0):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+    for p in procs:
+        log = getattr(p, '_ptpu_log', None)
+        if log:
+            log.close()
+
+
+def launch(cmd, nproc, devices_per_host=1, heartbeat_window=10.0,
+           heartbeat_interval=DEFAULT_INTERVAL, poll_interval=0.2,
+           max_relaunches=0, startup_grace=180.0, workdir=None,
+           log_dir=None, env=None):
+    """Run ``cmd`` (argv list) as an ``nproc``-host pod; supervise;
+    optionally relaunch degraded. Returns a :class:`LaunchResult`.
+
+    ``max_relaunches`` > 0 makes the pod ELASTIC: each host loss spends
+    one relaunch and restarts the surviving count as a new generation
+    (workers see ``PTPU_RESUME=1`` and restore the newest checkpoint).
+    ``startup_grace`` bounds how long a worker may run before its FIRST
+    heartbeat (interpreter + jax import are slow; a missing file only
+    counts as a loss after the grace)."""
+    cmd = list(cmd)
+    base = workdir or log_dir or '.'
+    os.makedirs(base, exist_ok=True)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    world = int(nproc)
+    gen = 0
+    generations = []
+    while True:
+        port = free_port()
+        hb_dir = os.path.join(base, 'hb_gen%d' % gen)
+        os.makedirs(hb_dir, exist_ok=True)
+        procs = [_spawn(cmd, r, world, gen, port, hb_dir,
+                        heartbeat_interval, devices_per_host,
+                        os.environ, log_dir, env)
+                 for r in range(world)]
+        monitor = HostMonitor(hb_dir, window=heartbeat_window,
+                              expected=range(world))
+        spawn_t = time.monotonic()
+        last_alive = {r: spawn_t for r in range(world)}
+        record = {'generation': gen, 'world': world, 'failed': {}}
+        mh_emit('generation_start', generation=gen, world=world,
+                port=port)
+        failed = {}
+        while True:
+            time.sleep(poll_interval)
+            now = time.monotonic()
+            codes = [p.poll() for p in procs]
+            scan = monitor.scan()
+            for r, code in enumerate(codes):
+                if code is None:
+                    last_alive[r] = now
+                if r in scan['ages']:
+                    last_alive[r] = max(
+                        last_alive[r], now - scan['ages'][r])
+            for r, code in enumerate(codes):
+                if code is not None and code != 0 and r not in failed:
+                    failed[r] = ('exit:%s' % code, now - last_alive[r])
+            for r in scan['stale']:
+                # an exited-ok worker legitimately stops heartbeating
+                if codes[r] is None and r not in failed:
+                    failed[r] = ('heartbeat_stale:%.2fs'
+                                 % scan['ages'][r],
+                                 scan['ages'][r])
+            if now - spawn_t > startup_grace:
+                for r in scan['missing']:
+                    if codes[r] is None and r not in failed:
+                        failed[r] = ('heartbeat_missing', now - spawn_t)
+            if failed:
+                break
+            if all(code == 0 for code in codes):
+                generations.append(record)
+                mh_emit('generation_done', generation=gen, world=world)
+                _kill_all(procs)
+                return LaunchResult(0, generations)
+        for r, (reason, detect_s) in sorted(failed.items()):
+            record['failed'][r] = reason
+            mh_emit('host_lost', host=r, reason=reason,
+                    generation=gen, detect_s=round(detect_s, 6),
+                    window_s=heartbeat_window)
+        generations.append(record)
+        # survivors are (or will shortly be) wedged in collectives the
+        # dead host can never join: kill them out so the next
+        # generation starts from the checkpoint, not a hang
+        _kill_all(procs)
+        survivors = world - len(failed)
+        if gen >= max_relaunches or survivors < 1:
+            return LaunchResult(1, generations)
+        gen += 1
+        world = survivors
+        mh_emit('relaunch', generation=gen, world=world)

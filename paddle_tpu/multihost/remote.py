@@ -1,0 +1,374 @@
+"""Remote-process serving cells: a ModelServer living in ANOTHER
+process, proxied over a local socket so ``fleet.Router`` /
+``ReplicaSupervisor`` manage it unchanged (SERVING.md "Fleet tier").
+
+In-process replicas die with their thread; a HOST dies with all of its
+replicas at once. :func:`spawn_cell` starts a worker process running
+:func:`serve` (a plain ModelServer behind a length-prefixed pickle
+protocol on 127.0.0.1) and returns a :class:`RemoteCell` — an object
+with the cell surface the Router already speaks: ``submit`` returning
+a future-like request, ``health``, ``load_score``, ``load_model``,
+``warmup``, ``drain``, ``swap_model``, ``close``.
+
+Failure mapping is the point: when the worker process dies (kill -9 of
+a "host"), the proxy's reader thread sees the socket reset and fails
+every in-flight future with the typed ``ServerClosed`` — exactly the
+REQUEUEABLE error the fleet's requeue path expects — and ``health()``
+raises, so the supervisor marks the replica DEAD and rebuilds it
+through the factory (a fresh process). ``tools/chaos_bench.py
+--kill-host`` drives this end to end.
+
+The protocol is pickle over a loopback socket between processes of the
+SAME user on the SAME machine (the launcher owns both ends) — it is an
+IPC transport, not a network service; the listener binds 127.0.0.1 and
+accepts exactly one connection.
+"""
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from ..serving.errors import (DeadlineExceeded, ServerClosed,
+                              ServingError)
+
+__all__ = ['RemoteCell', 'RemoteRequest', 'spawn_cell', 'serve']
+
+_LEN = struct.Struct('>I')
+
+
+def _send_msg(sock, obj, lock):
+    blob = pickle.dumps(obj, protocol=4)
+    with lock:
+        sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_exact(sock, n):
+    buf = b''
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError('remote cell connection closed')
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# ---- worker side ---------------------------------------------------------
+def serve(port_file, place=None):
+    """Worker-process main loop: one ModelServer, one connection.
+
+    Binds 127.0.0.1:0, publishes the port atomically through
+    ``port_file``, serves requests until ``close`` or EOF. ``submit``
+    is asynchronous server-side too — a waiter thread replies when the
+    batch resolves, so one slow request never blocks control ops."""
+    from ..serving import ModelServer
+    srv = ModelServer(place=place)
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind(('127.0.0.1', 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    tmp = port_file + '.tmp'
+    with open(tmp, 'w') as f:
+        f.write('%d\n' % port)
+    os.rename(tmp, port_file)
+    conn, _ = lsock.accept()
+    lsock.close()
+    send_lock = threading.Lock()
+
+    def _reply(mid, ok, value):
+        try:
+            _send_msg(conn, {'id': mid, 'ok': ok, 'value': value},
+                      send_lock)
+        except (pickle.PicklingError, TypeError):
+            _send_msg(conn, {'id': mid, 'ok': False,
+                             'value': ServingError(repr(value))},
+                      send_lock)
+        except OSError:
+            pass  # client went away; nothing left to tell
+
+    def _wait_and_reply(mid, req, timeout):
+        try:
+            _reply(mid, True, req.result(timeout=timeout))
+        except Exception as e:  # noqa: BLE001 — forwarded typed
+            _reply(mid, False, e)
+
+    try:
+        while True:
+            try:
+                msg = _recv_msg(conn)
+            except (ConnectionError, OSError):
+                break
+            mid, op = msg['id'], msg['op']
+            args = msg.get('args', ())
+            kwargs = msg.get('kwargs', {})
+            if op == 'submit':
+                try:
+                    req = srv.submit(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001 — typed refusal
+                    _reply(mid, False, e)
+                    continue
+                timeout = kwargs.get('deadline') or 60.0
+                threading.Thread(
+                    target=_wait_and_reply, args=(mid, req, timeout),
+                    daemon=True).start()
+                continue
+            if op == 'ping':
+                _reply(mid, True, os.getpid())
+                continue
+            try:
+                value = getattr(srv, op)(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — forwarded typed
+                _reply(mid, False, e)
+                if op == 'close':
+                    break
+                continue
+            _reply(mid, True, value)
+            if op == 'close':
+                break
+    finally:
+        try:
+            srv.close(timeout=5.0)
+        except Exception:  # noqa: BLE001 — already closed
+            pass
+        conn.close()
+
+
+# ---- client side ---------------------------------------------------------
+class RemoteRequest(object):
+    """Future over a submit running in the remote cell. Raises the
+    forwarded typed error — a dead cell process fails it with
+    ``ServerClosed``, the fleet's requeueable error."""
+
+    __slots__ = ('_event', '_value', '_error')
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def _complete(self, ok, value):
+        if ok:
+            self._value = value
+        else:
+            self._error = value
+        self._event.set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded(
+                'remote cell request timed out after %ss' % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class RemoteCell(object):
+    """Client proxy with the replica-cell surface the Router speaks.
+    One reader thread demultiplexes replies; process death fails every
+    pending future with ServerClosed and makes ``health()`` raise."""
+
+    def __init__(self, proc, sock, name='remote-cell'):
+        self.proc = proc
+        self.name = name
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._pending = {}
+        self._next_id = 0
+        self._dead = None
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True,
+                                        name='ptpu-remote-cell')
+        self._reader.start()
+
+    @property
+    def pid(self):
+        return self.proc.pid
+
+    def _read_loop(self):
+        try:
+            while True:
+                msg = _recv_msg(self._sock)
+                with self._lock:
+                    req = self._pending.pop(msg['id'], None)
+                if req is not None:
+                    req._complete(msg['ok'], msg['value'])
+        except (ConnectionError, OSError, pickle.UnpicklingError,
+                EOFError) as e:
+            self._fail_all(ServerClosed(
+                'remote cell %r process died: %r' % (self.name, e)))
+
+    def _fail_all(self, error):
+        with self._lock:
+            if self._dead is None:
+                self._dead = error
+            pending, self._pending = self._pending, {}
+        for req in pending.values():
+            req._complete(False, error)
+
+    def _post(self, op, args, kwargs):
+        with self._lock:
+            if self._dead is not None:
+                raise self._dead
+            self._next_id += 1
+            mid = self._next_id
+            req = RemoteRequest()
+            self._pending[mid] = req
+        try:
+            _send_msg(self._sock, {'id': mid, 'op': op, 'args': args,
+                                   'kwargs': kwargs}, self._send_lock)
+        except (OSError, ConnectionError) as e:
+            err = ServerClosed('remote cell %r unreachable: %r'
+                               % (self.name, e))
+            self._fail_all(err)
+            raise err
+        return req
+
+    def _call(self, op, *args, **kwargs):
+        timeout = kwargs.pop('_timeout', 120.0)
+        return self._post(op, args, kwargs).result(timeout=timeout)
+
+    # ---- the cell surface the Router drives ----------------------------
+    def submit(self, name, feeds, deadline=None, **kwargs):
+        return self._post('submit', (name, feeds),
+                          dict(kwargs, deadline=deadline))
+
+    def infer(self, name, feeds, deadline=None, timeout=30.0):
+        return self.submit(name, feeds,
+                           deadline=deadline).result(timeout=timeout)
+
+    def health(self):
+        return self._call('health', _timeout=10.0)
+
+    def load_score(self, model_name=None):
+        try:
+            return self._call('load_score', model_name, _timeout=10.0)
+        except ServerClosed:
+            return float('inf')  # unroutable, not an exception path
+
+    def load_model(self, name, dirname, model_filename=None,
+                   params_filename=None):
+        return self._call('load_model', name, dirname,
+                          model_filename=model_filename,
+                          params_filename=params_filename)
+
+    def swap_model(self, name, dirname, model_filename=None,
+                   params_filename=None):
+        return self._call('swap_model', name, dirname,
+                          model_filename=model_filename,
+                          params_filename=params_filename)
+
+    def unload_model(self, name, timeout=None):
+        return self._call('unload_model', name, timeout=timeout)
+
+    def drain(self, name, timeout=None):
+        return self._call('drain', name, timeout=timeout)
+
+    def warmup(self, model_name=None, upto=None, timeout=300.0):
+        return self._call('warmup', model_name, upto=upto,
+                          timeout=timeout, _timeout=timeout + 10.0)
+
+    def queue_depth(self, model_name):
+        return self._call('queue_depth', model_name, _timeout=10.0)
+
+    def models(self):
+        return self._call('models', _timeout=10.0)
+
+    def close(self, timeout=30.0):
+        try:
+            self._call('close', timeout=timeout,
+                       _timeout=max(1.0, timeout) + 5.0)
+        except (ServerClosed, DeadlineExceeded):
+            pass  # already gone — close converges either way
+        try:
+            self.proc.wait(timeout=max(1.0, timeout))
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        self._fail_all(ServerClosed('remote cell %r closed'
+                                    % self.name))
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def kill(self):
+        """Chaos hook: SIGKILL the whole cell process — the remote
+        analogue of killing a host."""
+        self.proc.kill()
+        self.proc.wait()
+
+
+def spawn_cell(name='remote-cell', devices=1, env=None,
+               startup_timeout=180.0):
+    """Start a cell worker process and connect to it. The child forces
+    the CPU backend with ``devices`` host devices (same recipe as the
+    test workers); the parent blocks until the port file appears."""
+    workdir = tempfile.mkdtemp(prefix='ptpu_cell_')
+    port_file = os.path.join(workdir, 'port')
+    child_env = dict(os.environ)
+    child_env.update(env or {})
+    child_env.setdefault('JAX_PLATFORMS', 'cpu')
+    flags = child_env.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        child_env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=%d'
+            % devices).strip()
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    child_env['PYTHONPATH'] = os.pathsep.join(
+        [root] + [p for p in
+                  child_env.get('PYTHONPATH', '').split(os.pathsep)
+                  if p])
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'paddle_tpu.multihost.remote',
+         '--port-file', port_file], env=child_env)
+    deadline = time.monotonic() + startup_timeout
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise ServerClosed(
+                'remote cell %r exited rc=%s before publishing its '
+                'port' % (name, proc.returncode))
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise ServerClosed(
+                'remote cell %r did not come up within %.0fs'
+                % (name, startup_timeout))
+        time.sleep(0.05)
+    with open(port_file) as f:
+        port = int(f.read().strip())
+    sock = socket.create_connection(('127.0.0.1', port), timeout=30.0)
+    sock.settimeout(None)
+    return RemoteCell(proc, sock, name=name)
+
+
+def _main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(
+        description='paddle_tpu remote serving cell worker')
+    parser.add_argument('--port-file', required=True)
+    args = parser.parse_args(argv)
+    serve(args.port_file)
+    return 0
+
+
+if __name__ == '__main__':
+    # force the CPU backend BEFORE any jax backend initialization (the
+    # image's sitecustomize pins a TPU plugin platform)
+    import jax
+
+    jax.config.update('jax_platforms',
+                      os.environ.get('JAX_PLATFORMS', 'cpu') or 'cpu')
+    sys.exit(_main())

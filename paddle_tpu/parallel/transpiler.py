@@ -38,6 +38,13 @@ class DistributeTranspiler(object):
     def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
                   trainers=1, sync_mode=True, split_method=None,
                   slice_var_up=True, zero_stage=None, bucket_bytes=None):
+        if trainers < 1:
+            raise ValueError('trainers must be >= 1, got %d' % trainers)
+        if not 0 <= trainer_id < trainers:
+            raise ValueError(
+                'trainer_id must be in [0, %d) but is %d — every '
+                'launched trainer process needs a distinct id below '
+                'the trainer count' % (trainers, trainer_id))
         self.trainer_id = trainer_id
         self.trainers = trainers
         self.pserver_endpoints = [e for e in pservers.split(",") if e]
@@ -58,12 +65,15 @@ class DistributeTranspiler(object):
         # Multi-host bootstrap: one process per trainer. The coordinator is
         # the first pserver endpoint (reused as the JAX coordination
         # service address); single-process setups skip initialization.
+        # multihost.initialize bounds the handshake: an unreachable
+        # coordinator raises a typed BootstrapTimeout after a few
+        # retried attempts instead of hanging this trainer forever.
         if trainers > 1 and os.environ.get('PADDLE_TPU_DISTRIBUTED', '0') \
                 == '1':
-            import jax
-            jax.distributed.initialize(
-                coordinator_address=self.pserver_endpoints[0],
-                num_processes=trainers, process_id=trainer_id)
+            from ..multihost import initialize as _mh_initialize
+            _mh_initialize(self.pserver_endpoints[0],
+                           num_processes=trainers,
+                           process_id=trainer_id)
         if slice_var_up:
             self._slice_optimizer_state(zero_stage=zero_stage,
                                         bucket_bytes=bucket_bytes)

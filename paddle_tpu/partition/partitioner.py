@@ -433,7 +433,13 @@ class Partitioner(object):
         """Stage a feed dict/pytree for dispatch: batch-dim sharded
         over the mesh (prefetch staging on the ParallelExecutor path —
         the PR-5 clamp replaced by this call), plain device_put on the
-        fallback."""
+        fallback. Multi-process feeds stay HOST-side: a device_put onto
+        a process-spanning NamedSharding from local data is invalid —
+        dispatch-time :meth:`globalize`
+        (make_array_from_process_local_data) is the one correct
+        placement there, and it accepts host shards directly."""
+        if self.active and self.multiprocess:
+            return feed
         t0 = time.perf_counter()
         if not self.active:
             out = jax.device_put(feed, self.device)
@@ -467,8 +473,19 @@ class Partitioner(object):
         device_put with its resolved sharding (replicated by default;
         mp/dp-annotated weights land sharded). This is how a
         ModelServer loads a model bigger than one chip. Returns the
-        number of vars placed."""
+        number of vars placed.
+
+        Multi-process: restored host state stays put — device_put onto
+        a process-spanning sharding from one process's host copy is
+        invalid; the next dispatch's :meth:`globalize` places it (every
+        process holds the full value after a checkpoint load, which is
+        exactly globalize's state contract)."""
         from ..lod import SequenceTensor
+        if self.active and self.multiprocess:
+            _obs.emit('partition', action='shard_scope_deferred',
+                      mesh=_mesh_desc(self.mesh),
+                      reason='multiprocess: globalize at dispatch')
+            return 0
         t0 = time.perf_counter()
         count = 0
         seen = set()

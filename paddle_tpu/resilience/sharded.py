@@ -27,7 +27,9 @@ from .checkpoint import tensor_crc32
 
 __all__ = ['SHARD_DIR', 'resolve_spec', 'shard_layout', 'shard_state',
            'write_state', 'load_state', 'assemble_tensor',
-           'verify_tensors', 'spec_signature']
+           'verify_tensors', 'spec_signature',
+           'write_state_multiprocess', 'merge_partial_tables',
+           'PARTIAL_MANIFEST_FMT']
 
 # payload files live under <serial_dir>/shards/; the name encodes the
 # tensor ordinal, not the tensor name (var names like `fc_0.w_0@GRAD`
@@ -175,6 +177,118 @@ def write_state(dirname, state, dtypes=None):
             'shards': entries,
         }
     return tensors
+
+
+PARTIAL_MANIFEST_FMT = 'partial_manifest_%03d.json'
+
+
+def _global_shard_owners(val):
+    """The GLOBAL shard table of a jax array: sorted unique bounds
+    across every device of its sharding (addressable or not), each
+    with the owning device — the lowest device id holding identical
+    bounds. Every process computes the SAME table from the sharding
+    alone, so concurrent multi-host writers agree on shard ordinals
+    and on who writes what without any extra coordination; replicated
+    arrays dedupe to one full shard owned by the host of device 0."""
+    shape = tuple(int(s) for s in np.shape(val))
+    imap = val.sharding.devices_indices_map(shape)
+    owners = {}
+    for dev, idx in imap.items():
+        bounds = tuple(tuple(int(x) for x in b)
+                       for b in _normalize_index(idx, shape))
+        cur = owners.get(bounds)
+        if cur is None or dev.id < cur.id:
+            owners[bounds] = dev
+    return sorted(owners.items())
+
+
+def shard_state_local(state, process_index):
+    """Multi-process twin of :func:`shard_state`: every process yields
+    the same global ``(name, spec, bounds)`` plan; ``extract`` is None
+    for shards another process owns. Host values and fully-addressable
+    arrays are logically replicated across the pod — process 0 writes
+    the single copy."""
+    import jax
+    for name in sorted(state):
+        val = state[name]
+        shape = tuple(int(s) for s in np.shape(val))
+        if isinstance(val, jax.Array) and not val.is_fully_addressable:
+            local = {}
+            for sh in val.addressable_shards:
+                b = tuple(tuple(int(x) for x in bb)
+                          for bb in _normalize_index(sh.index, shape))
+                local.setdefault(b, sh)
+            shards = []
+            for bounds, dev in _global_shard_owners(val):
+                if int(dev.process_index) == int(process_index):
+                    sh = local[bounds]
+                    shards.append(
+                        ([list(b) for b in bounds],
+                         (lambda s=sh: np.asarray(s.data))))
+                else:
+                    shards.append(([list(b) for b in bounds], None))
+            yield name, val, _array_spec(val), shards
+        else:
+            bounds = [[0, s] for s in shape]
+            extract = (lambda v=val: np.asarray(v)) \
+                if int(process_index) == 0 else None
+            yield name, val, [None] * len(shape), [(bounds, extract)]
+
+
+def write_state_multiprocess(dirname, state, process_index,
+                             dtypes=None):
+    """Concurrent multi-host payload write: THIS process writes only
+    the shards it owns (file names carry the globally agreed tensor +
+    shard ordinals, so writers can never collide) and returns its
+    PARTIAL manifest tensors table — shape/dtype/spec for every
+    tensor, shard entries only for locally written files. Process 0
+    merges the partials with :func:`merge_partial_tables` after a
+    barrier and alone writes the manifest."""
+    import jax
+    shard_root = os.path.join(dirname, SHARD_DIR)
+    os.makedirs(shard_root, exist_ok=True)
+    tensors = {}
+    for t_idx, (name, val, spec, shards) in enumerate(
+            shard_state_local(state, process_index)):
+        entries = []
+        dtype = str(np.dtype(val.dtype)) if isinstance(val, jax.Array) \
+            else str(np.asarray(val).dtype)
+        for s_idx, (bounds, extract) in enumerate(shards):
+            if extract is None:
+                continue          # another host owns (and writes) it
+            arr = extract()
+            dtype = str(arr.dtype)
+            rel = '%s/t%04d_s%03d.npy' % (SHARD_DIR, t_idx, s_idx)
+            np.save(os.path.join(dirname, rel), arr,
+                    allow_pickle=False)
+            entries.append({'file': rel, 'index': bounds,
+                            'crc32': tensor_crc32(arr)})
+        tensors[name] = {
+            'shape': [int(s) for s in np.shape(val)],
+            'dtype': (dtypes or {}).get(name, dtype),
+            'spec': spec,
+            'shards': entries,
+        }
+    return tensors
+
+
+def merge_partial_tables(parts):
+    """Union of per-process partial tensor tables into one manifest
+    table (shard entries sorted by file so the merge is order-stable
+    regardless of which process's partial arrives first)."""
+    out = {}
+    for tab in parts:
+        for name, meta in (tab or {}).items():
+            cur = out.get(name)
+            if cur is None:
+                cur = {'shape': meta['shape'], 'dtype': meta['dtype'],
+                       'spec': meta['spec'], 'shards': []}
+                out[name] = cur
+            cur['shards'].extend(meta['shards'])
+    for meta in out.values():
+        meta['shards'] = sorted(meta['shards'],
+                                key=lambda e: e['file'])
+    return out
 
 
 def write_resharded(dirname, state, specs, axes, extents, rules=None):

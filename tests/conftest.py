@@ -89,3 +89,9 @@ def pytest_configure(config):
         'reduce-scatter gradient tail, sharded optimizer update, '
         'replicated-path bit-exactness, chained-dispatch overlap '
         '(tier-1; filter with -m "not zero")')
+    config.addinivalue_line(
+        'markers',
+        'multihost: tests of the multi-host elastic runtime — pod '
+        'launcher, bounded bootstrap handshake, cross-host agreement, '
+        'heartbeat host-loss detection, degraded relaunch + bit-exact '
+        'resume (tier-1; filter with -m "not multihost")')

@@ -335,6 +335,139 @@ def _run_wedge_phase(fluid, artifact, problems, mesh=1):
     return result
 
 
+def run_kill_host(n_requests=12, seed=3, replicas=2,
+                  detect_window=5.0, poll_interval=0.1):
+    """Whole-host-loss chaos for the fleet tier (RESILIENCE.md
+    "Surviving host loss"): every replica is a ModelServer living in
+    its OWN process (``multihost.remote.spawn_cell``). Mid-stream one
+    cell process is killed with SIGKILL — the remote analogue of losing
+    a host and every replica on it at once. Invariants:
+
+    - every in-flight request resolves ok or with a typed error; the
+      requeue path re-runs them on the surviving cell and every
+      delivered output is bit-identical to the fault-free reference;
+    - the fleet detects the dead host within ``detect_window`` seconds
+      (supervisor poll or a client requeue, whichever is first);
+    - the supervisor rebuilds the replica through the factory — a NEW
+      process — and the rebuilt cell serves bit-identical outputs.
+    """
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fleet import Router
+    from paddle_tpu.fleet.router import ACTIVE
+    from paddle_tpu.multihost.remote import spawn_cell
+    from paddle_tpu.serving import ServingError
+
+    problems = []
+    rng = np.random.RandomState(seed)
+    inputs = [rng.randn(int(rng.randint(1, 5)),
+                        IN_DIM).astype('float32')
+              for _ in range(n_requests)]
+    with tempfile.TemporaryDirectory(prefix='chaos_kill_') as workdir:
+        artifact = _build_artifact(workdir)
+        reference = _reference_fn(artifact)
+        expected = [reference(x) for x in inputs]
+
+        router = Router(lambda rid: spawn_cell('cell-%d' % rid),
+                        replicas=replicas, supervise=True,
+                        poll_interval=poll_interval, requeue_wait=60.0)
+        result = {'killed_replica': None, 'killed_pid': None,
+                  'detect_seconds': None, 'restart_seconds': None,
+                  'restarted_pid': None, 'requeues': 0,
+                  'outputs_bit_identical': 0, 'typed_errors': 0}
+        try:
+            router.load_model('m', artifact)
+            victim = router.placement('m')[0]
+            result['killed_replica'] = victim
+            result['killed_pid'] = router.replica(victim).server.pid
+
+            pending = []
+            for i, x in enumerate(inputs):
+                pending.append((i, router.submit('m', {'x': x},
+                                                 deadline=120.0)))
+            # the kill must land on live work: top up until the victim
+            # holds an unresolved request
+            for extra in range(64):
+                if any(r.replica_id == victim and not r.done()
+                       for _i, r in pending):
+                    break
+                j = extra % len(inputs)
+                pending.append((j, router.submit('m',
+                                                 {'x': inputs[j]},
+                                                 deadline=120.0)))
+            else:
+                problems.append('could not land an in-flight request '
+                                'on the victim replica')
+            # SIGKILL the whole cell process: host loss takes down the
+            # replica AND every batch in flight on it
+            t_kill = time.monotonic()
+            router.replica(victim).server.kill()
+            for i, req in pending:
+                try:
+                    out, = req.result(timeout=120.0)
+                except ServingError as e:
+                    result['typed_errors'] += 1
+                    problems.append('request %d resolved with typed '
+                                    'error %r (expected requeue to '
+                                    'deliver it)' % (i, e))
+                    continue
+                except Exception as e:  # noqa: BLE001 — judged here
+                    problems.append('request %d failed UNTYPED: %r'
+                                    % (i, e))
+                    continue
+                if np.array_equal(np.asarray(out), expected[i]):
+                    result['outputs_bit_identical'] += 1
+                else:
+                    problems.append('request %d: output differs from '
+                                    'the fault-free reference' % i)
+            result['requeues'] = sum(
+                1 for _i, req in pending if req.requeues)
+
+            # detection: the victim must leave ACTIVE within the window
+            give_up = t_kill + detect_window
+            rep = router.replica(victim)
+            while time.monotonic() < give_up:
+                if rep.state != ACTIVE or rep.restarts > 0:
+                    result['detect_seconds'] = \
+                        time.monotonic() - t_kill
+                    break
+                time.sleep(0.01)
+            if result['detect_seconds'] is None:
+                problems.append(
+                    'dead host never detected within %.1fs'
+                    % detect_window)
+
+            # recovery: the supervisor rebuilds the cell (new process)
+            give_up = time.monotonic() + 180.0
+            while time.monotonic() < give_up:
+                if rep.restarts > 0 and rep.state == ACTIVE:
+                    result['restart_seconds'] = \
+                        time.monotonic() - t_kill
+                    break
+                time.sleep(0.05)
+            if result['restart_seconds'] is None:
+                problems.append('replica never rebuilt within 180s')
+            else:
+                result['restarted_pid'] = rep.server.pid
+                if result['restarted_pid'] == result['killed_pid']:
+                    problems.append('rebuilt replica reuses the dead '
+                                    'pid %s' % result['killed_pid'])
+                for i in (0, len(inputs) - 1):
+                    out, = rep.server.infer('m', {'x': inputs[i]},
+                                            timeout=120.0)
+                    if not np.array_equal(np.asarray(out),
+                                          expected[i]):
+                        problems.append(
+                            'rebuilt replica output %d differs from '
+                            'the fault-free reference' % i)
+            if result['requeues'] < 1:
+                problems.append('no request was requeued — the kill '
+                                'landed on an idle stream?')
+        finally:
+            router.close(timeout=10.0)
+    result['problems'] = problems
+    return result
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     ap.add_argument('--requests', type=int, default=48)
@@ -351,9 +484,45 @@ def main(argv=None):
                          'guardrail invariant breaks')
     ap.add_argument('--no-hang-phase', action='store_true',
                     help='skip the wedged-worker/close(timeout) phase')
+    ap.add_argument('--kill-host', action='store_true',
+                    help='whole-host-loss phase: replicas in separate '
+                         'processes, one SIGKILLed mid-stream; the '
+                         'fleet must requeue, rebuild and recover '
+                         'bit-identically')
+    ap.add_argument('--detect-window', type=float, default=5.0,
+                    help='--kill-host: max seconds to detect the dead '
+                         'host')
     ap.add_argument('--json', default=None,
                     help='write the full result dict to this path')
     args = ap.parse_args(argv)
+    if args.kill_host:
+        _force_cpu()
+        results = run_kill_host(
+            n_requests=12 if args.smoke else args.requests,
+            seed=args.seed, detect_window=args.detect_window)
+        if args.json:
+            with open(args.json, 'w') as f:
+                json.dump(results, f, indent=2, sort_keys=True,
+                          default=repr)
+        print('kill-host: replica %s (pid %s) SIGKILLed | detected in '
+              '%s | rebuilt as pid %s in %s | %d requeued, '
+              '%d bit-identical outputs'
+              % (results['killed_replica'], results['killed_pid'],
+                 '%.3fs' % results['detect_seconds']
+                 if results['detect_seconds'] is not None else 'NEVER',
+                 results['restarted_pid'],
+                 '%.1fs' % results['restart_seconds']
+                 if results['restart_seconds'] is not None else 'NEVER',
+                 results['requeues'],
+                 results['outputs_bit_identical']))
+        if results['problems']:
+            print('KILL-HOST INVARIANTS BROKEN:', file=sys.stderr)
+            for p in results['problems']:
+                print('  - %s' % p, file=sys.stderr)
+            return 1
+        print('kill-host OK (whole-host loss detected, requeued, '
+              'rebuilt bit-identically)')
+        return 0
     if args.mesh > 1 and 'xla_force_host_platform_device_count' not in \
             os.environ.get('XLA_FLAGS', ''):
         # must land before jax initializes (first import below)

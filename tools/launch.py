@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Multi-host pod launcher (RESILIENCE.md "Surviving host loss",
+PARTITIONING.md "Multi-host meshes").
+
+Spawns one worker process per "host" on host CPU devices, wires the
+coordinator/rank/heartbeat env contract, and supervises: a host that
+exits nonzero, dies to a signal, or goes heartbeat-stale within the
+bounded window is declared lost; surviving processes are killed out of
+their hung collectives; with ``--elastic N`` the pod relaunches up to
+N degraded generations that resume from the newest sharded checkpoint
+(workers see ``PTPU_RESUME=1``).
+
+Quickstart (2-host data-parallel training of train.py)::
+
+    python tools/launch.py --nproc 2 -- python train.py --epochs 3
+
+Worker env contract (generation g, rank r of w): PTPU_NPROC=w,
+PTPU_PROC_ID=r, PTPU_COORD=host:port, PTPU_HB_DIR, PTPU_HB_INTERVAL,
+PTPU_GENERATION=g, PADDLE_TPU_DISTRIBUTED=1, and PTPU_RESUME=1 when
+g > 0. A worker bootstraps by calling
+``DistributeTranspiler().transpile(trainer_id=int(os.environ[
+'PTPU_PROC_ID']), trainers=int(os.environ['PTPU_NPROC']),
+pservers=os.environ['PTPU_COORD'])`` — the reference-compatible
+surface — or ``paddle_tpu.multihost.initialize`` directly.
+
+Exit code: 0 when a generation completes with every worker at rc 0;
+1 when the pod failed and no relaunch budget (or no survivor) remains.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='launch + supervise an N-host paddle_tpu pod',
+        epilog='everything after -- (or the first positional) is the '
+               'worker command, run once per host')
+    parser.add_argument('--nproc', type=int, required=True,
+                        help='host (process) count of generation 0')
+    parser.add_argument('--devices-per-host', type=int, default=1,
+                        help='virtual CPU devices per host process '
+                             '(xla_force_host_platform_device_count)')
+    parser.add_argument('--heartbeat-window', type=float, default=10.0,
+                        help='seconds without a heartbeat before a '
+                             'live process counts as stalled')
+    parser.add_argument('--heartbeat-interval', type=float,
+                        default=0.5)
+    parser.add_argument('--poll-interval', type=float, default=0.2)
+    parser.add_argument('--elastic', type=int, default=0,
+                        metavar='RELAUNCHES',
+                        help='max degraded relaunches after host '
+                             'losses (0 = fail on first loss)')
+    parser.add_argument('--startup-grace', type=float, default=180.0,
+                        help='seconds a worker may run before its '
+                             'first heartbeat')
+    parser.add_argument('--workdir', default=None,
+                        help='scratch dir for heartbeat files '
+                             '(default: --log-dir or .)')
+    parser.add_argument('--log-dir', default=None,
+                        help='per-worker stdout/stderr log files '
+                             '(worker_g<gen>_r<rank>.log)')
+    parser.add_argument('--journal', default=None,
+                        help='shared multihost JSONL journal '
+                             '(launcher + all workers append; feed to '
+                             'tools/obs_report.py --require multihost)')
+    parser.add_argument('--json', action='store_true',
+                        help='print the launch record as JSON')
+    parser.add_argument('cmd', nargs=argparse.REMAINDER,
+                        help='worker command (prefix with --)')
+    args = parser.parse_args(argv)
+    cmd = [c for c in args.cmd if c != '--'] or None
+    if not cmd:
+        parser.error('no worker command given')
+    if args.nproc < 1:
+        parser.error('--nproc must be >= 1')
+    if args.journal:
+        import time
+        import uuid
+
+        from paddle_tpu.multihost import JOURNAL_ENV
+        from paddle_tpu.observability.journal import SCHEMA_VERSION
+        path = os.path.abspath(args.journal)
+        # fresh journal per launch, opened with the same run_begin
+        # header every RunJournal carries so obs_report --smoke accepts
+        # the launcher+worker-appended stream as a well-formed journal
+        with open(path, 'w') as f:
+            f.write(json.dumps(
+                {'ev': 'run_begin', 'run': uuid.uuid4().hex[:12],
+                 't': 0.0, 'wall': time.time(), 'pid': os.getpid(),
+                 'schema': SCHEMA_VERSION, 'launcher': 'multihost'},
+                separators=(',', ':')) + '\n')
+        os.environ[JOURNAL_ENV] = path
+    from paddle_tpu.multihost import launch
+    result = launch(
+        cmd, args.nproc, devices_per_host=args.devices_per_host,
+        heartbeat_window=args.heartbeat_window,
+        heartbeat_interval=args.heartbeat_interval,
+        poll_interval=args.poll_interval,
+        max_relaunches=args.elastic,
+        startup_grace=args.startup_grace,
+        workdir=args.workdir, log_dir=args.log_dir)
+    record = {'returncode': result.returncode,
+              'generations': result.generations}
+    if args.json:
+        print(json.dumps(record, indent=2, sort_keys=True))
+    else:
+        for g in result.generations:
+            state = 'completed' if not g['failed'] else \
+                'lost host(s) %s' % sorted(g['failed'])
+            print('[launch] generation %d (world=%d): %s'
+                  % (g['generation'], g['world'], state))
+        print('[launch] exit %d' % result.returncode)
+    return result.returncode
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -46,6 +46,11 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # (bucketed grad tail / sliced state — PERF.md "ZeRO-2
                # and collective overlap") or a measured collective
                'zero': ('zero', 'collective'),
+               # a multi-host pod must show bootstrap/barrier/host_lost
+               # /relaunch lifecycle events (RESILIENCE.md "Surviving
+               # host loss"); the gate also checks every host_lost was
+               # detected inside its heartbeat window
+               'multihost': 'multihost',
                'any': None}
 
 
@@ -222,6 +227,42 @@ def _zero_summary(by_ev):
     }
 
 
+def _multihost_summary(by_ev):
+    """Multi-host SLI (RESILIENCE.md "Surviving host loss"): pod
+    lifecycle from ``multihost`` events — bootstraps per host,
+    barriers/agreement checks, whole-host losses with their detection
+    latency against the heartbeat window, degraded relaunches."""
+    events = by_ev.get('multihost', ())
+    actions = {}
+    for r in events:
+        actions[r.get('action', '?')] = \
+            actions.get(r.get('action', '?'), 0) + 1
+    losses = [r for r in events if r.get('action') == 'host_lost']
+    detects = [r['detect_s'] for r in losses if 'detect_s' in r]
+    relaunches = [r for r in events if r.get('action') == 'relaunch']
+    boots = [r for r in events if r.get('action') == 'bootstrap']
+    return {
+        'events': len(events),
+        'actions': actions,
+        'bootstraps': len(boots),
+        'world': max((r.get('world', 0) for r in boots), default=0),
+        'barriers': actions.get('barrier', 0),
+        'agreement_failures': actions.get('agreement_fail', 0),
+        'hosts_lost': len(losses),
+        'loss_reasons': sorted({str(r.get('reason', '?'))
+                                for r in losses}),
+        'detect_max_s': max(detects) if detects else None,
+        'detect_mean_s': _mean(detects) if detects else None,
+        'losses_outside_window': sum(
+            1 for r in losses
+            if 'detect_s' in r and 'window_s' in r
+            and r['detect_s'] > r['window_s']),
+        'relaunches': len(relaunches),
+        'final_world': relaunches[-1].get('world') if relaunches
+        else (max((r.get('world', 0) for r in boots), default=None)),
+    }
+
+
 def _fleet_summary(by_ev):
     """Fleet SLI (SERVING.md "Fleet tier & continuous batching"):
     replica lifecycle (quarantines, kills, restarts, swaps) from
@@ -323,6 +364,7 @@ def summarize(records, malformed=0):
         'partition': _partition_summary(by_ev),
         'resilience': _resilience_summary(by_ev),
         'fleet': _fleet_summary(by_ev),
+        'multihost': _multihost_summary(by_ev),
         'zero': _zero_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
@@ -470,6 +512,25 @@ def render(summary, top=10):
                    100.0 * dc['mean_occupancy'],
                    100.0 * dc['min_occupancy'], dc['admitted'],
                    dc['retired']))
+    mh = s.get('multihost') or {}
+    if mh.get('events'):
+        line = ('multihost: %d hosts bootstrapped | %d barriers, '
+                '%d agreement failure(s) | %d host(s) lost, '
+                '%d relaunch(es)'
+                % (mh['bootstraps'], mh['barriers'],
+                   mh['agreement_failures'], mh['hosts_lost'],
+                   mh['relaunches']))
+        lines.append(line)
+        if mh['hosts_lost']:
+            lines.append(
+                '  loss detection: mean %.3fs max %.3fs (%d outside '
+                'the heartbeat window) | reasons: %s'
+                % (mh['detect_mean_s'] or 0.0, mh['detect_max_s']
+                   or 0.0, mh['losses_outside_window'],
+                   ', '.join(mh['loss_reasons']) or '-'))
+        if mh['relaunches']:
+            lines.append('  degraded to world=%s after relaunch'
+                         % mh['final_world'])
     if s['anomalies']:
         lines.append('anomaly:  %d guard trips' % s['anomalies'])
     lines.append('events:   %s' % ', '.join(
@@ -519,6 +580,19 @@ def check_journal(path, require='step'):
                     'journal contains zero step_end records with '
                     'pipeline fields (feed_wait) — was the run made '
                     'with a pre-pipelining trainer?')
+    if require == 'multihost':
+        # a host loss the monitor only noticed after its own heartbeat
+        # window means detection is broken even if recovery worked
+        for r in records:
+            if (r['ev'] == 'multihost'
+                    and r.get('action') == 'host_lost'
+                    and 'detect_s' in r and 'window_s' in r
+                    and float(r['detect_s']) > float(r['window_s'])):
+                problems.append(
+                    'host %s loss detected after %.2fs — outside its '
+                    '%.2fs heartbeat window'
+                    % (r.get('host'), float(r['detect_s']),
+                       float(r['window_s'])))
     return problems
 
 
