@@ -72,6 +72,22 @@ class DeadOpElimination(Pass):
 
     name = 'dead_op_elim'
 
+    def _forced_keep(self, block, op):
+        """Liveness aside, must this op survive? Side effects, feed/
+        fetch, RNG stream consumers, sub-block carriers, attr-only
+        definers, persistable writers. The sanitizer's
+        side-effect-preserved invariant is exactly this predicate's
+        contract — tests seed mutations here."""
+        if (op.type in SIDE_EFFECT_OPS or op.type in _ALWAYS_KEEP
+                or op.type in RNG_OPS or _has_sub_block(op)
+                or not op.output_arg_names):
+            return True
+        for nm in op.output_arg_names:
+            var = block._find_var_recursive(nm)
+            if var is not None and var.persistable:
+                return True
+        return False
+
     def run(self, program, ctx):
         res = PassResult(self.name)
         if not ctx.protected:
@@ -85,17 +101,7 @@ class DeadOpElimination(Pass):
         keep = [False] * len(ops)
         for i in reversed(range(len(ops))):
             op = ops[i]
-            forced = (op.type in SIDE_EFFECT_OPS
-                      or op.type in _ALWAYS_KEEP
-                      or op.type in RNG_OPS
-                      or _has_sub_block(op)
-                      or not op.output_arg_names)
-            if not forced:
-                for nm in op.output_arg_names:
-                    var = block._find_var_recursive(nm)
-                    if var is not None and var.persistable:
-                        forced = True
-                        break
+            forced = self._forced_keep(block, op)
             if forced or any(nm in live for nm in op.output_arg_names):
                 keep[i] = True
                 live.update(_op_reads(op))
@@ -291,6 +297,17 @@ class ElementwiseFusion(Pass):
 
     name = 'elementwise_fuse'
 
+    def _extension_hazard(self, ops, cur, j, hazard):
+        """WAR/WAW hazard: an interloper between chain tail ``cur`` and
+        candidate ``j`` writing anything the chain touches would
+        see/change the wrong value once the members move to j's
+        position. The sanitizer's read-order-hazard invariant is the
+        post-hoc twin of this check — tests seed mutations here."""
+        for k in range(cur + 1, j):
+            if set(_op_writes(ops[k])) & hazard:
+                return True
+        return False
+
     def run(self, program, ctx):
         res = PassResult(self.name)
         block = program.global_block()
@@ -339,15 +356,7 @@ class ElementwiseFusion(Pass):
                 var = block._find_var_recursive(out)
                 if var is not None and var.persistable:
                     break
-                # WAR/WAW hazard: an interloper writing anything the
-                # chain touches would see/change the wrong value once
-                # the members move to j's position
-                bad = False
-                for k in range(cur + 1, j):
-                    if set(_op_writes(ops[k])) & hazard:
-                        bad = True
-                        break
-                if bad:
+                if self._extension_hazard(ops, cur, j, hazard):
                     break
                 hazard |= set(_op_reads(nxt)) | set(_op_writes(nxt))
                 chain.append(j)

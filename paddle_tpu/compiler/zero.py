@@ -299,8 +299,17 @@ class ZeroShardGradients(Pass):
         self.bucket_bytes = int(bucket_bytes or DEFAULT_BUCKET_BYTES)
         self.axis = axis
 
-    def run(self, program, ctx):
+    def _shard_dim(self, shape, dp):
+        """The dim this gradient shards over — MUST be the same answer
+        ``Partitioner.grad_shard_spec`` / the optimizer-state slicing
+        compute (``first_divisible_dim``), or the spec the pass emits
+        conflicts with the partition rules. The sanitizer's shard-spec
+        invariant checks exactly this agreement — tests seed mutations
+        here. None = per-tensor replicated fallback."""
         from ..partition import first_divisible_dim
+        return first_divisible_dim(shape, dp)
+
+    def run(self, program, ctx):
         res = PassResult(self.name)
         dp = int(self.dp or 0)
         if dp <= 1:
@@ -329,7 +338,7 @@ class ZeroShardGradients(Pass):
             shape = tuple(getattr(var, 'shape', None) or ())
             if not shape or any(int(s) <= 0 for s in shape):
                 continue
-            d = first_divisible_dim(shape, dp)
+            d = self._shard_dim(shape, dp)
             if d is None:
                 continue      # per-tensor replicated fallback
             seen.add(gname)

@@ -28,6 +28,8 @@ from .core.lowering import (lower_block, runtime_dtype, RNG_KEY,
                             _op_reads)
 from .lod import SequenceTensor
 from .resilience import anomaly as _anomaly
+from . import analysis as _analysis
+from .analysis import ProgramInvalid
 
 __all__ = ['Executor', 'CacheInfo', 'global_scope', 'scope_guard',
            'switch_scope', 'fetch_var', 'as_numpy']
@@ -558,6 +560,11 @@ class Executor(object):
             opt, _results = _compiler.optimize(
                 pruned, fetch_names=fetch_names, scope=scope,
                 clone=pruned is program)
+        except ProgramInvalid:
+            # the pass sanitizer (PTPU_VERIFY_PASSES) caught a pass
+            # breaking an invariant — that is a deliberate, named
+            # failure, not an optimizer bug to degrade past
+            raise
         except Exception:
             # an optimizer bug must degrade to raw lowering, never take
             # the step down with it
@@ -747,6 +754,10 @@ class Executor(object):
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
+        # feed validation runs on the RAW feed: _prepare_feed casts to
+        # the declared dtype, which would mask exactly the mismatches
+        # the check exists to name (FeedInvalid, ANALYSIS.md)
+        _analysis.check_feeds_for_executor(program, feed)
 
         dynamic = program.__dict__.setdefault(
             '_dynamic_memo', {}).get(program.fingerprint())
@@ -781,6 +792,14 @@ class Executor(object):
                 feeds_s = part.feed_shardings(feed)
             if entry is None:
                 self._cache_misses += 1
+                if not dynamic:
+                    # static verify BEFORE any lowering: a mis-wired
+                    # program raises typed ProgramInvalid naming the
+                    # offending op instead of an XLA trace error
+                    _analysis.verify_for_executor(
+                        program,
+                        feed_names=set(feed) | set(static_env),
+                        fetch_names=fetch_names)
                 _obs.emit('compile_begin', fp=key[0])
                 lower_prog = self._optimized_program(
                     program, fetch_names, scope=scope, dynamic=dynamic)
@@ -997,6 +1016,10 @@ class Executor(object):
                 stacked_s = part.stacked_feed_shardings(prepped[0])
             if entry is None:
                 self._cache_misses += 1
+                _analysis.verify_for_executor(
+                    program,
+                    feed_names=set(prepped[0]) | set(static_envs[0]),
+                    fetch_names=fetch_names)
                 _obs.emit('compile_begin', fp=key[0], chain=k)
                 lower_prog = self._optimized_program(program,
                                                      fetch_names,

@@ -95,3 +95,13 @@ def pytest_configure(config):
         'launcher, bounded bootstrap handshake, cross-host agreement, '
         'heartbeat host-loss detection, degraded relaunch + bit-exact '
         'resume (tier-1; filter with -m "not multihost")')
+    config.addinivalue_line(
+        'markers',
+        'analysis: tests of the paddle_tpu.analysis static verifier — '
+        'dataflow/shape/sharding inference, executor-path '
+        'ProgramInvalid/FeedInvalid, the pass-pipeline sanitizer, the '
+        'analyze_program CLI (tier-1; filter with -m "not analysis")')
+    config.addinivalue_line(
+        'markers',
+        'lint: tests running tools/lint_repo.py over the tree against '
+        'its pinned allowlist (tier-1; filter with -m "not lint")')

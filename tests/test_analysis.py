@@ -1,0 +1,643 @@
+"""paddle_tpu.analysis: static program verifier, shape/dtype/sharding
+inference, executor integration, and the pass-pipeline sanitizer
+(ANALYSIS.md).
+
+The seeded-mutation suite is the sanitizer's acceptance test: for each
+stock compiler pass a deliberately-broken variant (hook-method override
+breaking exactly one invariant) must be caught STATICALLY by
+``PassPipeline(verify=True)`` with a diagnostic naming the pass and the
+invariant — while the stock pass verifies clean on the same program.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+import paddle_tpu.analysis as A
+from paddle_tpu import compiler
+from paddle_tpu.compiler.pass_base import (PassPipeline, PassContext,
+                                           Pass, PassResult)
+from paddle_tpu.compiler.passes import (DeadOpElimination,
+                                        ElementwiseFusion, BufferReuse)
+from paddle_tpu.compiler.zero import ZeroShardGradients
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp_train(hidden=32, classes=10):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[1, 28, 28],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='int64')
+        h = fluid.layers.fc(input=img, size=hidden, act='relu')
+        pred = fluid.layers.fc(input=h, size=classes, act='softmax')
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg)
+    return main, startup, avg
+
+
+# ---- diagnostics ----------------------------------------------------------
+
+
+def test_diagnostic_render_and_severity():
+    d = A.Diagnostic('rank-mismatch', A.ERROR, 'boom', op_index=3,
+                     op_type='mul', var_names=['x'])
+    assert d.is_error and 'mul' in d.render() and 'boom' in d.render()
+    assert d.as_dict()['op_index'] == 3
+    w = A.Diagnostic('shard-axis', A.WARNING, 'meh')
+    assert A.max_severity([w, d]) == A.ERROR
+    assert A.max_severity([]) is None
+    assert A.errors_of([w, d]) == [d]
+    with pytest.raises(ValueError):
+        A.Diagnostic('x', 'fatal', 'bad severity')
+
+
+def test_program_invalid_sorts_errors_first():
+    w = A.Diagnostic('c1', A.WARNING, 'warn msg')
+    e = A.Diagnostic('c2', A.ERROR, 'err msg', op_type='conv2d')
+    exc = A.ProgramInvalid([w, e])
+    assert exc.diagnostics[0] is e
+    assert 'conv2d' in str(exc) and '1 error(s)' in str(exc)
+
+
+def test_pass_verification_error_names_pass():
+    e = A.Diagnostic('pass-invariant', A.ERROR, 'x',
+                     pass_name='dead_op_elim',
+                     invariant='side-effect-preserved')
+    exc = A.PassVerificationError([e])
+    assert exc.pass_name == 'dead_op_elim'
+    assert exc.invariant == 'side-effect-preserved'
+    assert isinstance(exc, A.ProgramInvalid)
+
+
+# ---- dataflow -------------------------------------------------------------
+
+
+def test_dataflow_use_before_def():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        fluid.layers.data(name='a', shape=[4], dtype='float32')
+    block = prog.global_block()
+    block.create_var(name='ghost', shape=(4,), dtype='float32')
+    block.create_var(name='out', shape=(4,), dtype='float32')
+    block.append_op(type='relu', inputs={'X': ['ghost']},
+                    outputs={'Out': ['out']})
+    res, diags = A.analyze_dataflow(prog, feeds=('a',))
+    bad = [d for d in diags if d.code == 'use-before-def']
+    assert len(bad) == 1 and bad[0].op_type == 'relu'
+    assert 'ghost' in bad[0].var_names
+    assert res.undefined_reads
+
+
+def test_dataflow_backward_marker_hidden_writes():
+    """backward_marker defines every <param>@GRAD through its attrs,
+    with no output slot — the optimizer tail must not read as
+    use-before-def."""
+    main, _startup, _avg = _mlp_train()
+    marker = [op for op in main.global_block().ops
+              if op.type == 'backward_marker']
+    assert marker and not marker[0].output_arg_names
+    assert A.hidden_writes(marker[0])
+    _res, diags = A.analyze_dataflow(main, feeds=('img', 'label'))
+    assert not [d for d in diags if d.code == 'use-before-def']
+
+
+def test_dataflow_carrier_defs_dynamic_rnn():
+    """DynamicRNN step-input/memory vars are materialized by the
+    carrier op (attr-declared); sub-block ops reading them are not
+    use-before-def."""
+    import paddle_tpu.unique_name as unique_name
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        trg = fluid.layers.data(name='w', shape=[1], dtype='int64',
+                                lod_level=1)
+        emb = fluid.layers.embedding(input=trg, size=[30, 8])
+        boot = fluid.layers.fill_constant(shape=[2, 16],
+                                          dtype='float32', value=0.0)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            cur = drnn.step_input(emb)
+            mem = drnn.memory(init=boot)
+            cat = fluid.layers.concat([cur, mem], axis=-1)
+            out = fluid.layers.fc(input=cat, size=16, act='tanh')
+            drnn.update_memory(mem, out)
+            drnn.output(out)
+        _ = drnn()
+    carrier = [op for op in main.global_block().ops
+               if op.type == 'dynamic_rnn'][0]
+    assert A.carrier_defs(carrier)
+    _res, diags = A.analyze_dataflow(main, feeds=('w',))
+    assert not [d for d in diags if d.code == 'use-before-def'], diags
+
+
+def test_dataflow_last_reads_and_reachability():
+    prog = fluid.Program()
+    block = prog.global_block()
+    for nm in ('a', 't1', 't2', 'unrelated'):
+        block.create_var(name=nm, shape=(4,), dtype='float32')
+    block.var('a').is_data = True
+    block.append_op(type='relu', inputs={'X': ['a']},
+                    outputs={'Out': ['t1']})
+    block.append_op(type='tanh', inputs={'X': ['t1']},
+                    outputs={'Out': ['t2']})
+    block.append_op(type='sigmoid', inputs={'X': ['a']},
+                    outputs={'Out': ['unrelated']})
+    last = A.last_reads(block)
+    assert last['a'] == 2 and last['t1'] == 1
+    keep = A.reachable_ops(block, ['t2'])
+    assert keep == {0, 1}
+
+
+# ---- shape/dtype inference ------------------------------------------------
+
+
+def _bare_program(op_type, shapes, dtypes=None, attrs=None, slots=None):
+    """One-op program over fresh non-data vars (vars fed explicitly)."""
+    prog = fluid.Program()
+    block = prog.global_block()
+    names = []
+    dtypes = dtypes or ['float32'] * len(shapes)
+    for i, (shape, dt) in enumerate(zip(shapes, dtypes)):
+        nm = 'v%d' % i
+        block.create_var(name=nm, shape=tuple(shape), dtype=dt)
+        names.append(nm)
+    block.create_var(name='out', shape=(-1,), dtype=dtypes[0])
+    slots = slots or (['X', 'Y'] if len(names) == 2 else ['X'])
+    block.append_op(type=op_type,
+                    inputs={s: [n] for s, n in zip(slots, names)},
+                    outputs={'Out': ['out']}, attrs=dict(attrs or {}))
+    return prog, names
+
+
+def test_infer_mul_inner_dim_mismatch():
+    prog, names = _bare_program('mul', [(6, 5), (7, 3)])
+    _env, diags, _stats = A.infer_program(prog, feeds=names)
+    errs = [d for d in diags if d.code == 'rank-mismatch']
+    assert errs and errs[0].op_type == 'mul' and errs[0].is_error
+
+
+def test_infer_broadcast_mismatch():
+    prog, names = _bare_program('elementwise_add', [(4, 13), (4, 7)])
+    _env, diags, _stats = A.infer_program(prog, feeds=names)
+    assert [d for d in diags if d.code == 'broadcast-mismatch'
+            and d.is_error]
+
+
+def test_infer_conv_channel_mismatch():
+    # 3-channel input vs weights expecting 4 input channels
+    prog = fluid.Program()
+    block = prog.global_block()
+    block.create_var(name='x', shape=(2, 3, 8, 8), dtype='float32')
+    block.create_var(name='w', shape=(16, 4, 3, 3), dtype='float32')
+    block.create_var(name='y', shape=(-1,), dtype='float32')
+    block.append_op(type='conv2d',
+                    inputs={'Input': ['x'], 'Filter': ['w']},
+                    outputs={'Output': ['y']},
+                    attrs={'strides': [1, 1], 'paddings': [0, 0],
+                           'dilations': [1, 1], 'groups': 1})
+    names = ['x', 'w']
+    _env, diags, _stats = A.infer_program(prog, feeds=names)
+    assert [d for d in diags if d.code == 'conv-channel-mismatch'
+            and d.is_error]
+
+
+def test_infer_reshape_numel_mismatch():
+    prog, names = _bare_program('reshape', [(4, 6)],
+                                attrs={'shape': [5, -1]})
+    _env, diags, _stats = A.infer_program(prog, feeds=names)
+    assert [d for d in diags if d.code == 'reshape-numel' and d.is_error]
+
+
+def test_infer_lookup_table_float_ids():
+    prog = fluid.Program()
+    block = prog.global_block()
+    block.create_var(name='ids', shape=(8, 1), dtype='float32')
+    block.create_var(name='W', shape=(30, 16), dtype='float32')
+    block.create_var(name='emb', shape=(-1,), dtype='float32')
+    block.append_op(type='lookup_table',
+                    inputs={'Ids': ['ids'], 'W': ['W']},
+                    outputs={'Out': ['emb']})
+    _env, diags, _stats = A.infer_program(prog, feeds=['ids', 'W'])
+    assert [d for d in diags if d.code == 'dtype-mismatch' and d.is_error]
+
+
+def test_infer_propagates_through_net():
+    main, _startup, avg = _mlp_train()
+    env, diags, stats = A.infer_program(main)
+    assert not [d for d in diags if d.is_error], diags
+    assert stats['covered'] > 0
+    assert avg.name in env  # inference reached the loss
+
+
+def test_register_shape_extends_registry():
+    @A.register_shape('totally_custom_op_for_test')
+    def _rule(op, env, emit):
+        return {}
+    from paddle_tpu.analysis.infer import _RULES
+    assert 'totally_custom_op_for_test' in _RULES
+    del _RULES['totally_custom_op_for_test']
+
+
+def test_verify_fetch_unreachable():
+    main, _startup, _avg = _mlp_train()
+    diags = A.verify_program(main, feeds=('img', 'label'),
+                             fetch_names=('no_such_var',))
+    assert [d for d in diags if d.code == 'fetch-unreachable'
+            and d.is_error]
+
+
+# ---- sharding consistency -------------------------------------------------
+
+
+def test_check_sharding_flags_conflicting_zero_spec():
+    main, _startup, _avg = _mlp_train()
+    ZeroShardGradients(dp=2).run(main, PassContext())
+    assert not [d for d in A.check_sharding(main) if d.is_error]
+    # corrupt one bucket's shard dim to a non-dividing / wrong dim
+    block = main.global_block()
+    rs = [op for op in block.ops if op.type == 'zero_reduce_scatter']
+    assert rs
+    dims = list(rs[0].attrs['shard_dims'])
+    dims[0] += 1
+    rs[0].attrs['shard_dims'] = dims
+    errs = [d for d in A.check_sharding(main) if d.is_error]
+    assert errs and errs[0].code == 'shard-spec'
+
+
+def test_check_sharding_unknown_axis_warns():
+    prog = fluid.Program()
+    v = prog.global_block().create_var(name='w', shape=(8, 4),
+                                       dtype='float32')
+    v.sharding = ('made_up_axis', None)
+    diags = A.check_sharding(prog)
+    assert [d for d in diags if d.code == 'shard-axis'
+            and d.severity == A.WARNING]
+    assert not [d for d in diags if d.is_error]
+
+
+# ---- feed validation ------------------------------------------------------
+
+
+def test_check_feeds_rank_dim_dtype():
+    main, _startup, _avg = _mlp_train()
+    ok = A.check_feeds(main, {
+        'img': np.zeros((4, 1, 28, 28), 'float32'),
+        'label': np.zeros((4, 1), 'int64')})
+    assert not ok
+    # labels: a (N,) feed into the (None, 1) var is the standard idiom
+    assert not A.check_feeds(main, {'label': np.zeros((4,), 'int64')})
+    bad_rank = A.check_feeds(main, {'img': np.zeros((4, 784), 'f4')})
+    assert [d for d in bad_rank if d.code == 'feed-rank' and d.is_error]
+    # declared-dim disagreement is advisory (lowering traces with the
+    # FED shape; detection-style kernels feed variable extents)
+    bad_dim = A.check_feeds(main,
+                            {'img': np.zeros((4, 3, 28, 28), 'f4')})
+    assert [d for d in bad_dim if d.code == 'feed-shape'
+            and d.severity == A.WARNING]
+    bad_dt = A.check_feeds(main, {'label': np.zeros((4, 1), 'float32')})
+    assert [d for d in bad_dt if d.code == 'feed-dtype' and d.is_error]
+
+
+# ---- executor integration -------------------------------------------------
+
+
+def test_executor_raises_program_invalid_before_lowering(tmp_path):
+    """A rank-mismatched program dies with a typed error naming the op,
+    BEFORE any lowering/compile begins (no compile_begin journalled)."""
+    import paddle_tpu.observability as obs
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[7], dtype='float32')
+        z = fluid.layers.elementwise_add(x, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    jpath = str(tmp_path / 'run.jsonl')
+    with obs.journal(jpath):
+        with pytest.raises(A.ProgramInvalid) as ei:
+            exe.run(main,
+                    feed={'x': np.zeros((4, 13), 'float32'),
+                          'y': np.zeros((4, 7), 'float32')},
+                    fetch_list=[z])
+    assert 'elementwise_add' in str(ei.value)
+    recs, _bad = obs.read_journal(jpath)
+    evs = [r['ev'] for r in recs]
+    assert 'analysis' in evs
+    # the verify fired before lowering: no compile for THIS program
+    assert 'compile_begin' not in evs
+
+
+def test_executor_feed_invalid_names_slot():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name='a', shape=[4], dtype='float32')
+        b = fluid.layers.fc(input=a, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(A.FeedInvalid) as ei:
+        exe.run(main, feed={'a': np.zeros((2, 4, 3), 'float32')},
+                fetch_list=[b])
+    assert "'a'" in str(ei.value) and 'feed-rank' in str(ei.value)
+    # a well-shaped feed still runs (memo keyed on feed signature)
+    out, = exe.run(main, feed={'a': np.zeros((2, 4), 'float32')},
+                   fetch_list=[b])
+    assert np.asarray(out).shape == (2, 3)
+
+
+def test_executor_verify_memoized_and_toggleable():
+    from paddle_tpu.analysis import verifier
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[5], dtype='float32')
+        fluid.layers.elementwise_add(x, y)
+    with pytest.raises(A.ProgramInvalid):
+        A.verify_for_executor(main, feed_names=('x', 'y'))
+    memo = main.__dict__['_analysis_memo']
+    assert len(memo) == 1
+    with pytest.raises(A.ProgramInvalid):
+        A.verify_for_executor(main, feed_names=('x', 'y'))
+    assert len(memo) == 1        # second hit served from the memo
+    verifier.set_enabled(False)
+    try:
+        A.verify_for_executor(main, feed_names=('x', 'y'))  # no raise
+    finally:
+        verifier.set_enabled(None)
+    assert A.enabled() in (True, False)
+
+
+def test_good_training_step_unaffected():
+    main, startup, avg = _mlp_train()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    loss0 = loss1 = None
+    for i in range(3):
+        out, = exe.run(main, feed={
+            'img': rng.randn(8, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (8, 1)).astype('int64')},
+            fetch_list=[avg])
+        loss1 = float(np.asarray(out).mean())
+        loss0 = loss0 if loss0 is not None else loss1
+    assert np.isfinite(loss0) and np.isfinite(loss1)
+
+
+# ---- pass-pipeline sanitizer ----------------------------------------------
+
+
+def _hazard_program():
+    """relu(A)->T1; scale(A)->A (interloper WAW on A); scale(T1)->OUT.
+    Fusing relu+scale across the interloper is the WAR hazard the
+    stock ElementwiseFusion refuses."""
+    prog = fluid.Program()
+    block = prog.global_block()
+    a = block.create_var(name='A', shape=(4, 4), dtype='float32')
+    a.is_data = True
+    block.create_var(name='T1', shape=(4, 4), dtype='float32')
+    block.create_var(name='OUT', shape=(4, 4), dtype='float32')
+    block.append_op(type='relu', inputs={'X': ['A']},
+                    outputs={'Out': ['T1']})
+    block.append_op(type='scale', inputs={'X': ['A']},
+                    outputs={'Out': ['A']}, attrs={'scale': 2.0})
+    block.append_op(type='scale', inputs={'X': ['T1']},
+                    outputs={'Out': ['OUT']}, attrs={'scale': 1.0})
+    return prog
+
+
+class _BrokenDeadOpElim(DeadOpElimination):
+    """Treats the backward marker as removable — drops the hidden grad
+    definitions and the training side effect."""
+
+    def _forced_keep(self, block, op):
+        if op.type == 'backward_marker':
+            return False
+        return DeadOpElimination._forced_keep(self, block, op)
+
+
+class _BrokenFusion(ElementwiseFusion):
+    """Ignores interloper writes when extending a chain — fuses across
+    the WAR/WAW hazard."""
+
+    def _extension_hazard(self, ops, cur, j, hazard):
+        return False
+
+
+class _BrokenBufferReuse(Pass):
+    """Releases every temp at its FIRST read — starving later readers
+    (the bug the release-liveness invariant exists for)."""
+
+    name = 'buffer_reuse'
+
+    def run(self, program, ctx):
+        block = program.global_block()
+        released = set()
+        for op in block.ops:
+            for nm in op.input_arg_names:
+                var = block._find_var_recursive(nm)
+                if var is None or var.persistable or var.is_data \
+                        or nm in released:
+                    continue
+                rel = list(op.attrs.get('__release__', ()))
+                rel.append(nm)
+                op.attrs['__release__'] = rel
+                released.add(nm)
+        program._bump_version()
+        return PassResult(self.name, changed=bool(released),
+                          vars_released=len(released))
+
+
+class _BrokenZeroShard(ZeroShardGradients):
+    """Picks the LAST dp-divisible dim instead of the first — the spec
+    it emits disagrees with Partitioner.grad_shard_spec / the
+    optimizer-state slicing."""
+
+    def _shard_dim(self, shape, dp):
+        for i in reversed(range(len(shape))):
+            if int(shape[i]) % dp == 0:
+                return i
+        return None
+
+
+def test_sanitizer_stock_pipeline_clean():
+    main, _startup, avg = _mlp_train()
+    pipe = PassPipeline(compiler.default_pipeline().passes,
+                        verify=True)
+    prog, results = pipe.run(main, protected=(avg.name,))
+    assert [r.pass_name for r in results] == \
+        list(compiler.pipeline_signature())
+    assert prog is not main
+
+
+def test_sanitizer_catches_broken_dead_op_elim():
+    main, _startup, avg = _mlp_train()
+    with pytest.raises(A.PassVerificationError) as ei:
+        PassPipeline([_BrokenDeadOpElim()], verify=True).run(
+            main, protected=(avg.name,))
+    assert ei.value.pass_name == 'dead_op_elim'
+    assert ei.value.invariant == 'side-effect-preserved'
+    # stock pass on the same program: clean
+    PassPipeline([DeadOpElimination()], verify=True).run(
+        main, protected=(avg.name,))
+
+
+def test_sanitizer_catches_broken_fusion():
+    prog = _hazard_program()
+    # stock fusion refuses the hazardous chain and verifies clean
+    out, _ = PassPipeline([ElementwiseFusion()], verify=True).run(
+        prog, protected=('OUT',))
+    assert all(op.type != 'fused_elementwise'
+               for op in out.global_block().ops)
+    with pytest.raises(A.PassVerificationError) as ei:
+        PassPipeline([_BrokenFusion()], verify=True).run(
+            prog, protected=('OUT',))
+    assert ei.value.pass_name == 'elementwise_fuse'
+    assert ei.value.invariant == 'read-order-hazard'
+
+
+def test_sanitizer_catches_broken_buffer_reuse():
+    prog = fluid.Program()
+    block = prog.global_block()
+    a = block.create_var(name='A', shape=(4,), dtype='float32')
+    a.is_data = True
+    for nm in ('T1', 'T2', 'T3'):
+        block.create_var(name=nm, shape=(4,), dtype='float32')
+    block.append_op(type='relu', inputs={'X': ['A']},
+                    outputs={'Out': ['T1']})
+    block.append_op(type='tanh', inputs={'X': ['T1']},
+                    outputs={'Out': ['T2']})
+    block.append_op(type='sigmoid', inputs={'X': ['T1']},
+                    outputs={'Out': ['T3']})   # T1 read AGAIN here
+    out, _ = PassPipeline([BufferReuse()], verify=True).run(
+        prog, protected=('T2', 'T3'))          # stock: clean
+    with pytest.raises(A.PassVerificationError) as ei:
+        PassPipeline([_BrokenBufferReuse()], verify=True).run(
+            prog, protected=('T2', 'T3'))
+    assert ei.value.pass_name == 'buffer_reuse'
+    assert ei.value.invariant == 'release-liveness'
+
+
+def test_sanitizer_catches_broken_zero_shard():
+    main, _startup, avg = _mlp_train()
+    # stock ZeRO grad tail under the sanitizer: clean
+    PassPipeline([ZeroShardGradients(dp=2)], verify=True).run(
+        main, protected=(avg.name,))
+    with pytest.raises(A.PassVerificationError) as ei:
+        PassPipeline([_BrokenZeroShard(dp=2)], verify=True).run(
+            main, protected=(avg.name,))
+    assert ei.value.pass_name == 'zero_shard_grads'
+    assert ei.value.invariant == 'shard-spec'
+
+
+def test_sanitizer_env_toggle(monkeypatch):
+    """PassPipeline(verify=None) follows PTPU_VERIFY_PASSES."""
+    monkeypatch.delenv('PTPU_VERIFY_PASSES', raising=False)
+    assert not PassPipeline([])._verify_enabled()
+    monkeypatch.setenv('PTPU_VERIFY_PASSES', '1')
+    assert PassPipeline([])._verify_enabled()
+    main, _startup, avg = _mlp_train()
+    with pytest.raises(A.PassVerificationError):
+        PassPipeline([_BrokenDeadOpElim()]).run(main,
+                                                protected=(avg.name,))
+    assert not PassPipeline([], verify=False)._verify_enabled()
+
+
+def test_broken_pass_surfaces_through_executor(monkeypatch):
+    """With the sanitizer on, a broken pass in the default pipeline
+    becomes a typed PassVerificationError out of Executor.run — NOT a
+    silent degrade to raw lowering."""
+    monkeypatch.setenv('PTPU_VERIFY_PASSES', '1')
+    main, startup, avg = _mlp_train()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    import paddle_tpu.compiler as C
+    stock = C.default_pipeline
+
+    def broken_pipeline():
+        pipe = stock()
+        return PassPipeline([_BrokenDeadOpElim()] , name=pipe.name)
+    monkeypatch.setattr(C, 'default_pipeline', broken_pipeline)
+    with pytest.raises(A.PassVerificationError):
+        exe.run(main, feed={
+            'img': np.zeros((4, 1, 28, 28), 'float32'),
+            'label': np.zeros((4, 1), 'int64')}, fetch_list=[avg])
+
+
+# ---- the analyze_program CLI ----------------------------------------------
+
+
+def _write_builder(tmp_path, body):
+    p = tmp_path / 'net.py'
+    p.write_text('import paddle_tpu.fluid as fluid\n' + body)
+    return str(p)
+
+
+def test_cli_clean_builder(tmp_path):
+    path = _write_builder(tmp_path, '''
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1)
+    return main, ['x'], [y.name]
+''')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools',
+                                      'analyze_program.py'), path],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'clean' in r.stdout
+
+
+def test_cli_rank_mismatch_json_nonzero_exit(tmp_path):
+    path = _write_builder(tmp_path, '''
+main = fluid.Program()
+with fluid.program_guard(main, fluid.Program()):
+    a = fluid.layers.data(name='a', shape=[5], dtype='float32')
+    b = fluid.layers.data(name='b', shape=[7, 3], dtype='float32',
+                          append_batch_size=False)
+    c = fluid.layers.mul(a, b)
+FEEDS = ['a', 'b']
+FETCHES = [c.name]
+''')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools',
+                                      'analyze_program.py'), path,
+         '--json'],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    assert r.returncode == 1, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report['errors'] >= 1
+    codes = {d['code'] for d in report['diagnostics']}
+    assert 'rank-mismatch' in codes
+    ops = {d['op_type'] for d in report['diagnostics']}
+    assert 'mul' in ops
+
+
+def test_cli_saved_model_dir(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = str(tmp_path / 'model')
+    fluid.io.save_inference_model(model_dir, ['x'], [y], exe,
+                                  main_program=main)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools',
+                                      'analyze_program.py'), model_dir],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,216 @@
+"""Golden verifier sweep: the static analyzer runs over real scenario
+programs (the test_books models — conv-mnist, VGG, word2vec,
+recommender towers, DynamicRNN seq2seq) and must report ZERO error
+diagnostics — on the train program, on its ``clone(for_test=True)``
+inference twin, and with the full default pass pipeline running under
+``PassPipeline(verify=True)``.
+
+This pins the analyzer's false-positive rate at zero on every program
+shape the repo actually trains, so the executor-path verify can stay on
+by default (ANALYSIS.md "Golden sweep").
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.unique_name as unique_name
+import paddle_tpu.analysis as A
+from paddle_tpu import compiler
+from paddle_tpu.compiler.pass_base import PassPipeline
+
+pytestmark = pytest.mark.analysis
+
+
+def build_conv_mnist():
+    """book02: two conv-pool blocks + softmax classifier + Adam."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[1, 28, 28],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='int64')
+        cp1 = fluid.nets.simple_img_conv_pool(
+            input=img, filter_size=5, num_filters=8, pool_size=2,
+            pool_stride=2, act='relu')
+        cp2 = fluid.nets.simple_img_conv_pool(
+            input=cp1, filter_size=5, num_filters=16, pool_size=2,
+            pool_stride=2, act='relu')
+        pred = fluid.layers.fc(input=cp2, size=10, act='softmax')
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg = fluid.layers.mean(cost)
+        fluid.layers.accuracy(input=pred, label=label)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg)
+    return main, ('img', 'label'), avg.name
+
+
+def build_vgg_cifar():
+    """book03: VGG16 with batch-norm and dropout on CIFAR shapes."""
+    from paddle_tpu.models import vgg
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
+                                   dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='int64')
+        pred = vgg.vgg16_bn_drop(images, class_dim=10)
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.005).minimize(avg)
+    return main, ('pixel', 'label'), avg.name
+
+
+def build_word2vec(dict_size=100, n=5):
+    """book04: N-gram LM, shared embedding table across positions."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = [fluid.layers.data(name='word_%d' % i, shape=[1],
+                                   dtype='int64') for i in range(n - 1)]
+        nxt = fluid.layers.data(name='nextw', shape=[1], dtype='int64')
+        embeds = [fluid.layers.embedding(
+            input=w, size=[dict_size, 16],
+            param_attr=fluid.ParamAttr(name='shared_w'))
+            for w in words]
+        concat = fluid.layers.concat(input=embeds, axis=1)
+        h = fluid.layers.fc(input=concat, size=32, act='sigmoid')
+        pred = fluid.layers.fc(input=h, size=dict_size, act='softmax')
+        cost = fluid.layers.cross_entropy(input=pred, label=nxt)
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg)
+    feeds = tuple(w.name for w in words) + ('nextw',)
+    return main, feeds, avg.name
+
+
+def build_recommender():
+    """book05-lite: user/movie embedding towers, sequence pooling over
+    categories/title, cosine-similarity regression (fixed vocabs — no
+    dataset access)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        uid = fluid.layers.data(name='user_id', shape=[1],
+                                dtype='int64')
+        gender = fluid.layers.data(name='gender_id', shape=[1],
+                                   dtype='int64')
+        mov = fluid.layers.data(name='movie_id', shape=[1],
+                                dtype='int64')
+        cat = fluid.layers.data(name='category_id', shape=[1],
+                                dtype='int64', lod_level=1)
+        title = fluid.layers.data(name='movie_title', shape=[1],
+                                  dtype='int64', lod_level=1)
+        score = fluid.layers.data(name='score', shape=[1],
+                                  dtype='float32')
+
+        def emb_fc(x, vocab, dim=8):
+            e = fluid.layers.embedding(input=x, size=[vocab, dim],
+                                       is_sparse=True)
+            return fluid.layers.fc(input=e, size=16)
+
+        usr = fluid.layers.concat(
+            [emb_fc(uid, 50), emb_fc(gender, 2)], axis=1)
+        usr_feat = fluid.layers.fc(input=usr, size=32, act='tanh')
+        mov_emb = emb_fc(mov, 40)
+        cat_emb = fluid.layers.embedding(input=cat, size=[12, 8],
+                                         is_sparse=True)
+        cat_pool = fluid.layers.sequence_pool(input=cat_emb,
+                                              pool_type='sum')
+        title_emb = fluid.layers.embedding(input=title, size=[60, 8],
+                                           is_sparse=True)
+        title_conv = fluid.nets.sequence_conv_pool(
+            input=title_emb, num_filters=16, filter_size=3,
+            act='tanh', pool_type='sum')
+        mov_feat = fluid.layers.fc(
+            input=fluid.layers.concat(
+                [mov_emb, cat_pool, title_conv], axis=1),
+            size=32, act='tanh')
+        sim = fluid.layers.cos_sim(X=usr_feat, Y=mov_feat)
+        scaled = fluid.layers.scale(x=sim, scale=5.0)
+        cost = fluid.layers.square_error_cost(input=scaled, label=score)
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg)
+    return main, ('user_id', 'gender_id', 'movie_id', 'category_id',
+                  'movie_title', 'score'), avg.name
+
+
+def build_seq2seq(dict_size=30):
+    """book08: dynamic_lstm encoder + DynamicRNN decoder — the
+    attr-declared carrier vars (step inputs, memories) that broke naive
+    dataflow."""
+    word_dim, hidden_dim = 8, 16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        src = fluid.layers.data(name='src_word_id', shape=[1],
+                                dtype='int64', lod_level=1)
+        trg = fluid.layers.data(name='trg_word', shape=[1],
+                                dtype='int64', lod_level=1)
+        lbl = fluid.layers.data(name='trg_next_word', shape=[1],
+                                dtype='int64', lod_level=1)
+        src_emb = fluid.layers.embedding(input=src,
+                                         size=[dict_size, word_dim])
+        fc1 = fluid.layers.fc(input=src_emb, size=hidden_dim * 4,
+                              act='tanh')
+        lstm_h, _ = fluid.layers.dynamic_lstm(input=fc1,
+                                              size=hidden_dim * 4)
+        encoded = fluid.layers.sequence_pool(input=lstm_h,
+                                             pool_type='last')
+        trg_emb = fluid.layers.embedding(input=trg,
+                                         size=[dict_size, word_dim])
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            cur = drnn.step_input(trg_emb)
+            mem = drnn.memory(init=encoded)
+            dec_in = fluid.layers.concat([cur, mem], axis=-1)
+            out = fluid.layers.fc(input=dec_in, size=hidden_dim,
+                                  act='tanh')
+            prob = fluid.layers.fc(input=out, size=dict_size,
+                                   act='softmax')
+            drnn.update_memory(mem, out)
+            drnn.output(prob)
+        rnn_out = drnn()
+        cost = fluid.layers.cross_entropy(input=rnn_out, label=lbl)
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(avg)
+    return main, ('src_word_id', 'trg_word', 'trg_next_word'), avg.name
+
+
+BUILDERS = [build_conv_mnist, build_vgg_cifar, build_word2vec,
+            build_recommender, build_seq2seq]
+
+
+@pytest.mark.parametrize('builder', BUILDERS,
+                         ids=lambda b: b.__name__.replace('build_', ''))
+def test_golden_train_program_verifies_clean(builder):
+    main, feeds, loss = builder()
+    diags = A.verify_program(main, feeds=feeds, fetch_names=(loss,))
+    assert not [d for d in diags if d.is_error], \
+        A.format_diagnostics([d for d in diags if d.is_error])
+
+
+@pytest.mark.parametrize('builder', BUILDERS,
+                         ids=lambda b: b.__name__.replace('build_', ''))
+def test_golden_inference_clone_verifies_clean(builder):
+    main, feeds, loss = builder()
+    infer = main.clone(for_test=True)
+    diags = A.verify_program(infer, feeds=feeds, fetch_names=(loss,))
+    assert not [d for d in diags if d.is_error], \
+        A.format_diagnostics([d for d in diags if d.is_error])
+
+
+@pytest.mark.parametrize('builder', BUILDERS,
+                         ids=lambda b: b.__name__.replace('build_', ''))
+def test_golden_default_pipeline_sanitizes_clean(builder):
+    main, _feeds, loss = builder()
+    pipe = PassPipeline(compiler.default_pipeline().passes,
+                        name='golden', verify=True)
+    out, results = pipe.run(main, protected=(loss,))
+    assert len(results) == len(list(compiler.pipeline_signature()))
+    # the sanitized pipeline still OPTIMIZES (it must not be inert)
+    assert any(r.changed for r in results)
+
+
+def test_golden_sweep_covers_sub_block_carriers():
+    """The sweep includes at least one program with attr-declared
+    carrier vars (DynamicRNN) — the class of false positive the
+    dataflow walk must keep suppressed."""
+    main, _feeds, _loss = build_seq2seq()
+    carriers = [op for op in main.global_block().ops
+                if A.carrier_defs(op)]
+    assert carriers

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Repo-specific static lint over the paddle_tpu sources (ANALYSIS.md
+"Repo lint"). Stdlib ``ast`` only — no third-party linter, runs
+anywhere the tree is checked out.
+
+    python tools/lint_repo.py              # human report
+    python tools/lint_repo.py --json -     # machine output
+    python tools/lint_repo.py --list       # rules + scope
+
+Rules (each encodes a convention the codebase actually relies on):
+
+- ``bare-except``: ``except:`` swallows KeyboardInterrupt/SystemExit;
+  every intentional broad handler here spells ``except Exception``.
+- ``lock-outside-with``: ``<lock>.acquire()`` called outside a ``with``
+  item — an exception between acquire and release deadlocks the
+  executor cache / journal writer; the codebase takes locks only via
+  context managers.
+- ``unguarded-emit``: calling ``.emit`` on a journal OBJECT
+  (``get_journal().emit``, ``self.journal.emit``) without a
+  ``journal_active()`` / ``is not None`` guard — the module-level
+  ``observability.emit`` / ``_obs.emit`` helper is the None-safe entry
+  point and is always allowed.
+- ``dup-metric-name``: the same raw metric-name literal passed to
+  ``counter()``/``histogram()``/``gauge()`` from more than one of the
+  ``serving/``, ``fleet/``, ``multihost/`` packages — cross-subsystem
+  metric names must live in ONE place or the schemas drift apart.
+
+The embedded ``ALLOWLIST`` pins known, accepted occurrences (ratchet
+style): the tool exits nonzero only on violations NOT in the allowlist,
+and reports stale allowlist entries so the pin shrinks over time.
+tests/test_lint.py runs this over the tree and asserts zero new
+violations.
+"""
+import argparse
+import ast
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCOPE = ('paddle_tpu', 'tools')
+METRIC_PACKAGES = ('serving', 'fleet', 'multihost')
+METRIC_FACTORIES = ('counter', 'histogram', 'gauge')
+
+# rule:path:detail -> accepted occurrences. Add entries ONLY with a
+# review note; the lint test pins this set.
+ALLOWLIST = frozenset({
+})
+
+
+def _src(node):
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ast.dump(node)
+
+
+class Violation(object):
+    def __init__(self, rule, path, line, detail):
+        self.rule, self.path, self.line, self.detail = \
+            rule, path, line, detail
+
+    def key(self):
+        return '%s:%s:%s' % (self.rule, self.path, self.detail)
+
+    def render(self):
+        return '%s:%d: [%s] %s' % (self.path, self.line, self.rule,
+                                   self.detail)
+
+    def as_dict(self):
+        return {'rule': self.rule, 'path': self.path,
+                'line': self.line, 'detail': self.detail}
+
+
+def _parents(tree):
+    par = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _with_item_calls(tree):
+    """Call nodes used as ``with`` context expressions (directly or via
+    contextlib helpers wrapping them)."""
+    calls = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, getattr(ast, 'AsyncWith',
+                                               ast.With))):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        calls.add(id(sub))
+    return calls
+
+
+def _guarded(node, parents):
+    """Is ``node`` under an ``if`` whose test mentions the journal
+    guard idiom (``journal_active()`` / an ``is not None`` check)?"""
+    cur = node
+    while cur in parents:
+        cur = parents[cur]
+        if isinstance(cur, ast.If):
+            test = _src(cur.test)
+            if 'journal_active' in test or 'is not None' in test:
+                return True
+    return False
+
+
+def lint_file(path, relpath):
+    with open(path) as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Violation('parse-error', relpath, e.lineno or 0,
+                          str(e))], {}
+    parents = _parents(tree)
+    with_calls = _with_item_calls(tree)
+    out = []
+    metrics = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append(Violation('bare-except', relpath, node.lineno,
+                                 'bare except: catches SystemExit/'
+                                 'KeyboardInterrupt; use except '
+                                 'Exception'))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            recv = _src(node.func.value)
+            if node.func.attr == 'acquire' \
+                    and 'lock' in recv.lower() \
+                    and id(node) not in with_calls:
+                out.append(Violation(
+                    'lock-outside-with', relpath, node.lineno,
+                    '%s.acquire() outside a with item' % recv))
+            if node.func.attr == 'emit' and 'journal' in recv.lower() \
+                    and not _guarded(node, parents):
+                out.append(Violation(
+                    'unguarded-emit', relpath, node.lineno,
+                    '%s.emit() with no journal_active()/None guard '
+                    '(use observability.emit)' % recv))
+            if node.func.attr in METRIC_FACTORIES and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                metrics.setdefault(node.args[0].value, []).append(
+                    (relpath, node.args[0].lineno))
+    return out, metrics
+
+
+def _package_of(relpath):
+    parts = relpath.split(os.sep)
+    if len(parts) >= 2 and parts[0] == 'paddle_tpu' \
+            and parts[1] in METRIC_PACKAGES:
+        return parts[1]
+    return None
+
+
+def lint_tree(root=REPO):
+    violations = []
+    metric_sites = {}        # literal -> {package: [(path, line)]}
+    for top in SCOPE:
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(root, top)):
+            dirnames[:] = [d for d in dirnames
+                           if d != '__pycache__']
+            for fn in sorted(filenames):
+                if not fn.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fn)
+                relpath = os.path.relpath(path, root)
+                found, metrics = lint_file(path, relpath)
+                violations.extend(found)
+                pkg = _package_of(relpath)
+                if pkg:
+                    for name, sites in metrics.items():
+                        metric_sites.setdefault(
+                            name, {}).setdefault(pkg, []).extend(sites)
+    for name, by_pkg in sorted(metric_sites.items()):
+        if len(by_pkg) < 2:
+            continue
+        for pkg, sites in sorted(by_pkg.items()):
+            path, line = sites[0]
+            violations.append(Violation(
+                'dup-metric-name', path, line,
+                'metric literal %r defined in %d packages (%s); hoist '
+                'the name to one shared module'
+                % (name, len(by_pkg), ', '.join(sorted(by_pkg)))))
+    return violations
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description='paddle_tpu repo lint')
+    ap.add_argument('--json', nargs='?', const='-', default=None,
+                    help='write report as JSON (path or - for stdout)')
+    ap.add_argument('--list', action='store_true',
+                    help='print the rules and scope, then exit')
+    args = ap.parse_args(argv)
+    if args.list:
+        print('scope: %s' % ', '.join(SCOPE))
+        print('rules: bare-except, lock-outside-with, unguarded-emit, '
+              'dup-metric-name (across %s)'
+              % '/'.join(METRIC_PACKAGES))
+        return 0
+    violations = lint_tree()
+    new = [v for v in violations if v.key() not in ALLOWLIST]
+    seen = {v.key() for v in violations}
+    stale = sorted(ALLOWLIST - seen)
+    report = {'violations': [v.as_dict() for v in new],
+              'allowlisted': len(violations) - len(new),
+              'stale_allowlist': stale}
+    if args.json:
+        text = json.dumps(report, indent=2, sort_keys=True)
+        if args.json == '-':
+            print(text)
+        else:
+            with open(args.json, 'w') as f:
+                f.write(text + '\n')
+    else:
+        for v in new:
+            print(v.render())
+        if stale:
+            print('stale allowlist entries (remove them):')
+            for k in stale:
+                print('  ' + k)
+        print('%d violation(s), %d allowlisted, %d stale pin(s)'
+              % (len(new), len(violations) - len(new), len(stale)))
+    return 1 if new else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -24,7 +24,9 @@ must have exercised preemption saves or topology resharding
 (``preempt_save`` / ``reshard`` records, RESILIENCE.md); ``--require
 fleet`` for a run through the replica router / continuous-batching
 decode engine (``fleet`` / ``decode`` records, SERVING.md);
-``--require any`` for presence only).
+``--require analysis`` for a run that must have exercised the static
+program verifier (``analysis`` records, ANALYSIS.md); ``--require
+any`` for presence only).
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
 load run writes.
 """
@@ -51,6 +53,10 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # host loss"); the gate also checks every host_lost was
                # detected inside its heartbeat window
                'multihost': 'multihost',
+               # a run that must have gone through the static program
+               # verifier (Executor miss-path verify / feed checks /
+               # pass sanitizer — ANALYSIS.md) shows 'analysis' records
+               'analysis': 'analysis',
                'any': None}
 
 
@@ -227,6 +233,32 @@ def _zero_summary(by_ev):
     }
 
 
+def _analysis_summary(by_ev):
+    """Static-verifier SLI (ANALYSIS.md): applications of the program
+    verifier / feed checks / pass sanitizer from ``analysis`` events —
+    diagnostics found per phase, verify wall, and which compiler
+    passes ran under the sanitizer."""
+    events = by_ev.get('analysis', ())
+    phases = {}
+    for r in events:
+        p = phases.setdefault(r.get('phase', '?'), {
+            'runs': 0, 'errors': 0, 'warnings': 0, 'wall_s': 0.0})
+        p['runs'] += 1
+        p['errors'] += r.get('errors', 0)
+        p['warnings'] += r.get('warnings', 0)
+        p['wall_s'] += r.get('dur_s', 0.0)
+    return {
+        'events': len(events),
+        'errors': sum(p['errors'] for p in phases.values()),
+        'warnings': sum(p['warnings'] for p in phases.values()),
+        'wall_s': sum(p['wall_s'] for p in phases.values()),
+        'phases': phases,
+        'sanitized_passes': sorted({
+            r['pass'] for r in events
+            if r.get('phase') == 'sanitize' and r.get('pass')}),
+    }
+
+
 def _multihost_summary(by_ev):
     """Multi-host SLI (RESILIENCE.md "Surviving host loss"): pod
     lifecycle from ``multihost`` events — bootstraps per host,
@@ -366,6 +398,7 @@ def summarize(records, malformed=0):
         'fleet': _fleet_summary(by_ev),
         'multihost': _multihost_summary(by_ev),
         'zero': _zero_summary(by_ev),
+        'analysis': _analysis_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -531,6 +564,21 @@ def render(summary, top=10):
         if mh['relaunches']:
             lines.append('  degraded to world=%s after relaunch'
                          % mh['final_world'])
+    an = s.get('analysis') or {}
+    if an.get('events'):
+        line = ('analysis: %d verifier run(s), %.3fs wall | %d '
+                'error(s), %d warning(s)'
+                % (an['events'], an['wall_s'], an['errors'],
+                   an['warnings']))
+        if an['sanitized_passes']:
+            line += (' | sanitized passes: %s'
+                     % ', '.join(an['sanitized_passes']))
+        lines.append(line)
+        for ph, p in sorted(an['phases'].items()):
+            lines.append('  %-10s %3d runs  %8.3fms  errors=%d '
+                         'warnings=%d' % (ph, p['runs'],
+                                          p['wall_s'] * 1e3,
+                                          p['errors'], p['warnings']))
     if s['anomalies']:
         lines.append('anomaly:  %d guard trips' % s['anomalies'])
     lines.append('events:   %s' % ', '.join(
