@@ -779,6 +779,13 @@ class Executor(object):
         key = program_cache_key(program, feed, static_env, fetch_names,
                                 state_in_names, state_out_names, guard,
                                 profiling, part.cache_token(program))
+        # traced only under an active parent span (a serving batch, a
+        # trainer step): bare runs stay span-free, and the untraced
+        # cost is one thread-local read
+        _pctx = _obs.current_context()
+        tspan = _obs.start_span('exe/run', parent=_pctx,
+                                activate=False, fp=key[0]) \
+            if _pctx is not None else None
         t_lookup = time.perf_counter()
         feeds_s = state_s = None
         with self._cache_lock:
@@ -796,10 +803,16 @@ class Executor(object):
                     # static verify BEFORE any lowering: a mis-wired
                     # program raises typed ProgramInvalid naming the
                     # offending op instead of an XLA trace error
+                    _t_verify = time.perf_counter()
                     _analysis.verify_for_executor(
                         program,
                         feed_names=set(feed) | set(static_env),
                         fetch_names=fetch_names)
+                    if tspan is not None:
+                        _obs.emit_span(
+                            'exe/verify',
+                            time.perf_counter() - _t_verify,
+                            parent=tspan)
                 _obs.emit('compile_begin', fp=key[0])
                 lower_prog = self._optimized_program(
                     program, fetch_names, scope=scope, dynamic=dynamic)
@@ -883,6 +896,12 @@ class Executor(object):
             self._m_compile.observe(compile_wall)
             _obs.emit('compile_end', fp=key[0],
                       dur_s=round(compile_wall, 6))
+            if tspan is not None:
+                _obs.emit_span('exe/compile', compile_wall,
+                               parent=tspan, fp=key[0])
+        if tspan is not None:
+            _obs.emit_span('exe/dispatch', run_wall, parent=tspan,
+                           cache='miss' if was_miss else 'hit')
         if _obs.journal_active():
             _obs.emit('exe_run', cache='miss' if was_miss else 'hit',
                       fp=key[0], dur_s=round(run_wall, 6))
@@ -899,14 +918,23 @@ class Executor(object):
             _anomaly.observe_fetches(fetch_names, fetches)
         if async_fetch:
             # lazy device handles: dispatch returned, values unforced
+            if tspan is not None:
+                tspan.end(dispatched=True)
             return fetches
         if return_numpy:
+            _t_fetch = time.perf_counter()
             fetches = [as_numpy(f) for f in fetches]
+            if tspan is not None:
+                _obs.emit_span('exe/fetch',
+                               time.perf_counter() - _t_fetch,
+                               parent=tspan)
         else:
             # reference contract: fetches are LoDTensors; a dense fetch
             # still answers .lod() (with []) — wrap bare arrays
             fetches = [SequenceTensor(f, None) if isinstance(
                 f, (jax.Array, np.ndarray)) else f for f in fetches]
+        if tspan is not None:
+            tspan.end()
         return fetches
 
     def run_chained(self, program=None, feed_list=None, fetch_list=None,
@@ -1004,6 +1032,10 @@ class Executor(object):
                                 fetch_names, state_in_names,
                                 state_out_names, False, 'chain',
                                 part.cache_token(program))
+        _pctx = _obs.current_context()
+        tspan = _obs.start_span('exe/chain', parent=_pctx,
+                                activate=False, fp=key[0], steps=k) \
+            if _pctx is not None else None
         t_lookup = time.perf_counter()
         state_s = stacked_s = None
         with self._cache_lock:
@@ -1016,10 +1048,15 @@ class Executor(object):
                 stacked_s = part.stacked_feed_shardings(prepped[0])
             if entry is None:
                 self._cache_misses += 1
+                _t_verify = time.perf_counter()
                 _analysis.verify_for_executor(
                     program,
                     feed_names=set(prepped[0]) | set(static_envs[0]),
                     fetch_names=fetch_names)
+                if tspan is not None:
+                    _obs.emit_span('exe/verify',
+                                   time.perf_counter() - _t_verify,
+                                   parent=tspan)
                 _obs.emit('compile_begin', fp=key[0], chain=k)
                 lower_prog = self._optimized_program(program,
                                                      fetch_names,
@@ -1075,6 +1112,8 @@ class Executor(object):
                     RuntimeWarning, stacklevel=2)
                 _obs.emit('multihost', action='chain_fallback',
                           steps=k, error=repr(e))
+                if tspan is not None:
+                    tspan.end(fallback='globalize')
                 return _sequential()
         t_run = time.perf_counter()
         with part.run_context() if part.active else \
@@ -1110,6 +1149,12 @@ class Executor(object):
             self._m_compile.observe(compile_wall)
             _obs.emit('compile_end', fp=key[0], chain=k,
                       dur_s=round(compile_wall, 6))
+            if tspan is not None:
+                _obs.emit_span('exe/compile', compile_wall,
+                               parent=tspan, fp=key[0])
+        if tspan is not None:
+            _obs.emit_span('exe/dispatch', run_wall, parent=tspan,
+                           cache='miss' if was_miss else 'hit')
         if _obs.journal_active():
             _obs.emit('exe_run', cache='miss' if was_miss else 'hit',
                       fp=key[0], chain=k, dur_s=round(run_wall, 6))
@@ -1118,6 +1163,7 @@ class Executor(object):
         if getattr(program, '_half_inference', None):
             fetches = [_to_f32_fetch(f) for f in fetches]
         anomaly_on = _anomaly.any_active()
+        _t_fetch = time.perf_counter()
         steps_out = []
         for i in range(k):
             row = [jax.tree_util.tree_map(lambda x: x[i], f)
@@ -1132,6 +1178,12 @@ class Executor(object):
                 row = [SequenceTensor(f, None) if isinstance(
                     f, (jax.Array, np.ndarray)) else f for f in row]
             steps_out.append(row)
+        if tspan is not None:
+            if not async_fetch and return_numpy:
+                _obs.emit_span('exe/fetch',
+                               time.perf_counter() - _t_fetch,
+                               parent=tspan)
+            tspan.end()
         return steps_out
 
     def cost_analysis(self, program, feed, fetch_list, scope=None):

@@ -53,7 +53,8 @@ class DecodeRequest(object):
     (np.int64 array) once the slot retires."""
 
     __slots__ = ('init_states', 'first_id', 'max_new_tokens',
-                 'submit_time', '_event', '_tokens', '_error')
+                 'submit_time', '_event', '_tokens', '_error',
+                 'trace', '_qspan')
 
     def __init__(self, init_states, first_id, max_new_tokens):
         self.init_states = init_states
@@ -63,12 +64,18 @@ class DecodeRequest(object):
         self._event = threading.Event()
         self._tokens = None
         self._error = None
+        self.trace = None     # TraceContext of the decode/request span
+        self._qspan = None    # decode/request span, ended at completion
 
     def set_result(self, tokens):
+        if self._qspan is not None:
+            self._qspan.end(ok=True, tokens=len(tokens))
         self._tokens = tokens
         self._event.set()
 
     def set_error(self, error):
+        if self._qspan is not None:
+            self._qspan.end(error=type(error).__name__)
         self._error = error
         self._event.set()
 
@@ -88,11 +95,12 @@ class DecodeRequest(object):
 
 
 class _Slot(object):
-    __slots__ = ('req', 'tokens')
+    __slots__ = ('req', 'tokens', 'span')
 
     def __init__(self, req):
         self.req = req
         self.tokens = []
+        self.span = None      # decode/active span, admit -> retire
 
 
 class DecodeEngine(object):
@@ -228,8 +236,15 @@ class DecodeEngine(object):
         req = DecodeRequest(inits,
                             self.init_id if first_id is None
                             else int(first_id), mnt)
+        qspan = _obs.start_span('decode/request', activate=False,
+                                max_new_tokens=mnt)
+        if qspan.context is not None:
+            req._qspan = qspan
+            req.trace = qspan.context
         with self._cond:
             if self._closed:
+                if req._qspan is not None:
+                    req._qspan.end(error='ServerClosed')
                 raise ServerClosed('decode engine is shut down')
             self._pending.append(req)
             self._cond.notify()
@@ -269,8 +284,11 @@ class DecodeEngine(object):
             if not drain:
                 failed = list(self._pending)
                 self._pending.clear()
-                failed.extend(s.req for s in self._table
-                              if s is not None)
+                for s in self._table:
+                    if s is not None:
+                        if s.span is not None:
+                            s.span.end(error='ServerClosed')
+                        failed.append(s.req)
                 self._table = [None] * self.slots
                 for req in failed:
                     req.set_error(ServerClosed(
@@ -278,6 +296,9 @@ class DecodeEngine(object):
                         'finished'))
             self._cond.notify_all()
         self._worker.join(timeout)
+        j = _obs.get_journal()
+        if j is not None:
+            j.flush()   # span_ends for drained sequences hit disk now
 
     def __enter__(self):
         return self
@@ -303,8 +324,12 @@ class DecodeEngine(object):
             except Exception as e:  # noqa: BLE001 — engine must not die
                 # silently: fail every in-flight/pending future typed.
                 with self._cond:
-                    failed = [s.req for s in self._table
-                              if s is not None]
+                    failed = []
+                    for s in self._table:
+                        if s is not None:
+                            if s.span is not None:
+                                s.span.end(error=type(e).__name__)
+                            failed.append(s.req)
                     self._table = [None] * self.slots
                     failed.extend(self._pending)
                     self._pending.clear()
@@ -327,7 +352,19 @@ class DecodeEngine(object):
             if self._table[i] is not None:
                 continue
             req = self._pending.popleft()
-            self._table[i] = _Slot(req)
+            slot = _Slot(req)
+            if req.trace is not None:
+                # queue wait is pre-measured (submit -> admit), so it
+                # journals as a finished span; the slot's lifetime span
+                # opens here and retires with the sequence
+                _obs.emit_span('decode/queue',
+                               time.monotonic() - req.submit_time,
+                               parent=req.trace)
+                aspan = _obs.start_span('decode/active',
+                                        parent=req.trace,
+                                        activate=False, slot=i)
+                slot.span = aspan if aspan.context is not None else None
+            self._table[i] = slot
             self._ids[i, 0] = req.first_id
             self._pos[i, 0] = 0
             for name, shape, dtype in self.specs:
@@ -342,6 +379,25 @@ class DecodeEngine(object):
         live = [i for i, s in enumerate(self._table) if s is not None]
         if not live:
             return
+        traced = [self._table[i] for i in live
+                  if self._table[i].span is not None]
+        sspan = None
+        if traced:
+            # one decode/step serves every live traced sequence: parent
+            # under the first, link the rest (N<->1, like a coalesced
+            # serving batch). Activated, so exe/run nests under it.
+            sspan = _obs.start_span('decode/step',
+                                    parent=traced[0].req.trace,
+                                    live=len(live), admitted=admitted)
+            for s in traced:
+                _obs.link(sspan, s.req.trace)
+        try:
+            self._step_traced(live, admitted)
+        finally:
+            if sspan is not None:
+                sspan.end()
+
+    def _step_traced(self, live, admitted):
         feed = {'dec_ids': self._ids, 'dec_pos': self._pos}
         for name, _, _ in self.specs:
             feed['dec_state_%s' % name] = self._states[name]
@@ -365,6 +421,8 @@ class DecodeEngine(object):
             if done:
                 self._table[i] = None
                 retired += 1
+                if slot.span is not None:
+                    slot.span.end(tokens=len(slot.tokens))
                 slot.req.set_result(
                     np.asarray(slot.tokens, dtype=np.int64))
             else:

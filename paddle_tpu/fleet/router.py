@@ -91,10 +91,10 @@ class RoutedRequest(object):
     timeout, and never surfaces an untyped drop."""
 
     __slots__ = ('model', 'sticky_key', 'replicas_tried', 'requeues',
-                 '_router', '_feeds', '_deadline_abs', '_req')
+                 '_router', '_feeds', '_deadline_abs', '_req', '_span')
 
     def __init__(self, router, model, feeds, deadline_abs, req,
-                 replica_id, sticky_key=None):
+                 replica_id, sticky_key=None, span=None):
         self._router = router
         self.model = model
         self._feeds = feeds
@@ -103,6 +103,7 @@ class RoutedRequest(object):
         self.replicas_tried = [replica_id]
         self.requeues = 0
         self.sticky_key = sticky_key
+        self._span = span   # fleet/request root span, ended by result()
 
     @property
     def replica_id(self):
@@ -117,16 +118,33 @@ class RoutedRequest(object):
             remaining = None if end is None \
                 else max(0.0, end - time.monotonic())
             try:
-                return self._req.result(timeout=remaining)
+                value = self._req.result(timeout=remaining)
             except REQUEUEABLE as e:
                 self._router._note_replica_error(self.replica_id, e)
                 if self.requeues >= self._router.max_requeues:
+                    self._end_span(error='RequeueExhausted')
                     raise RequeueExhausted(
                         'request for model %r failed on %d replica(s) '
                         '(%s requeues exhausted): %r'
                         % (self.model, len(self.replicas_tried),
                            self.requeues, e), last_error=e)
-                self._requeue(e, end)
+                try:
+                    self._requeue(e, end)
+                except Exception as e2:
+                    self._end_span(error=type(e2).__name__)
+                    raise
+            except Exception as e:
+                self._end_span(error=type(e).__name__)
+                raise
+            else:
+                self._end_span(ok=True)
+                return value
+
+    def _end_span(self, **fields):
+        if self._span is not None:
+            self._span.end(requeues=self.requeues,
+                           replicas_tried=len(self.replicas_tried),
+                           **fields)
 
     def _remaining_deadline(self):
         if self._deadline_abs is None:
@@ -143,28 +161,46 @@ class RoutedRequest(object):
         router._m_requeued.inc()
         _obs.emit('fleet', action='requeue', model=self.model,
                   replica=self.replica_id)
+        # the requeue hop is its own child span of the fleet/request
+        # root: the failed-over attempt's serving/request span parents
+        # under it, so the hop's cost is attributed in the tree
+        rq = None
+        if self._span is not None:
+            rq = _obs.start_span('fleet/requeue', parent=self._span,
+                                 activate=False, model=self.model,
+                                 from_replica=self.replica_id,
+                                 cause=type(cause).__name__)
         give_up = time.monotonic() + router.requeue_wait
         if end is not None:
             give_up = min(give_up, end)
         last = cause
-        while True:
-            try:
-                req, rid = router._submit_once(
-                    self.model, self._feeds, self._remaining_deadline(),
-                    self.sticky_key, excluded={self.replica_id})
-            except (NoHealthyReplica, ServerOverloaded) as e:
-                last = e
-                if time.monotonic() >= give_up:
-                    raise RequeueExhausted(
-                        'no replica accepted the requeued request for '
-                        'model %r: %r' % (self.model, last),
-                        last_error=cause)
-                time.sleep(min(0.02, router.poll_interval))
-            else:
-                self.requeues += 1
-                self.replicas_tried.append(rid)
-                self._req = req
-                return
+        try:
+            while True:
+                try:
+                    req, rid = router._submit_once(
+                        self.model, self._feeds,
+                        self._remaining_deadline(),
+                        self.sticky_key, excluded={self.replica_id},
+                        trace=rq.context if rq is not None else None)
+                except (NoHealthyReplica, ServerOverloaded) as e:
+                    last = e
+                    if time.monotonic() >= give_up:
+                        raise RequeueExhausted(
+                            'no replica accepted the requeued request '
+                            'for model %r: %r' % (self.model, last),
+                            last_error=cause)
+                    time.sleep(min(0.02, router.poll_interval))
+                else:
+                    self.requeues += 1
+                    self.replicas_tried.append(rid)
+                    self._req = req
+                    if rq is not None:
+                        rq.end(to_replica=rid)
+                    return
+        except Exception as e:
+            if rq is not None:
+                rq.end(error=type(e).__name__)
+            raise
 
 
 class Router(object):
@@ -372,12 +408,14 @@ class Router(object):
         return [(s, rep) for s, _, rep in scored]
 
     def _submit_once(self, name, feeds, deadline, sticky_key,
-                     excluded=()):
+                     excluded=(), trace=None):
         """One routing decision + submit. Tries candidates cheapest
         first (sticky preference up front), stepping past replicas
         that refuse admission. Raises typed: the last ServerOverloaded
         when every candidate is merely full, NoHealthyReplica when
-        there was nothing to try."""
+        there was nothing to try. ``trace`` parents the replica-side
+        ``serving/request`` span (a RemoteCell forwards it by
+        pickle)."""
         cands = self._candidates(name, excluded=excluded)
         if sticky_key is not None and len(cands) > 1:
             with self._lock:
@@ -387,7 +425,8 @@ class Router(object):
         overloaded = None
         for _score, rep in cands:
             try:
-                req = rep.server.submit(name, feeds, deadline=deadline)
+                req = rep.server.submit(name, feeds, deadline=deadline,
+                                        trace=trace)
             except ServerOverloaded as e:
                 overloaded = e
                 continue
@@ -415,9 +454,22 @@ class Router(object):
                 raise ServerClosed('router is shut down')
         deadline_abs = None if deadline is None \
             else time.monotonic() + deadline
-        req, rid = self._submit_once(name, feeds, deadline, sticky_key)
+        # the whole fleet-side lifetime (attempts + requeue hops) is
+        # ONE root span; every replica attempt parents under it
+        span = _obs.start_span('fleet/request', activate=False,
+                               model=name)
+        if span.context is None:
+            span = None
+        try:
+            req, rid = self._submit_once(
+                name, feeds, deadline, sticky_key,
+                trace=span.context if span is not None else None)
+        except Exception as e:
+            if span is not None:
+                span.end(error=type(e).__name__)
+            raise
         return RoutedRequest(self, name, feeds, deadline_abs, req, rid,
-                             sticky_key=sticky_key)
+                             sticky_key=sticky_key, span=span)
 
     def infer(self, name, feeds, deadline=None, sticky_key=None,
               timeout=30.0):

@@ -33,6 +33,7 @@ import subprocess
 import sys
 import time
 
+from .. import observability as _obs
 from .events import mh_emit
 from .heartbeat import DEFAULT_INTERVAL, HostMonitor
 
@@ -82,6 +83,15 @@ def _spawn(cmd, rank, world, gen, port, hb_dir, hb_interval,
             % devices_per_host).strip()
     if gen > 0:
         env['PTPU_RESUME'] = '1'
+    # tracing env contract: the worker's train/run root parents under
+    # the launcher's span (PTPU_TRACE_PARENT header) and journals into
+    # its own per-rank file; PTPU_TRACE_SAMPLE rides base_env unchanged
+    ctx = _obs.current_context()
+    if ctx is not None:
+        env[_obs.TRACE_PARENT_ENV] = ctx.to_header()
+    if _obs.journal_active() and _obs.JOURNAL_ENV not in env:
+        env[_obs.JOURNAL_ENV] = os.path.join(
+            hb_dir, 'journal_g%d_r%d.jsonl' % (gen, rank))
     env.update(extra_env or {})
     out = None
     if log_dir:
@@ -132,6 +142,23 @@ def launch(cmd, nproc, devices_per_host=1, heartbeat_window=10.0,
     world = int(nproc)
     gen = 0
     generations = []
+    # root of the pod-wide span tree: every worker's train/run parents
+    # under this via the PTPU_TRACE_PARENT header _spawn exports
+    lspan = _obs.start_span('launch/run', nproc=world)
+    try:
+        return _launch_loop(cmd, world, devices_per_host,
+                            heartbeat_window, heartbeat_interval,
+                            poll_interval, max_relaunches,
+                            startup_grace, base, log_dir, env,
+                            generations)
+    finally:
+        lspan.end(generations=len(generations))
+
+
+def _launch_loop(cmd, world, devices_per_host, heartbeat_window,
+                 heartbeat_interval, poll_interval, max_relaunches,
+                 startup_grace, base, log_dir, env, generations):
+    gen = 0
     while True:
         port = free_port()
         hb_dir = os.path.join(base, 'hb_gen%d' % gen)

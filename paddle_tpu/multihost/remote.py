@@ -33,6 +33,7 @@ import tempfile
 import threading
 import time
 
+from .. import observability as _obs
 from ..serving.errors import (DeadlineExceeded, ServerClosed,
                               ServingError)
 
@@ -69,7 +70,19 @@ def serve(port_file, place=None):
     Binds 127.0.0.1:0, publishes the port atomically through
     ``port_file``, serves requests until ``close`` or EOF. ``submit``
     is asynchronous server-side too — a waiter thread replies when the
-    batch resolves, so one slow request never blocks control ops."""
+    batch resolves, so one slow request never blocks control ops.
+
+    When ``PTPU_JOURNAL`` names a path, the worker installs a
+    RunJournal there for its lifetime: TraceContexts arriving on
+    ``submit`` (pickled through the protocol) continue their tree in
+    this process's own journal, flushed per message so a ``kill -9``
+    leaves the in-flight ``span_begin`` on disk — the unclosed span
+    trace_report reports for work that died with the host."""
+    jpath = os.environ.get(_obs.JOURNAL_ENV)
+    jnl = None
+    if jpath:
+        jnl = _obs.RunJournal(jpath)
+        _obs.set_journal(jnl)
     from ..serving import ModelServer
     srv = ModelServer(place=place)
     lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -116,6 +129,9 @@ def serve(port_file, place=None):
                 except Exception as e:  # noqa: BLE001 — typed refusal
                     _reply(mid, False, e)
                     continue
+                finally:
+                    if jnl is not None:
+                        jnl.flush()
                 timeout = kwargs.get('deadline') or 60.0
                 threading.Thread(
                     target=_wait_and_reply, args=(mid, req, timeout),
@@ -140,6 +156,9 @@ def serve(port_file, place=None):
         except Exception:  # noqa: BLE001 — already closed
             pass
         conn.close()
+        if jnl is not None:
+            _obs.set_journal(None)
+            jnl.close()
 
 
 # ---- client side ---------------------------------------------------------
@@ -182,6 +201,7 @@ class RemoteCell(object):
     def __init__(self, proc, sock, name='remote-cell'):
         self.proc = proc
         self.name = name
+        self.journal_path = None   # set by spawn_cell when tracing
         self._sock = sock
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
@@ -280,6 +300,12 @@ class RemoteCell(object):
         return self._call('warmup', model_name, upto=upto,
                           timeout=timeout, _timeout=timeout + 10.0)
 
+    def pause(self, model_name=None):
+        return self._call('pause', model_name, _timeout=10.0)
+
+    def resume(self, model_name=None):
+        return self._call('resume', model_name, _timeout=10.0)
+
     def queue_depth(self, model_name):
         return self._call('queue_depth', model_name, _timeout=10.0)
 
@@ -321,6 +347,14 @@ def spawn_cell(name='remote-cell', devices=1, env=None,
     child_env = dict(os.environ)
     child_env.update(env or {})
     child_env.setdefault('JAX_PLATFORMS', 'cpu')
+    # a journaling parent gets a journaling worker: each process writes
+    # its OWN file; trace_report/timeline merge them by trace id.
+    # PTPU_TRACE_SAMPLE rides the inherited environ unchanged, so the
+    # worker agrees with the parent's sampling decisions.
+    journal_path = child_env.get(_obs.JOURNAL_ENV)
+    if not journal_path and _obs.journal_active():
+        journal_path = os.path.join(workdir, 'journal.jsonl')
+        child_env[_obs.JOURNAL_ENV] = journal_path
     flags = child_env.get('XLA_FLAGS', '')
     if 'xla_force_host_platform_device_count' not in flags:
         child_env['XLA_FLAGS'] = (
@@ -351,7 +385,9 @@ def spawn_cell(name='remote-cell', devices=1, env=None,
         port = int(f.read().strip())
     sock = socket.create_connection(('127.0.0.1', port), timeout=30.0)
     sock.settimeout(None)
-    return RemoteCell(proc, sock, name=name)
+    cell = RemoteCell(proc, sock, name=name)
+    cell.journal_path = journal_path
+    return cell
 
 
 def _main(argv=None):

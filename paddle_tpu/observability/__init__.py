@@ -14,17 +14,33 @@ Two complementary surfaces, both stdlib-only and import-cycle-free:
   default; install one with :func:`journal` / :func:`set_journal` and
   render it with ``tools/obs_report.py`` or merge it into a
   chrome://tracing view with ``tools/timeline.py --journal_path``.
+- :mod:`~paddle_tpu.observability.tracing` — distributed tracing over
+  the journal: propagated :class:`TraceContext` ids, ``span_begin`` /
+  ``span_end`` / ``span_link`` events, a ``PTPU_TRACE_SAMPLE``
+  sampling knob. Reconstruct trees with ``tools/trace_report.py``,
+  merge per-process journals with repeated ``--journal_path`` flags.
 """
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, default_registry,
                       DEFAULT_SECONDS_EDGES)
-from .journal import (SCHEMA_VERSION, RunJournal, set_journal,  # noqa
-                      get_journal, journal, journal_active, emit,
-                      read_journal)
+from .journal import (SCHEMA_VERSION, JOURNAL_ENV, RunJournal,  # noqa
+                      set_journal, get_journal, journal,
+                      journal_active, emit, read_journal,
+                      install_env_journal)
+from .tracing import (TraceContext, Span, NULL_SPAN,  # noqa: F401
+                      start_span, span, current_span, current_context,
+                      link, emit_span, sample_rate, parent_from_env,
+                      TRACE_PARENT_ENV, TRACE_SAMPLE_ENV)
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
     'default_registry', 'DEFAULT_SECONDS_EDGES',
-    'SCHEMA_VERSION', 'RunJournal', 'set_journal', 'get_journal',
+    'SCHEMA_VERSION', 'JOURNAL_ENV', 'RunJournal', 'set_journal',
+    'get_journal',
     'journal', 'journal_active', 'emit', 'read_journal',
+    'install_env_journal',
+    'TraceContext', 'Span', 'NULL_SPAN', 'start_span', 'span',
+    'current_span', 'current_context', 'link', 'emit_span',
+    'sample_rate', 'parent_from_env', 'TRACE_PARENT_ENV',
+    'TRACE_SAMPLE_ENV',
 ]

@@ -23,6 +23,8 @@ Event schema (OBSERVABILITY.md has the full field tables):
 ``serving_watchdog_trip``  model, stage, failed, overrun_s
 ``serving_drain`` / ``serving_swap`` / ``serving_abandoned_worker``
 ``anomaly``        kind, where, policy (AnomalyGuard trips)
+``span_begin`` / ``span_end`` / ``span_link``  distributed tracing
+                   (tracing.py): name, trace/span/parent ids, dur_s
 =================  =====================================================
 
 Records with a ``dur_s`` field are SPANS — ``tools/timeline.py`` can
@@ -32,9 +34,10 @@ merge them into a chrome://tracing view on their own track, and
 Overhead contract: journalling is OFF by default — every wiring point
 goes through :func:`emit`, which is a module-global ``None`` check when
 no journal is installed. With a journal installed, records buffer in
-memory and flush every ``buffer_lines`` records (or ``flush_interval``
-seconds), so the hot path pays one ``json.dumps`` and a list append,
-never a syscall per event.
+memory as dicts and flush every ``buffer_lines`` records (or
+``flush_interval`` seconds), so the hot path pays one dict build and a
+list append — JSON serialization is batched into the flush, and there
+is never a syscall per event.
 """
 import contextlib
 import json
@@ -43,10 +46,16 @@ import threading
 import time
 import uuid
 
-__all__ = ['SCHEMA_VERSION', 'RunJournal', 'set_journal', 'get_journal',
-           'journal', 'journal_active', 'emit', 'read_journal']
+__all__ = ['SCHEMA_VERSION', 'JOURNAL_ENV', 'RunJournal', 'set_journal',
+           'get_journal', 'journal', 'journal_active', 'emit',
+           'read_journal', 'install_env_journal']
 
 SCHEMA_VERSION = 1
+
+# env contract: a worker process that finds this set installs a
+# RunJournal at the named path for its whole lifetime (remote cells,
+# launcher-spawned hosts) — every process writes its OWN file
+JOURNAL_ENV = 'PTPU_JOURNAL'
 
 
 def _jsonable(obj):
@@ -58,11 +67,17 @@ def _jsonable(obj):
         return repr(obj)
 
 
+# json.dumps with a ``default=`` argument builds a fresh JSONEncoder on
+# every call — measurable on the per-record hot path. One shared
+# encoder (stateless, thread-safe) halves the serialization cost.
+_ENCODER = json.JSONEncoder(separators=(',', ':'), default=_jsonable)
+
+
 class RunJournal(object):
     """Buffered, thread-safe JSONL event writer with a stable run id."""
 
     def __init__(self, path, run_id=None, buffer_lines=128,
-                 flush_interval=2.0):
+                 flush_interval=2.0, max_bytes=None):
         self.path = path
         self.run_id = run_id or uuid.uuid4().hex[:12]
         self._lock = threading.Lock()
@@ -70,11 +85,15 @@ class RunJournal(object):
         self._closed = False
         self._buffer_lines = int(buffer_lines)
         self._flush_interval = float(flush_interval)
+        self._max_bytes = int(max_bytes) if max_bytes else 0
+        self._bytes = 0
+        self.rotations = 0
         self._t0 = time.monotonic()
+        self._wall0 = time.time()
         self._last_flush = self._t0
         self._f = open(path, 'w')
         self.counts = {}   # event type -> records written (introspection)
-        self.record('run_begin', wall=time.time(), pid=os.getpid(),
+        self.record('run_begin', wall=self._wall0, pid=os.getpid(),
                     schema=SCHEMA_VERSION)
 
     # ---- writing ---------------------------------------------------------
@@ -85,11 +104,10 @@ class RunJournal(object):
         rec = {'ev': ev, 'run': self.run_id,
                't': round(now - self._t0, 6)}
         rec.update(fields)
-        line = json.dumps(rec, separators=(',', ':'), default=_jsonable)
         with self._lock:
             if self._closed:
                 return
-            self._buf.append(line)
+            self._buf.append(rec)
             self.counts[ev] = self.counts.get(ev, 0) + 1
             if len(self._buf) >= self._buffer_lines or \
                     now - self._last_flush >= self._flush_interval:
@@ -107,10 +125,39 @@ class RunJournal(object):
 
     def _flush_locked(self, now):
         if self._buf:
-            self._f.write('\n'.join(self._buf) + '\n')
+            # records buffer as dicts; serialization is batched here,
+            # off the per-event hot path (fields are never mutated
+            # after record(), so deferred encoding sees the same data)
+            enc = _ENCODER.encode
+            chunk = '\n'.join(enc(r) for r in self._buf) + '\n'
+            self._f.write(chunk)
             self._f.flush()
+            self._bytes += len(chunk)
             del self._buf[:]
+            if self._max_bytes and self._bytes >= self._max_bytes:
+                self._rotate_locked()
         self._last_flush = now
+
+    def _rotate_locked(self):
+        """Roll the current file to ``<path>.1`` (one generation kept)
+        and restart the live file with a fresh ``run_begin`` carrying
+        the ORIGINAL wall anchor — ``t`` offsets keep counting from the
+        run's ``_t0``, so clock alignment in timeline/trace_report is
+        unchanged across a rotation."""
+        self._f.close()
+        os.replace(self.path, self.path + '.1')
+        self._f = open(self.path, 'w')
+        self._bytes = 0
+        self.rotations += 1
+        rec = {'ev': 'run_begin', 'run': self.run_id,
+               't': round(time.monotonic() - self._t0, 6),
+               'wall': self._wall0, 'pid': os.getpid(),
+               'schema': SCHEMA_VERSION, 'rotated': self.rotations}
+        line = json.dumps(rec, separators=(',', ':'), default=_jsonable)
+        self._f.write(line + '\n')
+        self._f.flush()
+        self._bytes += len(line) + 1
+        self.counts['run_begin'] = self.counts.get('run_begin', 0) + 1
 
     def flush(self):
         with self._lock:
@@ -168,6 +215,19 @@ def journal(path, run_id=None, **kwargs):
     finally:
         set_journal(prev)
         j.close()
+
+
+def install_env_journal(**kwargs):
+    """Honor the ``PTPU_JOURNAL`` env contract: install a RunJournal at
+    the named path for the process lifetime. A worker script spawned by
+    the launcher calls this once at startup; returns the journal, or
+    None when the env var is unset or a journal is already installed."""
+    path = os.environ.get(JOURNAL_ENV)
+    if not path or _JOURNAL is not None:
+        return None
+    j = RunJournal(path, **kwargs)
+    set_journal(j)
+    return j
 
 
 def emit(ev, **fields):

@@ -123,7 +123,7 @@ class Histogram(object):
     are cumulative in the exposition (Prometheus ``le`` semantics)."""
 
     __slots__ = ('name', 'labels', 'edges', '_lock', '_counts', '_sum',
-                 '_count', '_max')
+                 '_count', '_max', '_exemplars')
 
     def __init__(self, name, labels=(), edges=None):
         self.name = name
@@ -135,8 +135,12 @@ class Histogram(object):
         self._sum = 0.0
         self._count = 0
         self._max = 0.0
+        self._exemplars = {}   # bucket index -> (exemplar, value)
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
+        """Record one sample; ``exemplar`` (a trace id) is kept
+        last-write-wins for the bucket the sample lands in, so "p99 is
+        bad" resolves to a concrete trace (OBSERVABILITY.md)."""
         v = float(v)
         idx = len(self.edges)
         for i, edge in enumerate(self.edges):
@@ -149,6 +153,8 @@ class Histogram(object):
             self._count += 1
             if v > self._max:
                 self._max = v
+            if exemplar is not None:
+                self._exemplars[idx] = (exemplar, v)
 
     @property
     def count(self):
@@ -174,25 +180,54 @@ class Histogram(object):
                 return self.edges[i] if i < len(self.edges) else mx
         return mx
 
+    def exemplar(self, q):
+        """The exemplar attached to the bucket holding the q-th sample
+        — ``(exemplar, observed_value)`` or None. Falls back to the
+        nearest populated lower bucket with an exemplar, so a p99 probe
+        still resolves when the exact bucket never got one."""
+        with self._lock:
+            counts, total = list(self._counts), self._count
+            exemplars = dict(self._exemplars)
+        if not total:
+            return None
+        target, seen, idx = q * total, 0, len(counts) - 1
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target and c:
+                idx = i
+                break
+        for i in range(idx, -1, -1):
+            if i in exemplars:
+                return exemplars[i]
+        return None
+
     def _reset(self):
         with self._lock:
             self._counts = [0] * (len(self.edges) + 1)
             self._sum = 0.0
             self._count = 0
             self._max = 0.0
+            self._exemplars = {}
 
     def _series(self):
         with self._lock:
             counts = list(self._counts)
             s, n, mx = self._sum, self._count, self._max
+            exemplars = dict(self._exemplars)
         buckets, cum = {}, 0
         for edge, c in zip(self.edges, counts):
             cum += c
             buckets[repr(edge)] = cum
         buckets['+Inf'] = n
-        return {'labels': dict(self.labels), 'count': n, 'sum': s,
-                'max': mx, 'mean': (s / n if n else 0.0),
-                'buckets': buckets}
+        out = {'labels': dict(self.labels), 'count': n, 'sum': s,
+               'max': mx, 'mean': (s / n if n else 0.0),
+               'buckets': buckets}
+        if exemplars:
+            out['exemplars'] = {
+                (repr(self.edges[i]) if i < len(self.edges) else '+Inf'):
+                {'exemplar': ex, 'value': v}
+                for i, (ex, v) in sorted(exemplars.items())}
+        return out
 
     def _expose(self):
         with self._lock:

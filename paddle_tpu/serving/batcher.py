@@ -30,9 +30,11 @@ class InferenceRequest(object):
     calling client thread on an Event, never a busy-wait."""
 
     __slots__ = ('feeds', 'n', 'signature', 'deadline', 'submit_time',
-                 '_event', '_result', '_error', 'warmup', 'probe')
+                 '_event', '_result', '_error', 'warmup', 'probe',
+                 'trace', '_qspan')
 
-    def __init__(self, feeds, n, deadline=None, warmup=False):
+    def __init__(self, feeds, n, deadline=None, warmup=False,
+                 trace=None):
         self.feeds = feeds
         self.n = n
         self.signature = tuple(sorted(
@@ -42,6 +44,8 @@ class InferenceRequest(object):
         self.submit_time = _now()
         self.warmup = warmup
         self.probe = False    # admitted as a half-open breaker probe
+        self.trace = trace    # TraceContext propagated from the caller
+        self._qspan = None    # serving/request span, ended by _complete
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -51,10 +55,17 @@ class InferenceRequest(object):
             (now if now is not None else _now()) > self.deadline
 
     def set_result(self, fetches):
+        if self._qspan is not None:
+            self._qspan.end(ok=True)
         self._result = fetches
         self._event.set()
 
     def set_error(self, error):
+        # an errored completion still closes the serving/request span
+        # (with the error name), so only work that died with its whole
+        # process shows up as an UNCLOSED span in trace_report
+        if self._qspan is not None:
+            self._qspan.end(error=type(error).__name__)
         self._error = error
         self._event.set()
 

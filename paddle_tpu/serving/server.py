@@ -273,12 +273,16 @@ class ModelServer(object):
         self.stats.record_breaker_state(model.name, CLOSED)
 
     # ---- client surface --------------------------------------------------
-    def submit(self, model_name, feeds, deadline=None, _warmup=False):
+    def submit(self, model_name, feeds, deadline=None, _warmup=False,
+               trace=None):
         """Enqueue one request; returns an :class:`InferenceRequest`
         future. ``deadline`` is relative seconds — the request fails
-        with DeadlineExceeded if no worker launches it in time. Raises
-        ServerOverloaded / ServerClosed / ModelNotFound / CircuitOpen
-        synchronously.
+        with DeadlineExceeded if no worker launches it in time.
+        ``trace`` is an optional parent :class:`TraceContext` (a fleet
+        router's request span; pickles through a RemoteCell hop) —
+        this submission becomes a ``serving/request`` child span.
+        Raises ServerOverloaded / ServerClosed / ModelNotFound /
+        CircuitOpen synchronously.
         """
         model = self.registry.get(model_name)
         with self._lock:
@@ -294,12 +298,21 @@ class ModelServer(object):
             else time.monotonic() + deadline
         req = InferenceRequest(feeds, n, deadline=abs_deadline,
                                warmup=_warmup)
+        if not _warmup:
+            qspan = _obs.start_span('serving/request', parent=trace,
+                                    activate=False, model=model_name,
+                                    rows=n)
+            if qspan.context is not None:
+                req._qspan = qspan
+                req.trace = qspan.context
         breaker = self._breakers.get(model_name)
         if breaker is not None and not _warmup:
             try:
                 req.probe = breaker.admit()
             except CircuitOpen:
                 self.stats.record_breaker_rejected(model_name)
+                if req._qspan is not None:
+                    req._qspan.end(error='CircuitOpen')
                 raise
         try:
             batcher.submit(req)
@@ -307,6 +320,8 @@ class ModelServer(object):
             if req.probe:
                 breaker.release_probe()
             self.stats.record_shed()
+            if req._qspan is not None:
+                req._qspan.end(error='shed')
             raise
         self.stats.record_submitted()
         return req
@@ -571,6 +586,11 @@ class ModelServer(object):
             if w.is_alive():
                 self._abandon_worker(name, batchers.get(name), w)
         self.watchdog.stop()
+        # push buffered journal tail to disk: a SIGTERM'd or killed
+        # replica must not lose the spans of its last in-flight batch
+        j = _obs.get_journal()
+        if j is not None:
+            j.flush()
 
     def __enter__(self):
         return self
@@ -645,6 +665,13 @@ class ModelServer(object):
         capped by the batch's earliest request deadline."""
         def _on_retry(attempt, error):
             self.stats.record_retry()
+            # a zero-length marker span under the active serving/run
+            # span: the retry storm is visible in the request's tree
+            ctx = _obs.current_context()
+            if ctx is not None:
+                _obs.emit_span('serving/retry', 0.0, parent=ctx,
+                               attempt=attempt,
+                               error=type(error).__name__)
         return retry_call(self._exe_run, (model, feed),
                           max_attempts=self.retry_attempts,
                           backoff=self.retry_backoff,
@@ -658,13 +685,43 @@ class ModelServer(object):
     def _run_batch(self, model, batch):
         """Run one coalesced batch. Returns True when the watchdog
         tripped a stage mid-flight — the futures are already failed, so
-        the caller must not complete (or count) them again."""
+        the caller must not complete (or count) them again.
+
+        Tracing: each traced request gets a ``serving/queue`` span for
+        its time-in-queue; the batch itself runs under ONE
+        ``serving/batch`` span (parented to the first traced request)
+        ``span_link``-ed to every request it serves — the N↔1 coalesce
+        is a link, not a parent edge. The batch span is active on this
+        worker thread, so pad/run and Executor child spans nest."""
+        now = time.monotonic()
+        for r in batch:
+            if r.trace is not None:
+                _obs.emit_span('serving/queue', now - r.submit_time,
+                               parent=r.trace, model=model.name)
+        traced = [r.trace for r in batch if r.trace is not None]
+        bspan = None
+        if traced:
+            bspan = _obs.start_span('serving/batch', parent=traced[0],
+                                    model=model.name,
+                                    requests=len(batch))
+            for t in traced:
+                _obs.link(bspan, t)
+        try:
+            return self._run_batch_stages(model, batch, bspan)
+        finally:
+            if bspan is not None:
+                bspan.end()
+
+    def _run_batch_stages(self, model, batch, bspan):
         feed, rows, slices = merge_requests(batch)
         bucket = self.policy.bucket_for(rows) if model.batchable else rows
         deadline = self._earliest_deadline(batch)
         token = self.watchdog.enter(
             model.name, _fi.SITE_SERVING_PAD,
             self.stage_timeouts.get(_fi.SITE_SERVING_PAD), batch)
+        pspan = _obs.start_span('serving/pad', rows=rows,
+                                bucket=bucket) \
+            if bspan is not None else None
         try:
             with _prof.serving_span('serving/pad'):
                 _fi.maybe_fault(_fi.SITE_SERVING_PAD)
@@ -672,18 +729,25 @@ class ModelServer(object):
                                   self.policy.pad_mode)
         finally:
             pad_entry = self.watchdog.exit(token)
+            if pspan is not None:
+                pspan.end()
         if pad_entry is None:
             return True
         t0 = time.monotonic()
         token = self.watchdog.enter(
             model.name, _fi.SITE_SERVING_RUN,
             self.stage_timeouts.get(_fi.SITE_SERVING_RUN), batch)
+        rspan = _obs.start_span('serving/run', rows=rows,
+                                bucket=bucket) \
+            if bspan is not None else None
         try:
             with _prof.serving_span('serving/batch_run'):
                 fetches = self._run_guarded(model, padded,
                                             deadline=deadline)
         finally:
             run_entry = self.watchdog.exit(token)
+            if rspan is not None:
+                rspan.end()
         if run_entry is None:
             return True
         breaker = self._breakers.get(model.name)
@@ -703,12 +767,17 @@ class ModelServer(object):
                     model.name, _fi.SITE_SERVING_RUN,
                     self.stage_timeouts.get(_fi.SITE_SERVING_RUN),
                     [req])
+                espan = _obs.start_span('serving/exact_run',
+                                        parent=req.trace, rows=req.n) \
+                    if req.trace is not None else None
                 try:
                     with _prof.serving_span('serving/exact_fallback'):
                         out = self._run_guarded(model, req.feeds,
                                                 deadline=req.deadline)
                 finally:
                     entry = self.watchdog.exit(token)
+                    if espan is not None:
+                        espan.end()
                 if entry is None:
                     continue           # tripped: future already failed
                 self._complete(req, out)
@@ -720,6 +789,8 @@ class ModelServer(object):
     def _complete(self, req, fetches):
         latency = req.latency()
         if not req.warmup:
-            self.stats.record_completed(latency)
+            trace_id = req.trace.trace_id \
+                if (req.trace is not None and req.trace.sampled) else None
+            self.stats.record_completed(latency, trace=trace_id)
             _prof.record_serving_event('serving/request', latency)
         req.set_result(fetches)

@@ -209,13 +209,15 @@ class ServingStats(object):
         _obs.emit('serving_batch', rows=rows, bucket=bucket,
                   dur_s=round(seconds, 6))
 
-    def record_completed(self, latency_seconds, n=1):
+    def record_completed(self, latency_seconds, n=1, trace=None):
         with self._lock:
             self.completed += n
             for _ in range(n):
                 self.request_latency.record(latency_seconds)
         self._m['completed'].inc(n)
-        self._m['request_lat'].observe(latency_seconds)
+        # the trace id rides the latency bucket as an exemplar, so a
+        # bad p99 resolves to a concrete trace (OBSERVABILITY.md)
+        self._m['request_lat'].observe(latency_seconds, exemplar=trace)
 
     # ---- snapshots -------------------------------------------------------
     def occupancy(self):

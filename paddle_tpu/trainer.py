@@ -453,6 +453,13 @@ class Trainer(object):
         _obs.emit('train_begin', epochs=num_epochs,
                   start_epoch=start_epoch, global_step=global_step,
                   prefetch=prefetch, steps_per_dispatch=chain_k)
+        # root of this run's span tree; under the launcher a worker
+        # inherits the host-level parent via PTPU_TRACE_PARENT, so
+        # trees from every host merge under one trace id
+        tspan = _obs.start_span('train/run',
+                                parent=_obs.parent_from_env(),
+                                epochs=num_epochs,
+                                steps_per_dispatch=chain_k)
 
         def flush(epoch_id, chunk):
             """Dispatch a collected chunk (1 step, or K chained) and run
@@ -463,19 +470,32 @@ class Trainer(object):
             run_fetches = (fetch_names + grad_names) if want_fetch \
                 else []
             gs0 = global_step
+            # activated on the loop thread so exe/run | exe/chain and
+            # their verify/compile/dispatch children nest underneath.
+            # A 1-step chunk IS the step: no wrapper span — exe/run and
+            # train/step hang off train/run directly, keeping the
+            # default steps_per_dispatch=1 path inside the tracing
+            # overhead budget (bench.py bench_tracing_overhead)
+            cspan = _obs.start_span('train/chunk', steps=len(chunk),
+                                    global_step=gs0) \
+                if len(chunk) > 1 else None
             t0 = time.monotonic()
             # ONE dispatch surface for both executors: the PE facade
             # forwards to the same Executor.run/run_chained (sharded
             # when its Partitioner's mesh is real) — the PR-5 clamps
             # (K forced to 1, no staging on the PE path) are gone.
-            if len(chunk) > 1:
-                outs_steps = exe.run_chained(
-                    feed_list=[c[2] for c in chunk],
-                    fetch_list=run_fetches, async_fetch=lazy)
-            else:
-                outs_steps = [exe.run(feed=chunk[0][2],
-                                      fetch_list=run_fetches,
-                                      async_fetch=lazy)]
+            try:
+                if len(chunk) > 1:
+                    outs_steps = exe.run_chained(
+                        feed_list=[c[2] for c in chunk],
+                        fetch_list=run_fetches, async_fetch=lazy)
+                else:
+                    outs_steps = [exe.run(feed=chunk[0][2],
+                                          fetch_list=run_fetches,
+                                          async_fetch=lazy)]
+            finally:
+                if cspan is not None:
+                    cspan.end()
             dispatch_wall = time.monotonic() - t0
             m_dispatch.observe(dispatch_wall)
             per_step = dispatch_wall / len(chunk)
@@ -536,6 +556,14 @@ class Trainer(object):
                     if grad_norm is not None:
                         rec['grad_norm'] = grad_norm
                     _obs.emit('step_end', **rec)
+                    # pre-measured: the step's share of the chunk
+                    # dispatch plus its own host wait. parent=None
+                    # (1-step chunk) inherits the thread's active
+                    # train/run span — never a fresh root, since the
+                    # journal is active here and train/run is too
+                    _obs.emit_span('train/step', step_wall,
+                                   parent=cspan, step=step_id,
+                                   global_step=global_step)
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
             if cfg is not None and (global_step // cfg.step_interval) \
                     > (gs0 // cfg.step_interval):
@@ -559,6 +587,11 @@ class Trainer(object):
                         'SIGTERM/SIGINT').inc()
             _obs.emit('preempt_save', signal=int(sig), epoch=epoch_id,
                       step=last_step, global_step=global_step)
+            j = _obs.get_journal()
+            if j is not None:
+                # the process is about to die: buffered records (this
+                # preempt_save included) must hit disk now
+                j.flush()
             _logger.warning(
                 'preemption (signal %d): committed checkpoint at chunk '
                 'boundary (epoch %d, step %d, global step %d); exiting '
@@ -647,6 +680,7 @@ class Trainer(object):
                                                    -1, global_step,
                                                    exe=exe)
         finally:
+            tspan.end(steps=steps_done)
             for s, h in prev_handlers.items():
                 try:
                     _signal.signal(s, h)

@@ -123,6 +123,57 @@ def test_journal_merge_track(tmp_path):
                'r1' in e['args']['name'] for e in evs)
 
 
+def test_multi_journal_clock_alignment(tmp_path):
+    """Repeated --journal_path: every journal gets its own pid track,
+    and tracks are clock-aligned through their run_begin wall anchors —
+    the earliest anchor is the shared origin, so an event at monotonic
+    t in a later-started journal lands at (wall_skew + t)."""
+    j1 = str(tmp_path / 'host_a.jsonl')
+    j2 = str(tmp_path / 'host_b.jsonl')
+    # host_b's run began 2.5 wall-seconds after host_a's
+    _write_journal(j1, [
+        {'ev': 'run_begin', 'run': 'ra', 't': 0.0, 'wall': 100.0,
+         'pid': 11, 'schema': 1},
+        {'ev': 'step_end', 'run': 'ra', 't': 1.0, 'dur_s': 0.5,
+         'step': 0},
+    ])
+    _write_journal(j2, [
+        {'ev': 'run_begin', 'run': 'rb', 't': 0.0, 'wall': 102.5,
+         'pid': 22, 'schema': 1},
+        {'ev': 'span_end', 'run': 'rb', 't': 1.0, 'dur_s': 0.25,
+         'name': 'serving/request', 'trace': 'T1', 'span': 'S1',
+         'parent': None},
+        {'ev': 'span_begin', 'run': 'rb', 't': 0.8,
+         'name': 'serving/request', 'trace': 'T1', 'span': 'S1',
+         'parent': None},
+    ])
+    out = str(tmp_path / 'tl.json')
+    _run(['--journal_path', j1, '--journal_path', j2,
+          '--timeline_path', out])
+    trace = json.load(open(out))
+    _assert_catapult(trace)
+    evs = [e for e in trace['traceEvents'] if e['ph'] == 'X']
+    by_name = {e['name']: e for e in evs}
+    # two separate pid tracks, labeled with run id + worker pid
+    assert by_name['step_end']['pid'] != by_name['serving/request']['pid']
+    procs = {e['args']['name'] for e in trace['traceEvents']
+             if e['ph'] == 'M' and e['name'] == 'process_name'}
+    assert 'journal(run ra, pid 11)' in procs
+    assert 'journal(run rb, pid 22)' in procs
+    # host_a anchors the origin: its step_end ends at t=1.0 with 0.5s
+    # duration -> slice starts at 0.5s = 500000us
+    assert by_name['step_end']['ts'] == 500000
+    # host_b is skewed +2.5s: its span ends at 2.5+1.0=3.5s, minus the
+    # 0.25s duration -> slice starts at 3.25s
+    assert by_name['serving/request']['ts'] == 3250000
+    assert by_name['serving/request']['dur'] == 250000
+    # span_end rows by SPAN name; span_begin is structure, not a row
+    rows = {e['args']['name'] for e in trace['traceEvents']
+            if e['ph'] == 'M' and e['name'] == 'thread_name'}
+    assert 'serving/request' in rows
+    assert 'span_begin' not in rows
+
+
 def test_journal_only_and_malformed_lines(tmp_path):
     """A journal alone is a valid input; malformed lines are skipped
     (the smoke gate, not the viewer, polices them)."""

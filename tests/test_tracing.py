@@ -1,0 +1,488 @@
+"""Distributed tracing (OBSERVABILITY.md "Distributed tracing").
+
+Acceptance pins (ISSUE 14):
+- span trees reconstruct ACROSS PROCESS BOUNDARIES: a request routed
+  through the fleet Router to remote cells, requeued off a killed
+  replica, shares ONE trace id over three journals, with the requeue
+  hop a child span and the dead replica's attempt left unclosed;
+- batch<->request links are N-to-1 (one coalesced serving/batch span
+  links every request span it serves) and trace_report grafts the
+  batch subtree under each linked request;
+- sampling is decided once per root (``PTPU_TRACE_SAMPLE``): rate 0
+  journals ZERO span events while metrics and plain journal records
+  stay intact;
+- the journal rotates at ``max_bytes`` preserving the wall anchor, and
+  ModelServer.close flushes the installed journal so buffered spans
+  hit disk before the process exits.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import tracing
+from paddle_tpu.serving import ModelServer, ServerClosed
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..',
+                                'tools'))
+import trace_report  # noqa: E402
+
+pytestmark = pytest.mark.observability
+
+IN_DIM, OUT_DIM = 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_tracing_env(monkeypatch):
+    monkeypatch.delenv(obs.TRACE_SAMPLE_ENV, raising=False)
+    monkeypatch.delenv(obs.TRACE_PARENT_ENV, raising=False)
+    monkeypatch.delenv(obs.JOURNAL_ENV, raising=False)
+
+
+def _save_artifact(tmp_path, name='m0', seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[IN_DIM],
+                                  dtype='float32')
+            h = fluid.layers.fc(input=x, size=8, act='relu')
+            y = fluid.layers.fc(input=h, size=OUT_DIM, act=None)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / name)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ['x'], [y], exe,
+                                      main_program=main)
+    return d
+
+
+def _spans(journal_path, ev='span_end'):
+    recs, malformed = obs.read_journal(journal_path)
+    assert malformed == 0
+    return [r for r in recs if r['ev'] == ev]
+
+
+# ---- core API ------------------------------------------------------------
+def test_null_span_without_journal():
+    assert not obs.journal_active()
+    sp = obs.start_span('x')
+    assert sp is obs.NULL_SPAN
+    assert sp.context is None
+    sp.end(ok=True)                      # never raises
+    with obs.span('y') as sp2:
+        assert sp2 is obs.NULL_SPAN
+    assert obs.current_context() is None
+    assert obs.emit_span('z', 0.01) is None
+
+
+def test_span_nesting_and_thread_local(tmp_path):
+    p = str(tmp_path / 'j.jsonl')
+    with obs.journal(p):
+        with obs.span('outer') as outer:
+            octx = outer.context
+            assert obs.current_span() is outer
+            with obs.span('inner') as inner:
+                assert inner.context.trace_id == octx.trace_id
+                assert inner.context.parent_id == octx.span_id
+            # inner popped itself; outer is current again
+            assert obs.current_span() is outer
+        assert obs.current_span() is None
+    ends = {r['name']: r for r in _spans(p)}
+    assert set(ends) == {'outer', 'inner'}
+    assert ends['inner']['parent'] == ends['outer']['span']
+    assert ends['outer']['parent'] is None
+    begins = {r['name'] for r in _spans(p, 'span_begin')}
+    assert begins == {'outer', 'inner'}
+
+
+def test_span_end_idempotent_and_error_field(tmp_path):
+    p = str(tmp_path / 'j.jsonl')
+    with obs.journal(p):
+        sp = obs.start_span('once')
+        sp.end(ok=True)
+        sp.end(ok=False)                 # second end is a no-op
+        with pytest.raises(ValueError):
+            with obs.span('boom'):
+                raise ValueError('x')
+    ends = _spans(p)
+    once = [r for r in ends if r['name'] == 'once']
+    assert len(once) == 1 and once[0]['ok'] is True
+    boom = [r for r in ends if r['name'] == 'boom']
+    assert boom[0]['error'] == 'ValueError'
+
+
+def test_header_roundtrip():
+    ctx = tracing.TraceContext('a' * 16, 'b' * 16, None, True)
+    back = tracing.TraceContext.from_header(ctx.to_header())
+    assert (back.trace_id, back.span_id, back.sampled) == \
+        (ctx.trace_id, ctx.span_id, True)
+    off = tracing.TraceContext('c' * 16, 'd' * 16, None, False)
+    assert tracing.TraceContext.from_header(off.to_header()).sampled \
+        is False
+    for bad in (None, '', 'garbage', 'a-b', '--0', 'a-b-c-d'):
+        assert tracing.TraceContext.from_header(bad) is None
+    env = {obs.TRACE_PARENT_ENV: ctx.to_header()}
+    got = obs.parent_from_env(env)
+    assert got.trace_id == ctx.trace_id
+    assert obs.parent_from_env({}) is None
+
+
+def test_sampling_deterministic_hash(monkeypatch):
+    monkeypatch.setenv(obs.TRACE_SAMPLE_ENV, '0.5')
+    # pure function of the id: the same trace id always lands on the
+    # same side, so re-rolls in other processes agree with the root
+    ids = ['%016x' % (i * 0x9e3779b97f4a7c15 % (1 << 64))
+           for i in range(64)]
+    first = [tracing._sampled(t) for t in ids]
+    assert [tracing._sampled(t) for t in ids] == first
+    assert any(first) and not all(first)
+    monkeypatch.setenv(obs.TRACE_SAMPLE_ENV, 'not-a-number')
+    assert tracing.sample_rate() == 1.0
+
+
+def test_sampling_zero_no_span_events_metrics_intact(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv(obs.TRACE_SAMPLE_ENV, '0')
+    d = _save_artifact(tmp_path)
+    p = str(tmp_path / 'j.jsonl')
+    reg = obs.default_registry()
+    with obs.journal(p):
+        root = obs.start_span('root')            # unsampled root
+        assert root.context.sampled is False
+        child = obs.start_span('child', parent=root)
+        assert child.context is tracing._UNSAMPLED
+        child.end()
+        root.end()
+        before = reg.counter('serving_requests_completed_total').value
+        with ModelServer(place=fluid.CPUPlace(), max_batch_size=4) \
+                as srv:
+            srv.load_model('m', d)
+            out, = srv.infer('m', {'x': np.ones((2, IN_DIM),
+                                                'float32')})
+            assert out.shape == (2, OUT_DIM)
+        after = reg.counter('serving_requests_completed_total').value
+    recs, _ = obs.read_journal(p)
+    by_ev = {}
+    for r in recs:
+        by_ev[r['ev']] = by_ev.get(r['ev'], 0) + 1
+    # zero span events of any kind...
+    assert not {'span_begin', 'span_end', 'span_link'} & set(by_ev)
+    # ...while metrics and plain journal records are intact
+    assert after == before + 1
+    assert by_ev.get('serving_batch', 0) >= 1
+
+
+# ---- serving: batch<->request links --------------------------------------
+def test_batch_link_n_to_1(tmp_path):
+    d = _save_artifact(tmp_path)
+    p = str(tmp_path / 'j.jsonl')
+    n = 3
+    with obs.journal(p):
+        with ModelServer(place=fluid.CPUPlace(), max_batch_size=8) \
+                as srv:
+            srv.load_model('m', d)
+            srv.warmup()
+            # pause the batcher so all N requests queue up, then resume:
+            # ONE coalesced batch serves all of them, deterministically
+            srv.pause('m')
+            reqs = [srv.submit('m', {'x': np.full((1, IN_DIM), i,
+                                                  'float32')})
+                    for i in range(n)]
+            srv.resume('m')
+            for r in reqs:
+                r.result(timeout=30.0)
+    store = trace_report.build_store([p])
+    requests = store.by_kind('serving/request').get('serving/request',
+                                                    [])
+    assert len(requests) == n      # warmup requests are not traced
+    # each request span is linked FROM a serving/batch span; one batch
+    # serves several requests (N-to-1, not parent-child)
+    batches = {s['span']: s
+               for s in store.by_kind('serving/batch').get(
+                   'serving/batch', [])}
+    linked_batches = set()
+    for req in requests:
+        froms = store.links.get(req['span'], [])
+        assert froms, 'request span has no batch link'
+        for b in froms:
+            assert store.spans[b]['name'] == 'serving/batch'
+            linked_batches.add(b)
+        # link grafting: the batch subtree (serving/run, exe/run)
+        # reaches the request's tree through the link
+        sub = {store.spans[i]['name']
+               for i in store.subtree_ids(req['span'],
+                                          follow_links=True)}
+        assert 'serving/batch' in sub and 'serving/run' in sub
+    assert len(linked_batches) == 1      # the N<->1 coalescing
+    assert all(b in batches for b in linked_batches)
+    # the batch is a direct CHILD of exactly one request (the first it
+    # serves) and reaches the rest only through links
+    batch = linked_batches.pop()
+    req_ids = {r['span'] for r in requests}
+    assert store.spans[batch]['parent'] in req_ids
+
+
+# ---- journal rotation + flush --------------------------------------------
+def test_rotation_preserves_wall_anchor(tmp_path):
+    p = str(tmp_path / 'rot.jsonl')
+    j = obs.RunJournal(p, max_bytes=4096, buffer_lines=8)
+    wall0 = j._wall0
+    # write until the roll happens, then a handful more: exactly one
+    # rotation, so rolled + live together hold every record
+    i = 0
+    while j.rotations == 0:
+        j.record('step_end', step=i, dur_s=0.001, loss=float(i))
+        i += 1
+        assert i < 10000, 'journal never rotated'
+    for _ in range(5):
+        j.record('step_end', step=i, dur_s=0.001, loss=float(i))
+        i += 1
+    j.close()
+    assert j.rotations == 1
+    assert os.path.exists(p + '.1')
+    live, _ = obs.read_journal(p)
+    rolled, _ = obs.read_journal(p + '.1')
+    # the live file restarts with a run_begin carrying the ORIGINAL
+    # wall anchor + a rotated marker, so clock alignment is unchanged
+    assert live[0]['ev'] == 'run_begin'
+    assert live[0]['wall'] == wall0
+    assert live[0]['rotated'] == 1
+    assert rolled[0]['ev'] == 'run_begin' and rolled[0]['wall'] == wall0
+    # no record lost across the roll
+    steps = [r['step'] for r in rolled + live if r['ev'] == 'step_end']
+    assert steps == list(range(i))
+    # monotonic t keeps counting from the run's t0 across the roll
+    assert live[1]['t'] > rolled[-1]['t'] - 1e-6
+
+
+def test_modelserver_close_flushes_journal(tmp_path):
+    d = _save_artifact(tmp_path)
+    p = str(tmp_path / 'j.jsonl')
+    # huge buffer: nothing hits disk unless something flushes
+    j = obs.RunJournal(p, buffer_lines=1 << 20, flush_interval=1e9)
+    prev = obs.set_journal(j)
+    try:
+        srv = ModelServer(place=fluid.CPUPlace(), max_batch_size=4)
+        srv.load_model('m', d)
+        srv.infer('m', {'x': np.ones((1, IN_DIM), 'float32')})
+        assert _spans(p) == []           # still buffered
+        srv.close()
+        names = {r['name'] for r in _spans(p)}
+        assert 'serving/request' in names    # close() flushed
+    finally:
+        obs.set_journal(prev)
+        j.close()
+
+
+# ---- trainer: run/step tree ----------------------------------------------
+def test_trainer_trace_tree(tmp_path):
+    p = str(tmp_path / 'j.jsonl')
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 4).astype('float32')
+    ys = rng.randn(16, 1).astype('float32')
+
+    def reader():
+        for i in range(0, 16, 4):
+            yield [(xs[j], ys[j]) for j in range(i, i + 4)]
+
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        return fluid.layers.mean(fluid.layers.square_error_cost(
+            input=pred, label=y))
+
+    with obs.journal(p):
+        trainer = fluid.Trainer(
+            train_func=train_func,
+            optimizer=fluid.optimizer.SGD(learning_rate=0.01),
+            place=fluid.CPUPlace())
+        trainer.train(num_epochs=1, event_handler=lambda ev: None,
+                      reader=reader, feed_order=['x', 'y'])
+    store = trace_report.build_store([p])
+    runs = store.by_kind('train/run').get('train/run', [])
+    assert len(runs) == 1
+    root = runs[0]
+    tree_names = [store.spans[i]['name']
+                  for i in store.subtree_ids(root['span'])]
+    assert tree_names.count('train/step') == 4
+    assert 'exe/run' in tree_names
+    # ONE trace id covers the whole run
+    traces = {store.spans[i]['trace']
+              for i in store.subtree_ids(root['span'])}
+    assert traces == {root['trace']}
+    assert store.unclosed() == []
+
+
+# ---- cross-process: Router over remote cells, kill + requeue -------------
+def test_cross_process_requeue_trace(tmp_path):
+    from paddle_tpu.fleet import Router
+    from paddle_tpu.multihost.remote import spawn_cell
+
+    d = _save_artifact(tmp_path)
+    p0 = str(tmp_path / 'router.jsonl')
+    cells = {}
+
+    def factory(rid):
+        cell = spawn_cell(name='cell%d' % rid)
+        cells[rid] = cell
+        return cell
+
+    n = 12
+    rng = np.random.RandomState(3)
+    inputs = [rng.randn(1, IN_DIM).astype('float32') for _ in range(n)]
+    with obs.journal(p0):
+        router = Router(factory, replicas=2, supervise=False,
+                        warmup_on_load=False, poll_interval=0.05)
+        with router:
+            router.load_model('m', d)
+            # pause every replica's batcher: submits queue server-side
+            # (span_begin journaled, flushed per message) and stay IN
+            # FLIGHT until the kill — no race against fast inference
+            for c in cells.values():
+                c.pause('m')
+            reqs = [router.submit('m', {'x': x}) for x in inputs]
+            victim = reqs[0].replica_id
+            survivor = next(r for r in cells if r != victim)
+            # the ping round-trips AFTER the earlier submits on the
+            # same ordered socket, so the worker has journaled their
+            # serving/request span_begins before the SIGKILL lands
+            cells[victim].health()
+            cells[victim].kill()
+            cells[survivor].resume('m')
+            outs = [r.result(timeout=60.0) for r in reqs]
+        assert all(o is not None for o in outs)
+        requeued = [r for r in reqs if r.requeues >= 1]
+        assert requeued, 'the kill produced no requeues'
+        # every request that was on the victim failed over exactly once
+        assert all(r.requeues == 1 and r.replica_id == survivor
+                   for r in requeued)
+
+    paths = [p0] + [c.journal_path for c in cells.values()]
+    assert all(pp and os.path.exists(pp) for pp in paths)
+    store = trace_report.build_store(paths)
+
+    rq = requeued[0]
+    roots = [s for s in store.by_kind('fleet/request').get(
+                 'fleet/request', [])
+             if s['fields'].get('requeues')]
+    assert roots, 'no requeued fleet/request span journaled'
+    root = roots[0]
+    assert root['fields']['ok'] is True
+    assert root['fields']['requeues'] == rq.requeues
+    assert root['fields']['replicas_tried'] >= 2
+
+    kids = [store.spans[c] for c in store.children[root['span']]]
+    hops = [k for k in kids if k['name'] == 'fleet/requeue']
+    assert hops and hops[0]['closed']
+    # begin fields (who/why) merged with end fields (where to)
+    assert hops[0]['fields']['from_replica'] == \
+        rq.replicas_tried[0]
+    assert hops[0]['fields']['cause'] == 'ServerClosed'
+    assert hops[0]['fields']['to_replica'] == rq.replica_id
+    # the failed-over attempt parents under the hop, journaled by the
+    # SURVIVOR process — a different journal than the router's
+    under_hop = [store.spans[c]
+                 for c in store.children.get(hops[0]['span'], [])]
+    attempts = [u for u in under_hop
+                if u['name'] == 'serving/request' and u['closed']]
+    assert attempts
+    assert attempts[0]['journal'] != root['journal']
+    # one trace id across all three processes
+    sub = store.subtree_ids(root['span'])
+    assert {store.spans[i]['trace'] for i in sub} == {root['trace']}
+    journals_in_tree = {store.spans[i]['journal'] for i in sub}
+    assert len(journals_in_tree) >= 2
+    # the dead cell's journal holds work that died in flight: a
+    # span_begin whose span_end was killed with the process
+    dead_idx = paths.index(cells[victim].journal_path)
+    unclosed = [s for s in store.unclosed()
+                if s['journal'] == dead_idx]
+    assert unclosed, 'killed replica left no unclosed span'
+    assert any(s['name'] == 'serving/request' for s in unclosed)
+    for c in cells.values():
+        try:
+            c.close(timeout=5.0)
+        except ServerClosed:
+            pass
+
+
+# ---- trace_report: quantiles, exemplars, attribution ---------------------
+def test_trace_report_quantiles_and_attribution(tmp_path):
+    p = str(tmp_path / 'j.jsonl')
+    with obs.journal(p):
+        for i in range(20):
+            with obs.span('serving/request', idx=i) as sp:
+                obs.emit_span('serving/queue',
+                              0.001 * (i + 1), parent=sp)
+                time.sleep(0.002 if i == 19 else 0.0)
+    store = trace_report.build_store([p])
+    reqs = store.by_kind('serving/request').get('serving/request', [])
+    assert len(reqs) == 20
+    ordered = sorted(reqs, key=lambda s: s['dur_s'])
+    p99 = trace_report._quantile(ordered, 0.99)
+    # nearest-rank: the exemplar is an ACTUAL span, so its trace id
+    # resolves to a renderable tree
+    assert p99 is ordered[-1]
+    lines = []
+    trace_report.render_tree(store, p99['trace'], lines)
+    text = '\n'.join(lines)
+    assert 'serving/request' in text and 'serving/queue' in text
+    # self-time: parent self = dur - closed children, clamped >= 0
+    selfs = store.self_times(p99['span'])
+    assert selfs['serving/queue'] > 0
+    assert selfs['serving/request'] >= 0
+    summary = trace_report.summarize(store, kind='serving/request')
+    att = summary['attribution']
+    assert att['count'] == 20
+    assert att['percentiles']['p99']['trace'] == p99['trace']
+    assert att['percentiles']['p99']['critical_path'][0]['name'] == \
+        'serving/request'
+
+
+def test_trace_report_cli_json(tmp_path, capsys):
+    p = str(tmp_path / 'j.jsonl')
+    with obs.journal(p):
+        with obs.span('fleet/request'):
+            with obs.span('serving/request'):
+                pass
+    rc = trace_report.main([p, '--kind', 'fleet/request', '--json',
+                            '-'])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out['spans'] == 2 and out['traces'] == 1
+    assert out['attribution']['count'] == 1
+
+
+# ---- lint: the span-not-ended rule stays armed ---------------------------
+def test_lint_span_not_ended_rule(tmp_path):
+    import lint_repo
+    bad = tmp_path / 'bad.py'
+    bad.write_text(
+        'from paddle_tpu import observability as _obs\n'
+        'def leak():\n'
+        '    _obs.start_span("a")\n'
+        'def leak2():\n'
+        '    s = _obs.start_span("b")\n'
+        '    print(s)\n'        # printed, i.e. handed off — not a leak
+        'def leak3():\n'
+        '    s2 = _obs.start_span("c")\n'
+        'def fine(cond, slot):\n'
+        '    x = _obs.start_span("d") if cond else None\n'
+        '    if x is not None:\n'
+        '        x.end()\n'
+        '    a = _obs.start_span("e", activate=False)\n'
+        '    slot.span = a if a.context is not None else None\n')
+    out, _ = lint_repo.lint_file(str(bad), 'bad.py')
+    rules = [(v.rule, v.line) for v in out]
+    assert ('span-not-ended', 3) in rules      # dropped
+    assert ('span-not-ended', 8) in rules      # bound, never consumed
+    assert all(line not in (10, 13) for _, line in rules)

@@ -26,7 +26,10 @@ Two phases, both seeded and deterministic in shape:
 
 ``--smoke`` runs a short schedule of both phases, writes an
 observability journal and validates it via ``obs_report.py --require
-fleet`` semantics, exiting nonzero if any invariant breaks — the CI
+fleet`` AND ``--require tracing`` semantics — including that the
+kill-mid-load requeue leaves a span tree ``trace_report.py`` can
+reconstruct end to end (``fleet/request -> fleet/requeue ->
+serving/request``) — exiting nonzero if any invariant breaks; the CI
 gate alongside ``chaos_bench.py --smoke`` and
 ``serve_bench.py --smoke``.
 
@@ -171,10 +174,23 @@ def run_fleet_chaos(replicas=3, n_requests=120, clients=4, max_batch=8,
             victim = None
             if kill:
                 # wait until half the load is in flight, then yank a
-                # placed replica out from under it
+                # placed replica out from under it. Holding the
+                # victim's batcher first guarantees the kill strands
+                # queued requests (sub-ms batches would otherwise
+                # drain before the SIGKILL lands), so the requeue
+                # path — and its trace spans — provably exercise
                 for _ in range(kill_at):
                     submitted.acquire()
-                victim = router.placement('m')[0]
+                # ties in load score break toward the lowest replica
+                # id, so that's where idle-time traffic lands — pick
+                # it as the victim so the pause provably queues work
+                victim = min(router.placement('m'))
+                vsrv = router.replica(victim).server
+                vsrv.pause('m')
+                give_up = time.monotonic() + 10.0
+                while vsrv.queue_depth('m') == 0 and \
+                        time.monotonic() < give_up:
+                    time.sleep(0.002)
                 router.kill_replica(victim)
             for t in threads:
                 t.join(120.0)
@@ -339,6 +355,37 @@ def run_decode_phase(slots=8, n_sequences=48, max_len=32, seed=3,
     }
 
 
+def check_requeue_trace(journal_path):
+    """Tracing gate for the kill-mid-load smoke: the journal must hold
+    at least one requeued request whose span tree reconstructs end to
+    end — a ``fleet/request`` root with a ``fleet/requeue`` hop child
+    that itself parents a ``serving/request`` attempt on the replica
+    the request was moved to. Returns a list of problems."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from trace_report import build_store
+    store = build_store([journal_path])
+    requeued = 0
+    for sp in store.by_kind('fleet/request').get('fleet/request', []):
+        if not sp['fields'].get('requeues'):
+            continue
+        requeued += 1
+        hops = [store.spans[c]
+                for c in store.children.get(sp['span'], [])
+                if store.spans[c]['name'] == 'fleet/requeue']
+        for hop in hops:
+            under = [store.spans[c]
+                     for c in store.children.get(hop['span'], [])]
+            if any(u['name'] == 'serving/request' and u['closed']
+                   for u in under):
+                return []
+    if requeued == 0:
+        return ['tracing: journal holds no requeued fleet/request '
+                'span despite the kill — requeue hops are not traced']
+    return ['tracing: %d requeued fleet/request span(s) found but '
+            'none reconstructs a full fleet/request -> fleet/requeue '
+            '-> serving/request tree' % requeued]
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     ap.add_argument('--replicas', type=int, default=3)
@@ -419,6 +466,11 @@ def main(argv=None):
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from obs_report import check_journal
         problems += check_journal(journal_path, require='fleet')
+        # tracing rides the same journal: completed spans must exist,
+        # and the kill phase must leave a reconstructable requeue tree
+        problems += check_journal(journal_path, require='tracing')
+        if args.smoke and not args.no_kill:
+            problems += check_requeue_trace(journal_path)
 
     results = {'fleet': fleet, 'decode': decode, 'problems': problems}
     if args.json:

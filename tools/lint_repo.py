@@ -22,8 +22,16 @@ Rules (each encodes a convention the codebase actually relies on):
   point and is always allowed.
 - ``dup-metric-name``: the same raw metric-name literal passed to
   ``counter()``/``histogram()``/``gauge()`` from more than one of the
-  ``serving/``, ``fleet/``, ``multihost/`` packages — cross-subsystem
+  ``serving/``, ``fleet/``, ``multihost/``, ``observability/``
+  packages (the last covers the tracing series) — cross-subsystem
   metric names must live in ONE place or the schemas drift apart.
+- ``span-not-ended``: a ``start_span()`` call that is not a ``with``
+  item, not returned, not passed on, and not bound to a name that the
+  enclosing scope later ``.end()``s, aliases, or hands off — a span
+  begun and dropped journals a ``span_begin`` with no ``span_end``,
+  which trace_report/obs_report then report as a crashed-looking
+  unclosed span. The ``x = start_span(...) if cond else None`` idiom
+  and cross-method handoffs (``slot.span = x``) are recognized.
 
 The embedded ``ALLOWLIST`` pins known, accepted occurrences (ratchet
 style): the tool exits nonzero only on violations NOT in the allowlist,
@@ -39,7 +47,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCOPE = ('paddle_tpu', 'tools')
-METRIC_PACKAGES = ('serving', 'fleet', 'multihost')
+METRIC_PACKAGES = ('serving', 'fleet', 'multihost', 'observability')
 METRIC_FACTORIES = ('counter', 'histogram', 'gauge')
 
 # rule:path:detail -> accepted occurrences. Add entries ONLY with a
@@ -107,6 +115,87 @@ def _guarded(node, parents):
     return False
 
 
+def _enclosing_scope(node, parents):
+    """Nearest enclosing function (or the module) — the region scanned
+    for what happens to a span after start_span()."""
+    cur = node
+    while cur in parents:
+        cur = parents[cur]
+        if isinstance(cur, (ast.FunctionDef,
+                            getattr(ast, 'AsyncFunctionDef',
+                                    ast.FunctionDef), ast.Lambda,
+                            ast.Module)):
+            return cur
+    return cur
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _span_disposition(call, parents):
+    """How a start_span() call's result leaves the call site: 'with',
+    'returned', 'escaped' (argument of another call / stored on an
+    attribute or subscript), ('named', name) for a plain name binding
+    (possibly through ``... if cond else None``), or 'dropped'."""
+    cur = call
+    while cur in parents:
+        parent = parents[cur]
+        if isinstance(parent, ast.withitem):
+            return 'with'
+        if isinstance(parent, ast.Return):
+            return 'returned'
+        if isinstance(parent, ast.Call) and cur is not parent.func:
+            return 'escaped'        # callee owns it now
+        if isinstance(parent, ast.keyword):
+            return 'escaped'
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = parent.targets \
+                if isinstance(parent, ast.Assign) else [parent.target]
+            if all(isinstance(t, ast.Name) for t in targets):
+                return ('named', targets[0].id)
+            return 'escaped'        # self.x = / slot[i] = handoff
+        if isinstance(parent, ast.Expr):
+            return 'dropped'
+        if isinstance(parent, (ast.stmt, ast.FunctionDef, ast.Module)):
+            return 'dropped'
+        cur = parent            # IfExp / BoolOp / ternary wrappers
+    return 'dropped'
+
+
+def _span_name_consumed(scope, name, defining_call):
+    """Does ``scope`` end, return, alias, or hand off the span bound to
+    ``name``? ``.end()`` and ``__exit__`` count as closing; a return,
+    a re-assignment of the value elsewhere (``slot.span = x``), or
+    passing the name into another call counts as ownership transfer."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in ('end', '__exit__') \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id == name:
+                return True
+            args = list(node.args) + [k.value for k in node.keywords]
+            for a in args:
+                if any(sub is defining_call
+                       for sub in ast.walk(a)):
+                    continue        # the defining site itself
+                if name in _names_in(a):
+                    return True
+        elif isinstance(node, ast.Return) and node.value is not None:
+            if name in _names_in(node.value):
+                return True
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if value is None or any(sub is defining_call
+                                    for sub in ast.walk(value)):
+                continue
+            if name in _names_in(value):
+                return True         # aliased / stored for later close
+    return False
+
+
 def lint_file(path, relpath):
     with open(path) as f:
         source = f.read()
@@ -145,6 +234,29 @@ def lint_file(path, relpath):
                     and isinstance(node.args[0].value, str):
                 metrics.setdefault(node.args[0].value, []).append(
                     (relpath, node.args[0].lineno))
+        if isinstance(node, ast.Call):
+            func = node.func
+            callee = func.attr if isinstance(func, ast.Attribute) \
+                else (func.id if isinstance(func, ast.Name) else None)
+            if callee == 'start_span' \
+                    and relpath != os.path.join('paddle_tpu',
+                                                'observability',
+                                                'tracing.py'):
+                disp = _span_disposition(node, parents)
+                problem = None
+                if disp == 'dropped':
+                    problem = ('start_span() result dropped — the '
+                               'span can never be end()ed; use '
+                               'with span(...) or bind and close it')
+                elif isinstance(disp, tuple):
+                    scope = _enclosing_scope(node, parents)
+                    if not _span_name_consumed(scope, disp[1], node):
+                        problem = ('span %r is started but never '
+                                   'end()ed, returned, or handed '
+                                   'off in this scope' % disp[1])
+                if problem:
+                    out.append(Violation('span-not-ended', relpath,
+                                         node.lineno, problem))
     return out, metrics
 
 
@@ -199,7 +311,7 @@ def main(argv=None):
     if args.list:
         print('scope: %s' % ', '.join(SCOPE))
         print('rules: bare-except, lock-outside-with, unguarded-emit, '
-              'dup-metric-name (across %s)'
+              'span-not-ended, dup-metric-name (across %s)'
               % '/'.join(METRIC_PACKAGES))
         return 0
     violations = lint_tree()

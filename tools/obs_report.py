@@ -26,6 +26,9 @@ fleet`` for a run through the replica router / continuous-batching
 decode engine (``fleet`` / ``decode`` records, SERVING.md);
 ``--require analysis`` for a run that must have exercised the static
 program verifier (``analysis`` records, ANALYSIS.md); ``--require
+tracing`` for a run that must hold completed distributed-tracing spans
+(``span_end`` records, OBSERVABILITY.md — unclosed spans never fail
+the gate; fault injection legitimately leaves them); ``--require
 any`` for presence only).
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
 load run writes.
@@ -57,6 +60,11 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # verifier (Executor miss-path verify / feed checks /
                # pass sanitizer — ANALYSIS.md) shows 'analysis' records
                'analysis': 'analysis',
+               # a traced run must hold completed spans (span_end —
+               # OBSERVABILITY.md "Distributed tracing"). Unclosed
+               # spans are NOT gated: fault injection legitimately
+               # leaves them (a killed replica's in-flight work)
+               'tracing': 'span_end',
                'any': None}
 
 
@@ -324,6 +332,55 @@ def _fleet_summary(by_ev):
     }
 
 
+def _tracing_summary(by_ev):
+    """Tracing SLI (OBSERVABILITY.md "Distributed tracing"): span
+    counts per kind, distinct traces, link records, UNCLOSED spans
+    (span_begin with no span_end in THIS journal — work that died with
+    the process, or continued in another journal: tools/trace_report.py
+    merges files before judging), and the top critical paths (largest
+    roots with their dominant child chains)."""
+    begins = by_ev.get('span_begin', ())
+    ends = by_ev.get('span_end', ())
+    ended = {r.get('span') for r in ends}
+    unclosed = [r for r in begins if r.get('span') not in ended]
+    kinds = {}
+    children = {}
+    for r in ends:
+        k = kinds.setdefault(r.get('name', '?'), {
+            'count': 0, 'total_s': 0.0, 'max_s': 0.0})
+        k['count'] += 1
+        k['total_s'] += r.get('dur_s', 0.0)
+        k['max_s'] = max(k['max_s'], r.get('dur_s', 0.0))
+        children.setdefault(r.get('parent'), []).append(r)
+    ends_by_id = {r.get('span'): r for r in ends}
+    roots = [r for r in ends
+             if r.get('parent') is None
+             or r.get('parent') not in ends_by_id]
+    roots.sort(key=lambda r: -r.get('dur_s', 0.0))
+    paths = []
+    for root in roots[:5]:
+        path, rec = [], root
+        for _ in range(8):
+            path.append('%s(%.1fms)' % (rec.get('name', '?'),
+                                        rec.get('dur_s', 0.0) * 1e3))
+            kids = children.get(rec.get('span'))
+            if not kids:
+                break
+            rec = max(kids, key=lambda r: r.get('dur_s', 0.0))
+        paths.append(' > '.join(path))
+    return {
+        'spans': len(ends),
+        'traces': len({r.get('trace') for r in ends
+                       if r.get('trace')}),
+        'links': len(by_ev.get('span_link', ())),
+        'unclosed': len(unclosed),
+        'unclosed_names': sorted({r.get('name', '?')
+                                  for r in unclosed}),
+        'kinds': kinds,
+        'critical_paths': paths,
+    }
+
+
 def summarize(records, malformed=0):
     """Aggregate a record list into a JSON-ready summary dict."""
     by_ev = {}
@@ -399,6 +456,7 @@ def summarize(records, malformed=0):
         'multihost': _multihost_summary(by_ev),
         'zero': _zero_summary(by_ev),
         'analysis': _analysis_summary(by_ev),
+        'tracing': _tracing_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -579,6 +637,23 @@ def render(summary, top=10):
                          'warnings=%d' % (ph, p['runs'],
                                           p['wall_s'] * 1e3,
                                           p['errors'], p['warnings']))
+    tr = s.get('tracing') or {}
+    if tr.get('spans') or tr.get('unclosed'):
+        line = ('tracing:  %d span(s) over %d trace(s), %d link(s)'
+                % (tr['spans'], tr['traces'], tr['links']))
+        if tr['unclosed']:
+            line += (' | %d UNCLOSED (%s)'
+                     % (tr['unclosed'],
+                        ', '.join(tr['unclosed_names']) or '-'))
+        lines.append(line)
+        for name, k in sorted(tr.get('kinds', {}).items(),
+                              key=lambda kv: -kv[1]['total_s'])[:top]:
+            lines.append('  %-24s %5d spans  %9.3fms total  max '
+                         '%8.3fms' % (name, k['count'],
+                                      k['total_s'] * 1e3,
+                                      k['max_s'] * 1e3))
+        for p in tr.get('critical_paths', ())[:3]:
+            lines.append('  path: %s' % p)
     if s['anomalies']:
         lines.append('anomaly:  %d guard trips' % s['anomalies'])
     lines.append('events:   %s' % ', '.join(

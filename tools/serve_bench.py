@@ -279,6 +279,9 @@ def main(argv=None):
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from obs_report import check_journal
         problems += check_journal(journal_path, require='serving')
+        # every smoke request is traced end to end; an empty span set
+        # means the serving pipeline lost its tracing wiring
+        problems += check_journal(journal_path, require='tracing')
     if problems:
         print('SMOKE REGRESSION:', file=sys.stderr)
         for p in problems:

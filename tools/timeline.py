@@ -12,13 +12,20 @@ https://ui.perfetto.dev.
 ``--journal_path`` additionally merges an observability run journal
 (``paddle_tpu.observability.RunJournal`` JSONL) into the same trace on
 its own process track: records carrying ``dur_s`` (steps, XLA
-compiles, serving batches, executor runs) become duration slices
-grouped into one named row per event type, and instantaneous records
-(checkpoints, anomalies, shed requests) become instant events — so ONE
-artifact shows op kernels, compiles, and serving batches together.
-Journal timestamps are monotonic seconds from the journal's own
-``run_begin``; profile timestamps are rebased to their first event, so
-tracks share an origin but are only loosely aligned across clocks.
+compiles, serving batches, executor runs, tracing spans) become
+duration slices grouped into one named row per event type — tracing
+``span_end`` records row by their span name — and instantaneous
+records (checkpoints, anomalies, shed requests) become instant events,
+so ONE artifact shows op kernels, compiles, and serving batches
+together.
+
+The flag REPEATS: every ``--journal_path`` becomes its own process
+track (one per fleet replica / remote cell / launcher rank), and
+tracks are clock-aligned through each journal's ``run_begin`` wall
+anchor — the earliest anchor is the shared origin, so a request that
+hops processes reads left-to-right across tracks. Profile timestamps
+are rebased to their first event and only loosely aligned with journal
+tracks (different clocks).
 """
 import argparse
 import json
@@ -91,7 +98,17 @@ def _load_journal(journal_path):
     return records
 
 
-def build_timeline(profiles, journal=None):
+def _wall_anchor(journal):
+    """The journal's ``run_begin`` wall-clock anchor (rotation repeats
+    it with the ORIGINAL value, so any run_begin works); None when the
+    journal predates wall anchoring."""
+    for rec in journal:
+        if rec.get('ev') == 'run_begin' and 'wall' in rec:
+            return float(rec['wall'])
+    return None
+
+
+def build_timeline(profiles, journals=None):
     tracer = ChromeTraceFormatter()
     pid = 0
     for pid, (name, events) in enumerate(sorted(profiles.items())):
@@ -102,31 +119,48 @@ def build_timeline(profiles, journal=None):
         for op, start, dur in events:
             tracer.emit_region((start - base) * 1e6, dur * 1e6, pid, 0,
                                'Op', op, {'name': op})
-    if journal:
-        jpid = len(profiles)
+    journals = journals or []
+    # shared origin: the earliest wall anchor across every journal;
+    # per-journal offsets realign each file's monotonic 't' to it
+    anchors = [_wall_anchor(j) for j in journals]
+    known = [a for a in anchors if a is not None]
+    wall0 = min(known) if known else 0.0
+    for idx, (journal, anchor) in enumerate(zip(journals, anchors)):
+        jpid = len(profiles) + idx
+        offset = (anchor - wall0) if anchor is not None else 0.0
         run_id = next((r.get('run') for r in journal if r.get('run')),
                       '?')
-        tracer.emit_pid('journal(run %s)' % run_id, jpid)
+        ospid = next((r.get('pid') for r in journal
+                      if r.get('ev') == 'run_begin' and 'pid' in r),
+                     None)
+        label = 'journal(run %s)' % run_id if ospid is None else \
+            'journal(run %s, pid %s)' % (run_id, ospid)
+        tracer.emit_pid(label, jpid)
         tids = {}
         for rec in journal:
             ev = rec['ev']
             if ev == 'run_begin':
                 continue
-            tid = tids.get(ev)
+            if ev in ('span_begin', 'span_link'):
+                continue   # tree structure is trace_report's job
+            # tracing span_ends row by SPAN name, everything else by
+            # event type
+            row = rec.get('name', ev) if ev == 'span_end' else ev
+            tid = tids.get(row)
             if tid is None:
-                tid = tids[ev] = len(tids)
-                tracer.emit_tid(ev, jpid, tid)
+                tid = tids[row] = len(tids)
+                tracer.emit_tid(row, jpid, tid)
             args = {k: v for k, v in rec.items()
                     if k not in ('ev', 'run')}
-            ts_us = rec.get('t', 0.0) * 1e6
+            ts_us = (offset + rec.get('t', 0.0)) * 1e6
             if 'dur_s' in rec:
                 dur_us = rec['dur_s'] * 1e6
                 # 't' is the END of a span (records are written when
                 # the block closes); slice back to its start
                 tracer.emit_region(max(ts_us - dur_us, 0.0), dur_us,
-                                   jpid, tid, 'journal', ev, args)
+                                   jpid, tid, 'journal', row, args)
             else:
-                tracer.emit_instant(ts_us, jpid, tid, 'journal', ev,
+                tracer.emit_instant(ts_us, jpid, tid, 'journal', row,
                                     args)
     return tracer
 
@@ -138,19 +172,20 @@ def main():
         help='Input profile file name. If there are multiple files, the '
              'format should be trainer1=file1,trainer2=file2,ps=file3')
     parser.add_argument(
-        '--journal_path', type=str, default='',
-        help='Optional observability run journal (.jsonl) merged into '
-             'the trace on its own track.')
+        '--journal_path', type=str, action='append', default=[],
+        help='Observability run journal (.jsonl) merged into the trace '
+             'on its own track. Repeat for multi-process runs (one per '
+             'replica / remote cell / launcher rank); tracks are '
+             'clock-aligned via each journal\'s run_begin wall anchor.')
     parser.add_argument('--timeline_path', type=str, default='',
                         help='Output timeline file name.')
     args = parser.parse_args()
     profiles = _load_profiles(args.profile_path) if args.profile_path \
         else {}
-    journal = _load_journal(args.journal_path) if args.journal_path \
-        else None
-    if not profiles and not journal:
+    journals = [_load_journal(p) for p in args.journal_path]
+    if not profiles and not journals:
         parser.error('need --profile_path and/or --journal_path')
-    tracer = build_timeline(profiles, journal=journal)
+    tracer = build_timeline(profiles, journals=journals)
     with open(args.timeline_path, 'w') as f:
         f.write(tracer.format_to_string())
     print('timeline written to %s' % args.timeline_path)

@@ -1,0 +1,368 @@
+#!/usr/bin/env python
+"""Reconstruct distributed span trees from observability run journals.
+
+The input is one or more JSONL journals written by
+``paddle_tpu.observability.RunJournal`` — typically one per process
+(router, fleet replicas, remote cells, launcher ranks). Spans carry
+propagated trace ids (``paddle_tpu.observability.tracing``), so this
+tool merges every file and reassembles each request's / step's tree no
+matter how many processes it crossed. Standalone on purpose — stdlib
+only, so it runs anywhere the journal files landed.
+
+    python tools/trace_report.py j1.jsonl j2.jsonl        # overview
+    python tools/trace_report.py *.jsonl --trace ab12...  # one tree
+    python tools/trace_report.py *.jsonl --kind serving/request
+    python tools/trace_report.py *.jsonl --json -
+
+Overview mode prints, per span kind: count, p50/p95/p99/max latency,
+and the EXEMPLAR trace id behind each percentile — the concrete trace
+to pull up with ``--trace`` when a p99 looks wrong (the same ids ride
+`MetricsRegistry` histogram buckets in-process). ``--kind`` adds
+per-stage critical-path attribution: the percentile exemplars' trees
+are decomposed into self-time per stage, so "p99 is 40ms" becomes
+"32ms queue wait, 6ms run, 2ms pad".
+
+A ``span_begin`` with no matching ``span_end`` is UNCLOSED: work that
+died with its process (killed replica, lost host). Unclosed spans are
+listed, marked in trees, and are NOT an error — they are the forensic
+record fault injection leaves behind.
+
+``span_link`` records (a coalesced batch span serving N request spans)
+graft the linking span's subtree under every request it served, so a
+request tree reaches through the batch into executor spans.
+"""
+import argparse
+import json
+import sys
+
+
+def load_journal(path):
+    """(records, malformed_count) without importing paddle_tpu."""
+    records, malformed = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                malformed += 1
+                continue
+            if not isinstance(rec, dict) or 'ev' not in rec:
+                malformed += 1
+                continue
+            records.append(rec)
+    return records, malformed
+
+
+class SpanStore(object):
+    """Merged span records from N journals, indexed for tree walks."""
+
+    def __init__(self):
+        self.spans = {}       # span_id -> span dict
+        self.children = {}    # parent span_id -> [span_id]
+        self.links = {}       # linked (request) span_id -> [batch ids]
+        self.traces = {}      # trace_id -> [root span_id]
+        self.malformed = 0
+        self.journals = []    # (path, wall_anchor)
+
+    def add_journal(self, path):
+        records, bad = load_journal(path)
+        self.malformed += bad
+        wall = next((r.get('wall') for r in records
+                     if r.get('ev') == 'run_begin' and 'wall' in r),
+                    None)
+        jidx = len(self.journals)
+        self.journals.append((path, wall))
+        for rec in records:
+            ev = rec.get('ev')
+            if ev == 'span_begin':
+                self._touch(rec, jidx)
+            elif ev == 'span_end':
+                sp = self._touch(rec, jidx)
+                sp['dur_s'] = rec.get('dur_s', 0.0)
+                sp['t'] = rec.get('t')
+                sp['closed'] = True
+                # begin fields (who/why) merge with end fields (how it
+                # went); end wins on collision
+                sp['fields'].update(
+                    (k, v) for k, v in rec.items()
+                    if k not in ('ev', 'run', 't', 'name', 'trace',
+                                 'span', 'parent', 'dur_s'))
+            elif ev == 'span_link':
+                self.links.setdefault(
+                    rec.get('linked_span'), []).append(rec.get('span'))
+
+    def _touch(self, rec, jidx):
+        sid = rec.get('span')
+        sp = self.spans.get(sid)
+        if sp is None:
+            sp = self.spans[sid] = {
+                'span': sid, 'name': rec.get('name'),
+                'trace': rec.get('trace'),
+                'parent': rec.get('parent'), 'dur_s': None,
+                't': rec.get('t'), 'closed': False,
+                'fields': {k: v for k, v in rec.items()
+                           if k not in ('ev', 'run', 't', 'name',
+                                        'trace', 'span', 'parent',
+                                        'dur_s')},
+                'journal': jidx}
+            self.children.setdefault(rec.get('parent'), []).append(sid)
+        return sp
+
+    def finalize(self):
+        for sid, sp in self.spans.items():
+            parent = sp['parent']
+            # a root is parentless OR its parent lives in a journal we
+            # were not given (cross-process orphan: still show it)
+            if parent is None or parent not in self.spans:
+                self.traces.setdefault(sp['trace'], []).append(sid)
+        for roots in self.traces.values():
+            roots.sort(key=lambda s: self.spans[s].get('t') or 0.0)
+
+    # ---- queries ---------------------------------------------------------
+    def by_kind(self, kind=None):
+        """{name: [span dict, ...]} over CLOSED spans."""
+        out = {}
+        for sp in self.spans.values():
+            if not sp['closed']:
+                continue
+            if kind is not None and sp['name'] != kind:
+                continue
+            out.setdefault(sp['name'], []).append(sp)
+        for spans in out.values():
+            spans.sort(key=lambda s: s['dur_s'])
+        return out
+
+    def unclosed(self):
+        return sorted((sp for sp in self.spans.values()
+                       if not sp['closed']),
+                      key=lambda s: (s['trace'] or '', s['span'] or ''))
+
+    def subtree_ids(self, sid, follow_links=True, _seen=None):
+        """All span ids reachable from ``sid`` via children and (once
+        each) link grafts."""
+        seen = _seen if _seen is not None else set()
+        if sid in seen:
+            return seen
+        seen.add(sid)
+        for c in self.children.get(sid, ()):
+            self.subtree_ids(c, follow_links, seen)
+        if follow_links:
+            for b in self.links.get(sid, ()):
+                if b in self.spans:
+                    self.subtree_ids(b, follow_links, seen)
+        return seen
+
+    def self_times(self, root_sid):
+        """Per-stage attribution of one tree: {name: self_seconds},
+        where a span's self time is its duration minus its direct
+        children's (clamped at 0 — children measured on another clock
+        can slightly overhang). Unclosed spans contribute 0."""
+        out = {}
+        for sid in self.subtree_ids(root_sid):
+            sp = self.spans[sid]
+            if not sp['closed']:
+                continue
+            dur = sp['dur_s'] or 0.0
+            kids = [self.spans[c] for c in self.children.get(sid, ())
+                    if self.spans[c]['closed']]
+            child_dur = sum(k['dur_s'] or 0.0 for k in kids)
+            self_s = max(0.0, dur - child_dur)
+            out[sp['name']] = out.get(sp['name'], 0.0) + self_s
+        return out
+
+    def critical_path(self, root_sid, depth=8):
+        """The chain of largest closed children under ``root_sid``."""
+        path, sid = [], root_sid
+        for _ in range(depth):
+            sp = self.spans[sid]
+            path.append(sp)
+            kids = [self.spans[c] for c in self.children.get(sid, ())
+                    if self.spans[c]['closed']]
+            if not kids:
+                break
+            best = max(kids, key=lambda k: k['dur_s'] or 0.0)
+            sid = best['span']
+        return path
+
+
+def _quantile(sorted_spans, q):
+    """The actual span sitting at quantile ``q`` (nearest rank)."""
+    if not sorted_spans:
+        return None
+    idx = min(len(sorted_spans) - 1,
+              max(0, int(q * len(sorted_spans) + 0.5) - 1))
+    return sorted_spans[idx]
+
+
+def render_tree(store, trace_id, out_lines, max_depth=12):
+    roots = store.traces.get(trace_id)
+    if not roots:
+        out_lines.append('trace %s: no spans found' % trace_id)
+        return
+    out_lines.append('trace %s (%d span(s)):' % (
+        trace_id, sum(1 for s in store.spans.values()
+                      if s['trace'] == trace_id)))
+    seen = set()
+
+    def walk(sid, depth, via_link=False):
+        if sid in seen or depth > max_depth:
+            return
+        seen.add(sid)
+        sp = store.spans[sid]
+        dur = ('%.3fms' % (sp['dur_s'] * 1e3)) if sp['closed'] \
+            else 'UNCLOSED'
+        extra = ' '.join('%s=%s' % kv
+                         for kv in sorted(sp['fields'].items()))
+        mark = ' (via link)' if via_link else ''
+        jpath = store.journals[sp['journal']][0]
+        out_lines.append('%s%-26s %10s  [%s]%s%s' % (
+            '  ' * depth, sp['name'], dur, jpath,
+            (' ' + extra) if extra else '', mark))
+        for c in sorted(store.children.get(sid, ()),
+                        key=lambda s: store.spans[s].get('t') or 0.0):
+            walk(c, depth + 1)
+        for b in store.links.get(sid, ()):
+            if b in store.spans:
+                walk(b, depth + 1, via_link=True)
+
+    for r in roots:
+        walk(r, 1)
+
+
+def summarize(store, kind=None, top=10):
+    kinds = store.by_kind()
+    table = {}
+    for name, spans in sorted(kinds.items()):
+        row = {'count': len(spans)}
+        for label, q in (('p50', 0.50), ('p95', 0.95), ('p99', 0.99)):
+            sp = _quantile(spans, q)
+            row[label] = {'dur_s': sp['dur_s'], 'trace': sp['trace']}
+        row['max_s'] = spans[-1]['dur_s']
+        row['total_s'] = sum(s['dur_s'] for s in spans)
+        table[name] = row
+    unclosed = store.unclosed()
+    summary = {
+        'journals': [p for p, _ in store.journals],
+        'malformed_lines': store.malformed,
+        'spans': sum(1 for s in store.spans.values() if s['closed']),
+        'unclosed': [
+            {'name': s['name'], 'trace': s['trace'], 'span': s['span'],
+             'journal': store.journals[s['journal']][0]}
+            for s in unclosed],
+        'traces': len(store.traces),
+        'kinds': table,
+    }
+    if kind is not None:
+        spans = kinds.get(kind, [])
+        attribution = {}
+        for label, q in (('p50', 0.50), ('p95', 0.95), ('p99', 0.99)):
+            sp = _quantile(spans, q)
+            if sp is None:
+                continue
+            attribution[label] = {
+                'trace': sp['trace'], 'dur_s': sp['dur_s'],
+                'stages': store.self_times(sp['span']),
+                'critical_path': [
+                    {'name': p['name'], 'dur_s': p['dur_s']}
+                    for p in store.critical_path(sp['span'])],
+            }
+        summary['attribution'] = {'kind': kind, 'count': len(spans),
+                                  'percentiles': attribution}
+    return summary
+
+
+def render(summary, top=10):
+    s = summary
+    lines = [
+        '----------------->     Trace Report     <-----------------',
+        '%d journal(s), %d closed span(s), %d trace(s), %d unclosed'
+        % (len(s['journals']), s['spans'], s['traces'],
+           len(s['unclosed'])),
+    ]
+    if s['malformed_lines']:
+        lines.append('!! %d malformed line(s)' % s['malformed_lines'])
+    if s['kinds']:
+        lines.append('%-26s %6s %10s %10s %10s %10s' % (
+            'span kind', 'count', 'p50', 'p95', 'p99', 'max'))
+        for name, row in sorted(s['kinds'].items()):
+            lines.append('%-26s %6d %9.2fms %9.2fms %9.2fms %9.2fms' % (
+                name, row['count'], row['p50']['dur_s'] * 1e3,
+                row['p95']['dur_s'] * 1e3, row['p99']['dur_s'] * 1e3,
+                row['max_s'] * 1e3))
+            lines.append('  %-24s        p50=%s p99=%s' % (
+                'exemplar traces:', row['p50']['trace'],
+                row['p99']['trace']))
+    at = s.get('attribution')
+    if at:
+        lines.append('attribution for %r (%d spans):'
+                     % (at['kind'], at['count']))
+        for label in ('p50', 'p95', 'p99'):
+            pct = at['percentiles'].get(label)
+            if pct is None:
+                continue
+            lines.append('  %s %.3fms  trace %s'
+                         % (label, pct['dur_s'] * 1e3, pct['trace']))
+            stages = sorted(pct['stages'].items(),
+                            key=lambda kv: -kv[1])
+            for stage, self_s in stages[:top]:
+                share = self_s / pct['dur_s'] if pct['dur_s'] else 0.0
+                lines.append('    %-24s %9.3fms  (%4.1f%% self)'
+                             % (stage, self_s * 1e3, 100.0 * share))
+            lines.append('    critical path: %s' % ' > '.join(
+                p['name'] for p in pct['critical_path']))
+    if s['unclosed']:
+        lines.append('unclosed spans (work that died in flight):')
+        for u in s['unclosed'][:top]:
+            lines.append('  %-26s trace=%s  [%s]'
+                         % (u['name'], u['trace'], u['journal']))
+        if len(s['unclosed']) > top:
+            lines.append('  ... and %d more'
+                         % (len(s['unclosed']) - top))
+    return '\n'.join(lines)
+
+
+def build_store(paths):
+    store = SpanStore()
+    for p in paths:
+        store.add_journal(p)
+    store.finalize()
+    return store
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('journals', nargs='+',
+                    help='RunJournal .jsonl files (one per process)')
+    ap.add_argument('--trace', default=None, metavar='TRACE_ID',
+                    help='print the full span tree of one trace')
+    ap.add_argument('--kind', default=None, metavar='SPAN_NAME',
+                    help='per-stage attribution of the p50/p95/p99 '
+                         'exemplars of this span kind')
+    ap.add_argument('--top', type=int, default=10,
+                    help='stages / unclosed spans to list')
+    ap.add_argument('--json', default=None, metavar='PATH',
+                    help="write the summary as JSON ('-' = stdout)")
+    args = ap.parse_args(argv)
+    store = build_store(args.journals)
+    if args.trace:
+        lines = []
+        render_tree(store, args.trace, lines)
+        print('\n'.join(lines))
+        return 0
+    summary = summarize(store, kind=args.kind, top=args.top)
+    if args.json == '-':
+        json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    if args.json:
+        with open(args.json, 'w') as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+    print(render(summary, top=args.top))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
