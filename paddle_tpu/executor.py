@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from . import framework
 from . import observability as _obs
+from .observability import perf as _perf
 from .framework import Program, Variable, default_main_program
 from .core import places as _places
 from .core import lowering
@@ -876,6 +877,26 @@ class Executor(object):
             # untouched
             state = part.reconcile_state(state, state_s)
 
+        _ledger = None
+        if was_miss and not (profiling or dynamic) \
+                and not (sharded and part.multiprocess) \
+                and _perf.capture_enabled():
+            # perf observatory (OBSERVABILITY.md): ledger the program's
+            # XLA cost/memory accounting on the miss path only — one
+            # extra AOT lower().compile() against abstract avals per
+            # compile, zero steady-state cost. Runs under the same
+            # device/mesh context as the dispatch and never raises.
+            with part.run_context() if sharded else \
+                    jax.default_device(self.place.jax_device()):
+                _ledger = _perf.capture_compiled(
+                    jitted, feed, state, key[0],
+                    backend=jax.default_backend(),
+                    device_kind=getattr(self.place.jax_device(),
+                                        'device_kind', ''),
+                    mesh=_perf.mesh_signature(
+                        part.describe() if sharded else None),
+                    devices=part.device_count if sharded else 1)
+
         t_run = time.perf_counter()
         with part.run_context() if sharded else \
                 jax.default_device(self.place.jax_device()):
@@ -899,6 +920,10 @@ class Executor(object):
             if tspan is not None:
                 _obs.emit_span('exe/compile', compile_wall,
                                parent=tspan, fp=key[0])
+            if _ledger is not None:
+                _perf.seal(_ledger, compile_wall,
+                           trace=tspan.context if tspan is not None
+                           else _pctx)
         if tspan is not None:
             _obs.emit_span('exe/dispatch', run_wall, parent=tspan,
                            cache='miss' if was_miss else 'hit')
@@ -1115,6 +1140,21 @@ class Executor(object):
                 if tspan is not None:
                     tspan.end(fallback='globalize')
                 return _sequential()
+        _ledger = None
+        if was_miss and not multiproc and _perf.capture_enabled():
+            # chained programs ledger separately (K steps fused into
+            # one XLA program — flops/bytes are per-CHUNK, chain=k)
+            with part.run_context() if part.active else \
+                    jax.default_device(self.place.jax_device()):
+                _ledger = _perf.capture_compiled(
+                    jitted, stacked, state,
+                    key[0], backend=jax.default_backend(),
+                    device_kind=getattr(self.place.jax_device(),
+                                        'device_kind', ''),
+                    mesh=_perf.mesh_signature(
+                        part.describe() if part.active else None),
+                    devices=part.device_count if part.active else 1,
+                    chain=k)
         t_run = time.perf_counter()
         with part.run_context() if part.active else \
                 jax.default_device(self.place.jax_device()):
@@ -1152,6 +1192,10 @@ class Executor(object):
             if tspan is not None:
                 _obs.emit_span('exe/compile', compile_wall,
                                parent=tspan, fp=key[0])
+            if _ledger is not None:
+                _perf.seal(_ledger, compile_wall,
+                           trace=tspan.context if tspan is not None
+                           else _pctx)
         if tspan is not None:
             _obs.emit_span('exe/dispatch', run_wall, parent=tspan,
                            cache='miss' if was_miss else 'hit')

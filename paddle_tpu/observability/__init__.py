@@ -19,6 +19,13 @@ Two complementary surfaces, both stdlib-only and import-cycle-free:
   ``span_end`` / ``span_link`` events, a ``PTPU_TRACE_SAMPLE``
   sampling knob. Reconstruct trees with ``tools/trace_report.py``,
   merge per-process journals with repeated ``--journal_path`` flags.
+- :mod:`~paddle_tpu.observability.perf` — the performance
+  observatory: per-program :class:`ProgramLedger` (XLA cost/memory
+  analysis) captured on the Executor's compile-miss path when enabled
+  (``PTPU_PERF=1`` / :func:`perf.enable_capture`), live
+  ``perf_mfu{program=}`` / roofline gauges joined from measured step
+  walls, and the :class:`PerfBaseline` regression sentinel behind
+  ``tools/perf_report.py``.
 """
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, default_registry,
@@ -31,6 +38,9 @@ from .tracing import (TraceContext, Span, NULL_SPAN,  # noqa: F401
                       start_span, span, current_span, current_context,
                       link, emit_span, sample_rate, parent_from_env,
                       TRACE_PARENT_ENV, TRACE_SAMPLE_ENV)
+from . import perf  # noqa: F401
+from .perf import (ProgramLedger, LedgerBook, PerfBaseline,  # noqa
+                   PERF_ENV)
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
@@ -43,4 +53,5 @@ __all__ = [
     'current_span', 'current_context', 'link', 'emit_span',
     'sample_rate', 'parent_from_env', 'TRACE_PARENT_ENV',
     'TRACE_SAMPLE_ENV',
+    'perf', 'ProgramLedger', 'LedgerBook', 'PerfBaseline', 'PERF_ENV',
 ]

@@ -25,6 +25,9 @@ Event schema (OBSERVABILITY.md has the full field tables):
 ``anomaly``        kind, where, policy (AnomalyGuard trips)
 ``span_begin`` / ``span_end`` / ``span_link``  distributed tracing
                    (tracing.py): name, trace/span/parent ids, dur_s
+``perf_ledger``    per-program cost/memory ledger (perf.py): flops,
+                   bytes, mesh, compile wall, trace exemplar; a
+                   ``phase=measured`` update adds measured_ms/mfu
 =================  =====================================================
 
 Records with a ``dur_s`` field are SPANS — ``tools/timeline.py`` can

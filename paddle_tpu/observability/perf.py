@@ -1,0 +1,618 @@
+"""Performance observatory: per-program cost/memory ledgers, live MFU
+and HBM attribution, and the on-disk perf-regression baseline.
+
+Every perf claim in PERF.md ultimately reduces to one artifact — the
+flops/bytes "ledger" XLA computes for a compiled program — which used
+to live as private offline code in ``bench.py``. This module promotes
+it to a first-class runtime surface:
+
+- :class:`ProgramLedger` — captured on the Executor's compile-cache
+  MISS path (one extra AOT ``lower().compile()`` against abstract
+  avals; zero steady-state cost) for every jitted program: XLA
+  ``cost_analysis()`` flops / bytes-accessed plus ``memory_analysis()``
+  temp/argument/output bytes, the compile wall, device kind, and the
+  partition mesh signature so dp/ZeRO variants ledger separately.
+- :class:`LedgerBook` — the process-wide store; feeds the
+  ``perf_hbm_live_bytes`` / ``perf_hbm_watermark_bytes`` gauges.
+- :func:`publish_step` — joins a ledger with the measured step wall
+  into ``perf_mfu{program=}`` and ``perf_roofline_bound{program=}``
+  (1.0 = compute-bound, 0.0 = bandwidth-bound). Two gauge stores per
+  step; the Trainer calls it from its dispatch path.
+- ``perf_ledger`` journal events carry the tracing trace id, so a
+  regressed program resolves to a renderable span tree
+  (``tools/trace_report.py``).
+- :class:`PerfBaseline` — TuningCache-style on-disk JSON keyed
+  ``fingerprint|shape-sig|backend|mesh``; ``tools/perf_report.py``
+  diffs a run against it and exits nonzero on regressions.
+
+Overhead contract (mirrors tracing/journal): capture is OFF by default
+— ``capture_enabled()`` is one list read (+ an env probe on the
+compile-miss path only). Enable with :func:`enable_capture`, the
+:func:`capture_scope` context manager, or ``PTPU_PERF=1`` in the
+environment. ``bench.py bench_perf_obs_overhead`` pins the enabled
+steady-state cost at <=1% of the training hot loop.
+
+Lint contract: this file is the ONLY place allowed to call XLA's
+``cost_analysis()`` directly (``tools/lint_repo.py`` rule
+``direct-cost-analysis``; ``Executor.cost_analysis`` is the seeded
+allowlist exception it delegates through).
+"""
+import contextlib
+import hashlib
+import json
+import os
+import threading
+
+# NB: the package __init__ rebinds the name ``journal`` to the
+# contextmanager, so import the emit hook directly (not the submodule)
+from .journal import emit as _emit
+from . import metrics as _metrics
+
+__all__ = [
+    'PERF_ENV', 'PEAK_FLOPS_ENV', 'HBM_GBPS_ENV',
+    'DEFAULT_PEAK_FLOPS', 'DEFAULT_HBM_GBPS',
+    'ProgramLedger', 'LedgerBook', 'PerfBaseline',
+    'capture_enabled', 'enable_capture', 'capture_scope',
+    'capture_compiled', 'seal', 'publish_step',
+    'book', 'get_ledger', 'ledgers', 'clear',
+    'peak_flops_for', 'hbm_gbps_for', 'mesh_signature',
+    'shape_signature', 'transformer_flops_per_token',
+    'mfu_from_throughput', 'program_ledger', 'memory_dict',
+]
+
+PERF_ENV = 'PTPU_PERF'              # '1' -> capture on for the process
+PEAK_FLOPS_ENV = 'PTPU_PERF_PEAK_FLOPS'   # override bf16 peak (flop/s)
+HBM_GBPS_ENV = 'PTPU_PERF_HBM_GBPS'       # override HBM bandwidth
+
+# bf16 peak flop/s by device-kind substring (first match wins) — same
+# table bench.py's MFU headlines always used; v5e is the measured chip.
+PEAK_BF16 = (('v6', 918e12), ('v5p', 459e12), ('v5', 197e12),
+             ('v4', 275e12), ('v3', 123e12), ('v2', 45e12))
+# HBM GB/s by device-kind substring; 819 is the v5e number every
+# published bandwidth-bound figure in PERF.md is computed against.
+HBM_GBPS = (('v6', 1640.0), ('v5p', 2765.0), ('v5', 819.0),
+            ('v4', 1228.0), ('v3', 900.0), ('v2', 700.0))
+
+DEFAULT_PEAK_FLOPS = 197e12
+DEFAULT_HBM_GBPS = 819.0
+
+BASELINE_SCHEMA = 1
+
+# Relative drift allowed on compile-time-deterministic fields (flops,
+# bytes) before the baseline diff calls it a mismatch; XLA version
+# bumps move these by well under a percent.
+DETERMINISTIC_RTOL = 0.02
+
+_TRUTHY = ('1', 'true', 'on', 'yes')
+
+
+def peak_flops_for(device_kind, default=DEFAULT_PEAK_FLOPS):
+    """bf16 peak flop/s for a PJRT ``device_kind`` string (env override
+    ``PTPU_PERF_PEAK_FLOPS`` wins; unknown kinds -> ``default``)."""
+    ov = os.environ.get(PEAK_FLOPS_ENV)
+    if ov:
+        try:
+            return float(ov)
+        except ValueError:
+            pass
+    kind = (device_kind or '').lower()
+    return next((p for s, p in PEAK_BF16 if s in kind), default)
+
+
+def hbm_gbps_for(device_kind, default=DEFAULT_HBM_GBPS):
+    """HBM bandwidth in GB/s for a device kind (env override
+    ``PTPU_PERF_HBM_GBPS`` wins; unknown kinds -> ``default``)."""
+    ov = os.environ.get(HBM_GBPS_ENV)
+    if ov:
+        try:
+            return float(ov)
+        except ValueError:
+            pass
+    kind = (device_kind or '').lower()
+    return next((b for s, b in HBM_GBPS if s in kind), default)
+
+
+# ---- capture gate ---------------------------------------------------------
+# tri-state like tracing's sample override: None -> the env decides.
+_CAPTURE = [None]
+
+
+def capture_enabled():
+    v = _CAPTURE[0]
+    if v is not None:
+        return v
+    return os.environ.get(PERF_ENV, '').lower() in _TRUTHY
+
+
+def enable_capture(on=True):
+    """Force ledger capture on/off for the process (overrides
+    ``PTPU_PERF``); ``None`` restores env control. Returns the previous
+    override so callers can restore it."""
+    prev = _CAPTURE[0]
+    _CAPTURE[0] = None if on is None else bool(on)
+    return prev
+
+
+@contextlib.contextmanager
+def capture_scope(on=True):
+    """Scoped :func:`enable_capture` — serving ``warmup()`` wraps its
+    per-bucket pre-compiles in this so every bucket ledgers."""
+    prev = enable_capture(on)
+    try:
+        yield
+    finally:
+        _CAPTURE[0] = prev
+
+
+# ---- signatures -----------------------------------------------------------
+def shape_signature(feed, state):
+    """Stable short token of the (feed, state) leaf shapes/dtypes —
+    the shape axis of the baseline key. Mirrors the spirit of
+    ``compiler.tuning.shape_signature`` without importing the executor
+    (cycle avoidance)."""
+    import jax
+    leaves = jax.tree_util.tree_leaves((feed, state))
+    items = [(tuple(getattr(v, 'shape', ()) or ()),
+              str(getattr(v, 'dtype', type(v).__name__)))
+             for v in leaves]
+    return hashlib.sha1(repr(items).encode()).hexdigest()[:16]
+
+
+def mesh_signature(describe=None):
+    """Canonical mesh token for ledger/baseline keys: ``'single'`` off
+    the mesh, else sorted ``axis=extent`` pairs from
+    ``Partitioner.describe()['axes']`` (e.g. ``'dp=2'``)."""
+    if not describe:
+        return 'single'
+    axes = describe.get('axes') if isinstance(describe, dict) else None
+    if not axes:
+        return 'single'
+    return ','.join('%s=%d' % (k, int(v))
+                    for k, v in sorted(axes.items()))
+
+
+# ---- the ledger -----------------------------------------------------------
+class ProgramLedger(object):
+    """One compiled program's XLA-counted cost/memory accounting."""
+
+    __slots__ = ('fingerprint', 'shape_sig', 'backend', 'device_kind',
+                 'mesh', 'devices', 'chain', 'flops', 'bytes_accessed',
+                 'output_bytes', 'temp_bytes', 'argument_bytes',
+                 'compile_wall_s', 'measured_ms', 'trace', 'label')
+
+    def __init__(self, fingerprint, shape_sig='', backend='',
+                 device_kind='', mesh='single', devices=1, chain=0,
+                 flops=0.0, bytes_accessed=0.0, output_bytes=0.0,
+                 temp_bytes=0, argument_bytes=0, label=''):
+        self.fingerprint = fingerprint
+        self.shape_sig = shape_sig
+        self.backend = backend
+        self.device_kind = device_kind
+        self.mesh = mesh
+        self.devices = int(devices)
+        self.chain = int(chain)
+        self.flops = float(flops)
+        self.bytes_accessed = float(bytes_accessed)
+        self.output_bytes = float(output_bytes)
+        self.temp_bytes = int(temp_bytes)
+        self.argument_bytes = int(argument_bytes)
+        self.compile_wall_s = None
+        self.measured_ms = None
+        self.trace = None
+        self.label = label
+
+    # -- derived ------------------------------------------------------------
+    @property
+    def live_bytes(self):
+        """Per-device bytes the compiled program holds while running:
+        arguments + outputs + XLA temp buffers."""
+        return int(self.argument_bytes + self.output_bytes
+                   + self.temp_bytes)
+
+    @property
+    def peak_flops(self):
+        return peak_flops_for(self.device_kind)
+
+    @property
+    def hbm_gbps(self):
+        return hbm_gbps_for(self.device_kind)
+
+    def bandwidth_bound_s(self, hbm_gbps=None):
+        bw = self.hbm_gbps if hbm_gbps is None else hbm_gbps
+        return self.bytes_accessed / (bw * 1e9)
+
+    def compute_bound_s(self, peak=None):
+        pk = self.peak_flops if peak is None else peak
+        return self.flops / pk
+
+    @property
+    def roofline_bound(self):
+        """Which roofline leg binds this program: the larger of the two
+        bound times is the constraint the measured step cannot beat."""
+        return ('compute' if self.compute_bound_s()
+                >= self.bandwidth_bound_s() else 'bandwidth')
+
+    def mfu(self, measured_ms=None, peak=None):
+        """XLA-counted flops over the measured step against bf16 peak;
+        None until a measured step time is known."""
+        ms = self.measured_ms if measured_ms is None else measured_ms
+        if not ms:
+            return None
+        pk = self.peak_flops if peak is None else peak
+        return self.flops / (ms / 1e3) / pk
+
+    # -- serialization ------------------------------------------------------
+    def bench_dict(self, measured_ms, hbm_gbps=DEFAULT_HBM_GBPS,
+                   peak=DEFAULT_PEAK_FLOPS):
+        """The exact BENCH-JSON ``ledger`` dict bench.py has always
+        published (resnet50 r4 onward) — field names and rounding are
+        byte-compatible with the retired private implementation."""
+        return {
+            'flops': self.flops,
+            'bytes_accessed': self.bytes_accessed,
+            'temp_bytes': self.temp_bytes,
+            'bandwidth_bound_ms': round(
+                self.bytes_accessed / (hbm_gbps * 1e9) * 1e3, 1),
+            'compute_bound_ms': round(self.flops / peak * 1e3, 1),
+            'measured_ms_per_step': round(measured_ms, 1),
+            'hw_flops_per_sec': round(
+                self.flops / (measured_ms / 1e3), 0),
+        }
+
+    def as_dict(self):
+        d = {
+            'fp': self.fingerprint, 'shape_sig': self.shape_sig,
+            'backend': self.backend, 'device_kind': self.device_kind,
+            'mesh': self.mesh, 'devices': self.devices,
+            'chain': self.chain, 'flops': self.flops,
+            'bytes_accessed': self.bytes_accessed,
+            'output_bytes': self.output_bytes,
+            'temp_bytes': self.temp_bytes,
+            'argument_bytes': self.argument_bytes,
+            'live_bytes': self.live_bytes,
+            'bandwidth_bound_ms': round(
+                self.bandwidth_bound_s() * 1e3, 3),
+            'compute_bound_ms': round(self.compute_bound_s() * 1e3, 3),
+            'roofline': self.roofline_bound,
+        }
+        if self.label:
+            d['program'] = self.label
+        if self.compile_wall_s is not None:
+            d['compile_wall_s'] = round(self.compile_wall_s, 6)
+        if self.measured_ms is not None:
+            d['measured_ms'] = round(self.measured_ms, 3)
+            m = self.mfu()
+            if m is not None:
+                d['mfu'] = round(m, 4)
+        return d
+
+
+class LedgerBook(object):
+    """Thread-safe (fp, shape_sig, backend, mesh) -> ledger store;
+    owns the process HBM live/watermark gauges."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}    # full key -> ProgramLedger
+        self._by_fp = {}      # fingerprint -> latest ProgramLedger
+        self._watermark = 0
+
+    @staticmethod
+    def key(ledger):
+        return '%s|%s|%s|%s' % (ledger.fingerprint, ledger.shape_sig,
+                                ledger.backend, ledger.mesh)
+
+    def record(self, ledger):
+        with self._lock:
+            self._entries[self.key(ledger)] = ledger
+            self._by_fp[ledger.fingerprint] = ledger
+            live = sum(l.live_bytes for l in self._entries.values())
+            self._watermark = max(self._watermark, live)
+            wm = self._watermark
+        reg = _metrics.default_registry()
+        reg.gauge('perf_hbm_live_bytes',
+                  'sum of live bytes (args+outputs+temps) over all '
+                  'ledgered compiled programs, per device').set(live)
+        reg.gauge('perf_hbm_watermark_bytes',
+                  'high-water mark of perf_hbm_live_bytes over the '
+                  'process lifetime').set(wm)
+        return ledger
+
+    def get(self, fingerprint):
+        with self._lock:
+            return self._by_fp.get(fingerprint)
+
+    def ledgers(self):
+        with self._lock:
+            return list(self._entries.values())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._by_fp.clear()
+            self._watermark = 0
+
+
+_BOOK = LedgerBook()
+_PUBLISHED = set()    # fingerprints whose measured journal update went out
+_GAUGES = {}          # fingerprint -> (mfu_gauge, roofline_gauge);
+#                       registry lookups are lock+label-sort, too slow
+#                       for the per-flush publish path
+
+
+def book():
+    return _BOOK
+
+
+def get_ledger(fingerprint):
+    return _BOOK.get(fingerprint)
+
+
+def ledgers():
+    return _BOOK.ledgers()
+
+
+def clear():
+    """Drop every recorded ledger and the measured-once markers (test /
+    benchmark phase isolation; gauges re-publish on next record)."""
+    _BOOK.clear()
+    _PUBLISHED.clear()
+    _GAUGES.clear()
+
+
+# ---- capture / seal / publish ---------------------------------------------
+def _capture_failures():
+    return _metrics.default_registry().counter(
+        'perf_capture_failures_total',
+        'ledger captures that raised and were dropped (capture is '
+        'diagnostic; it never fails the run)')
+
+
+def capture_compiled(jitted, feed, state, fingerprint, backend='',
+                     device_kind='', mesh='single', devices=1,
+                     chain=0, label=''):
+    """AOT-compile ``jitted`` against the abstract avals of ``(feed,
+    state)`` and read XLA's cost/memory analysis into a
+    :class:`ProgramLedger`. Returns None when capture is disabled or
+    anything goes wrong — the ledger is diagnostic and must never take
+    down an execution. Call under the same device/mesh context the
+    program will execute in (the Executor does)."""
+    if not capture_enabled():
+        return None
+    try:
+        import jax
+        abstract = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+            (feed, state))
+        comp = jitted.lower(*abstract).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        ca = ca or {}
+        ma = comp.memory_analysis()
+        ledger = ProgramLedger(
+            fingerprint=fingerprint,
+            shape_sig=shape_signature(feed, state),
+            backend=backend, device_kind=device_kind, mesh=mesh,
+            devices=devices, chain=chain,
+            flops=float(ca.get('flops', 0.0)),
+            bytes_accessed=float(ca.get('bytes accessed', 0.0)),
+            output_bytes=float(ca.get('bytes accessedout{}', 0.0)),
+            temp_bytes=int(ma.temp_size_in_bytes),
+            argument_bytes=int(ma.argument_size_in_bytes),
+            label=label)
+        try:
+            ledger.output_bytes = float(ma.output_size_in_bytes)
+        except AttributeError:
+            pass
+        return ledger
+    except Exception:
+        _capture_failures().inc()
+        return None
+
+
+def seal(ledger, compile_wall_s, trace=None):
+    """Finish a captured ledger on the compile-miss seal path: attach
+    the compile wall and the trace context, record into the book, and
+    journal the ``perf_ledger`` event (with the trace-id exemplar when
+    the compile ran under a sampled trace)."""
+    if ledger is None:
+        return None
+    ledger.compile_wall_s = float(compile_wall_s)
+    if trace is not None and getattr(trace, 'sampled', False):
+        ledger.trace = trace.trace_id
+    _BOOK.record(ledger)
+    fields = ledger.as_dict()
+    if ledger.trace:
+        fields['trace'] = ledger.trace
+    _emit('perf_ledger', **fields)
+    return ledger
+
+
+def publish_step(fingerprint, seconds_per_step):
+    """Join a measured per-step wall with the program's ledger into the
+    live derived series. Steady-state cost: one dict probe when nothing
+    is ledgered; two gauge stores when a ledger exists. The first
+    measurement per program also journals a ``perf_ledger`` update
+    carrying ``measured_ms``/``mfu``."""
+    if not _BOOK._by_fp:      # nothing captured -> free
+        return None
+    ledger = _BOOK.get(fingerprint)
+    if ledger is None or not seconds_per_step:
+        return None
+    ms = seconds_per_step * 1e3
+    ledger.measured_ms = ms
+    mfu = ledger.mfu()
+    pair = _GAUGES.get(fingerprint)
+    if pair is None:
+        reg = _metrics.default_registry()
+        pair = (
+            reg.gauge('perf_mfu',
+                      'XLA-counted flops / measured step / bf16 peak, '
+                      'per compiled program', program=fingerprint),
+            reg.gauge('perf_roofline_bound',
+                      'roofline classification per program: 1.0 = '
+                      'compute-bound, 0.0 = bandwidth-bound',
+                      program=fingerprint))
+        _GAUGES[fingerprint] = pair
+    pair[0].set(mfu or 0.0)
+    pair[1].set(1.0 if ledger.roofline_bound == 'compute' else 0.0)
+    if fingerprint not in _PUBLISHED:
+        _PUBLISHED.add(fingerprint)
+        _emit('perf_ledger', fp=fingerprint, phase='measured',
+                      measured_ms=round(ms, 3),
+                      mfu=round(mfu, 4) if mfu is not None else None,
+                      roofline=ledger.roofline_bound)
+    return mfu
+
+
+# ---- shared offline helpers (the one ledger implementation) ---------------
+def program_ledger(exe, program, feed, fetch_list, scope=None,
+                   measured_ms=None, hbm_gbps=DEFAULT_HBM_GBPS,
+                   peak=DEFAULT_PEAK_FLOPS):
+    """The bench.py ledger dict for a fluid program, via
+    ``Executor.cost_analysis`` (the allowlisted XLA caller). With
+    ``measured_ms`` this returns the full BENCH-compatible dict
+    (``bandwidth_bound_ms`` .. ``hw_flops_per_sec``); without it, just
+    the raw cost fields."""
+    ca = exe.cost_analysis(program, feed, fetch_list, scope=scope)
+    if measured_ms is None:
+        return dict(ca)
+    ledger = ProgramLedger(
+        fingerprint=program.fingerprint(),
+        flops=ca['flops'], bytes_accessed=ca['bytes_accessed'],
+        output_bytes=ca.get('output_bytes', 0.0),
+        temp_bytes=ca['temp_bytes'],
+        argument_bytes=ca.get('argument_bytes', 0))
+    return ledger.bench_dict(measured_ms, hbm_gbps=hbm_gbps, peak=peak)
+
+
+def memory_dict(comp):
+    """Per-device byte accounting of an AOT-compiled executable —
+    the shared ``memory_analysis()`` reader (ParallelExecutor
+    ``compile_stats``, bench memory leg)."""
+    ma = comp.memory_analysis()
+    return {'argument_bytes': int(ma.argument_size_in_bytes),
+            'output_bytes': int(ma.output_size_in_bytes),
+            'temp_bytes': int(ma.temp_size_in_bytes)}
+
+
+def transformer_flops_per_token(n_layers, d_model, vocab, seq):
+    """Matmul-only flops/token for the bench transformer (projections
+    + FFN + unembed at 6 flops per weight, attention dots at
+    12 * layers * (S/2) * d for the causal average) — the exact
+    arithmetic behind every published transformer MFU number."""
+    n_matmul = n_layers * 12 * d_model * d_model + vocab * d_model
+    return 6 * n_matmul + 12 * n_layers * (seq // 2) * d_model
+
+
+def mfu_from_throughput(per_sec, flops_per_unit,
+                        peak=DEFAULT_PEAK_FLOPS):
+    """round(throughput * flops-per-unit / peak, 4) — the BENCH-JSON
+    MFU rounding, one place."""
+    return round(per_sec * flops_per_unit / peak, 4)
+
+
+# ---- regression baseline --------------------------------------------------
+class PerfBaseline(object):
+    """On-disk perf baseline, TuningCache-style: schema'd JSON of
+    entries keyed ``fingerprint|shape-sig|backend|mesh``. Deterministic
+    fields (flops, bytes) must MATCH within ``DETERMINISTIC_RTOL``;
+    timing fields (``step_ms``, ``mfu``), when present on both sides,
+    gate regressions at the caller's tolerance."""
+
+    def __init__(self, path):
+        self.path = path
+        self.entries = {}
+
+    @staticmethod
+    def key(fingerprint, shape_sig, backend, mesh):
+        return '%s|%s|%s|%s' % (fingerprint, shape_sig, backend, mesh)
+
+    @classmethod
+    def entry_from_ledger(cls, ledger, with_timings=False):
+        e = {'program': ledger.label or ledger.fingerprint[:12],
+             'device_kind': ledger.device_kind,
+             'flops': ledger.flops,
+             'bytes_accessed': ledger.bytes_accessed,
+             'temp_bytes': ledger.temp_bytes,
+             'argument_bytes': ledger.argument_bytes,
+             'output_bytes': ledger.output_bytes}
+        if with_timings and ledger.measured_ms:
+            e['step_ms'] = round(ledger.measured_ms, 3)
+            m = ledger.mfu()
+            if m is not None:
+                e['mfu'] = round(m, 4)
+        return e
+
+    # -- persistence --------------------------------------------------------
+    def load(self):
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return self
+        if data.get('schema') == BASELINE_SCHEMA:
+            self.entries = dict(data.get('entries', {}))
+        return self
+
+    def save(self):
+        payload = {'schema': BASELINE_SCHEMA,
+                   'entries': dict(self.entries)}
+        d = os.path.dirname(os.path.abspath(self.path))
+        try:
+            os.makedirs(d)
+        except OSError:
+            pass
+        tmp = self.path + '.tmp.%d' % os.getpid()
+        with open(tmp, 'w') as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write('\n')
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def put(self, key, entry):
+        self.entries[key] = dict(entry)
+
+    # -- the sentinel -------------------------------------------------------
+    def diff(self, current, tol=0.10, det_rtol=DETERMINISTIC_RTOL):
+        """Compare ``current`` ({key: entry}) against the baseline.
+        Returns a list of problem strings, each naming the program —
+        empty means the run is clean. Baseline keys absent from the
+        run are reported (a program stopped compiling); run keys absent
+        from the baseline are NOT (new programs ratchet in via
+        ``--update-baseline``)."""
+        problems = []
+        for key, base in sorted(self.entries.items()):
+            name = base.get('program') or key.split('|')[0][:12]
+            cur = current.get(key)
+            if cur is None:
+                problems.append(
+                    '%s: program missing from run (baseline key %s)'
+                    % (name, key))
+                continue
+            for f in ('flops', 'bytes_accessed'):
+                b, c = base.get(f), cur.get(f)
+                if b is None or c is None:
+                    continue
+                if abs(c - b) > det_rtol * max(abs(b), 1.0):
+                    problems.append(
+                        '%s: %s drifted %.4g -> %.4g (> %.0f%% rtol)'
+                        % (name, f, b, c, det_rtol * 100))
+            b_ms, c_ms = base.get('step_ms'), cur.get('step_ms')
+            if b_ms and c_ms and c_ms > b_ms * (1.0 + tol):
+                problems.append(
+                    '%s: step time regressed %.3f ms -> %.3f ms '
+                    '(> %.0f%% tolerance)' % (name, b_ms, c_ms,
+                                              tol * 100))
+            b_m, c_m = base.get('mfu'), cur.get('mfu')
+            if b_m and c_m and c_m < b_m * (1.0 - tol):
+                problems.append(
+                    '%s: MFU regressed %.4f -> %.4f (> %.0f%% '
+                    'tolerance)' % (name, b_m, c_m, tol * 100))
+        return problems

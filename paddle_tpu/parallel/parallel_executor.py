@@ -185,9 +185,7 @@ class ParallelExecutor(object):
             (feed, state))
         with part.run_context():
             comp = jitted.lower(*abstract).compile()
-        ma = comp.memory_analysis()
-        return {
-            'argument_bytes': int(ma.argument_size_in_bytes),
-            'temp_bytes': int(ma.temp_size_in_bytes),
-            'output_bytes': int(ma.output_size_in_bytes),
-        }
+        # shared memory_analysis reader (observability.perf) — same
+        # dict the perf ledger's byte fields come from
+        from ..observability import perf as _perf
+        return _perf.memory_dict(comp)

@@ -375,11 +375,19 @@ class ModelServer(object):
         instead of re-deriving defaults: fast cold-start is the whole
         point of paying the tuning search offline."""
         from ..compiler import tuning as _ctuning
+        from ..observability import perf as _perf
         t0 = time.monotonic()
         tuned = _ctuning.default_cache().preload()
         names = [model_name] if model_name is not None else self.models()
         warmed = {}
-        with _prof.serving_span('serving/warmup'):
+        # perf observatory: when this process is already observing
+        # (capture on, or a journal installed) warmup ledgers every
+        # bucket it compiles — per-bucket flops/bytes land in the book
+        # and as perf_ledger events before any live traffic
+        _n_ledgers0 = len(_perf.book())
+        with _perf.capture_scope(_perf.capture_enabled()
+                                 or _obs.journal_active()), \
+                _prof.serving_span('serving/warmup'):
             pending = []
             for name in names:
                 model = self.registry.get(name)
@@ -401,6 +409,7 @@ class ModelServer(object):
                   models=len(warmed),
                   buckets=sum(len(v) for v in warmed.values()),
                   tuning_entries=tuned,
+                  perf_ledgers=len(_perf.book()) - _n_ledgers0,
                   dur_s=round(time.monotonic() - t0, 6))
         return warmed
 

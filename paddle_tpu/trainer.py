@@ -450,6 +450,10 @@ class Trainer(object):
             'Executor dispatch wall per chunk (1 step, or K chained)')
         loop_t0 = time.monotonic()
         steps_done = examples_done = 0
+        # perf observatory (OBSERVABILITY.md): the step program's
+        # fingerprint keys its compiled ledger; computed once per
+        # train() call, joined per step in flush()
+        perf_fp = self.train_program.fingerprint()
         _obs.emit('train_begin', epochs=num_epochs,
                   start_epoch=start_epoch, global_step=global_step,
                   prefetch=prefetch, steps_per_dispatch=chain_k)
@@ -499,6 +503,9 @@ class Trainer(object):
             dispatch_wall = time.monotonic() - t0
             m_dispatch.observe(dispatch_wall)
             per_step = dispatch_wall / len(chunk)
+            # live MFU/roofline series: one dict probe when nothing is
+            # ledgered, two gauge stores when capture is on
+            _obs.perf.publish_step(perf_fp, per_step)
             for (step_id, begin, feed, examples, wait_s), outs in zip(
                     chunk, outs_steps):
                 metrics = outs[:len(fetch_names)] if want_fetch else outs

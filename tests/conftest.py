@@ -105,3 +105,9 @@ def pytest_configure(config):
         'markers',
         'lint: tests running tools/lint_repo.py over the tree against '
         'its pinned allowlist (tier-1; filter with -m "not lint")')
+    config.addinivalue_line(
+        'markers',
+        'perfobs: tests of the performance observatory — per-program '
+        'cost/memory ledgers on the compile-miss path, MFU/roofline '
+        'math, the PerfBaseline regression sentinel, tools/'
+        'perf_report.py (tier-1; filter with -m "not perfobs")')

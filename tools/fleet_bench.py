@@ -433,9 +433,14 @@ def main(argv=None):
 
     jctx = observability.journal(journal_path) if journal_path \
         else None
+    _perf_prev = None
     try:
         if jctx is not None:
             jctx.__enter__()
+            # journalled runs also ledger every in-process replica
+            # compile (OBSERVABILITY.md "Performance observatory") so
+            # the perf smoke gate below has records to validate
+            _perf_prev = observability.perf.enable_capture(True)
         if args.smoke:
             fleet = run_fleet_chaos(
                 replicas=args.replicas, n_requests=96,
@@ -456,6 +461,7 @@ def main(argv=None):
                                  seed=3)
     finally:
         if jctx is not None:
+            observability.perf.enable_capture(_perf_prev)
             jctx.__exit__(None, None, None)
 
     problems = list(fleet['problems'])
@@ -469,6 +475,8 @@ def main(argv=None):
         # tracing rides the same journal: completed spans must exist,
         # and the kill phase must leave a reconstructable requeue tree
         problems += check_journal(journal_path, require='tracing')
+        # perf rides it too: every replica compile must have ledgered
+        problems += check_journal(journal_path, require='perf')
         if args.smoke and not args.no_kill:
             problems += check_requeue_trace(journal_path)
 

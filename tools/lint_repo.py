@@ -32,6 +32,14 @@ Rules (each encodes a convention the codebase actually relies on):
   which trace_report/obs_report then report as a crashed-looking
   unclosed span. The ``x = start_span(...) if cond else None`` idiom
   and cross-method handoffs (``slot.span = x``) are recognized.
+- ``direct-cost-analysis``: a ``.cost_analysis()`` call outside
+  ``paddle_tpu/observability/perf.py`` — XLA's cost model is read in
+  ONE place (the perf observatory, OBSERVABILITY.md "Performance
+  observatory") so key-spelling quirks (``'bytes accessed'``,
+  list-wrapped results) and roofline constants never fork. New callers
+  go through ``observability.perf`` (``capture_compiled`` /
+  ``program_ledger``); ``Executor.cost_analysis`` is the one pinned
+  legacy entry point.
 
 The embedded ``ALLOWLIST`` pins known, accepted occurrences (ratchet
 style): the tool exits nonzero only on violations NOT in the allowlist,
@@ -53,6 +61,10 @@ METRIC_FACTORIES = ('counter', 'histogram', 'gauge')
 # rule:path:detail -> accepted occurrences. Add entries ONLY with a
 # review note; the lint test pins this set.
 ALLOWLIST = frozenset({
+    # Executor.cost_analysis is the public pre-observatory API; its
+    # body is the single pinned direct reader outside perf.py
+    'direct-cost-analysis:paddle_tpu/executor.py:'
+    'comp.cost_analysis()',
 })
 
 
@@ -229,6 +241,13 @@ def lint_file(path, relpath):
                     'unguarded-emit', relpath, node.lineno,
                     '%s.emit() with no journal_active()/None guard '
                     '(use observability.emit)' % recv))
+            if node.func.attr == 'cost_analysis' \
+                    and relpath != os.path.join('paddle_tpu',
+                                                'observability',
+                                                'perf.py'):
+                out.append(Violation(
+                    'direct-cost-analysis', relpath, node.lineno,
+                    '%s.cost_analysis()' % recv))
             if node.func.attr in METRIC_FACTORIES and node.args \
                     and isinstance(node.args[0], ast.Constant) \
                     and isinstance(node.args[0].value, str):
@@ -311,8 +330,8 @@ def main(argv=None):
     if args.list:
         print('scope: %s' % ', '.join(SCOPE))
         print('rules: bare-except, lock-outside-with, unguarded-emit, '
-              'span-not-ended, dup-metric-name (across %s)'
-              % '/'.join(METRIC_PACKAGES))
+              'span-not-ended, direct-cost-analysis, dup-metric-name '
+              '(across %s)' % '/'.join(METRIC_PACKAGES))
         return 0
     violations = lint_tree()
     new = [v for v in violations if v.key() not in ALLOWLIST]

@@ -29,7 +29,9 @@ program verifier (``analysis`` records, ANALYSIS.md); ``--require
 tracing`` for a run that must hold completed distributed-tracing spans
 (``span_end`` records, OBSERVABILITY.md — unclosed spans never fail
 the gate; fault injection legitimately leaves them); ``--require
-any`` for presence only).
+perf`` for a run that must have captured per-program performance
+ledgers (``perf_ledger`` records, OBSERVABILITY.md "Performance
+observatory"); ``--require any`` for presence only).
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
 load run writes.
 """
@@ -65,6 +67,11 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # spans are NOT gated: fault injection legitimately
                # leaves them (a killed replica's in-flight work)
                'tracing': 'span_end',
+               # a perf-observed run must have ledgered at least one
+               # compiled program (cost/memory accounting captured on
+               # the Executor's compile-miss path — OBSERVABILITY.md
+               # "Performance observatory")
+               'perf': 'perf_ledger',
                'any': None}
 
 
@@ -381,6 +388,43 @@ def _tracing_summary(by_ev):
     }
 
 
+def _perf_summary(by_ev):
+    """Perf SLI (OBSERVABILITY.md "Performance observatory"):
+    per-program cost/memory ledgers from ``perf_ledger`` events. Seal
+    rows (compile-miss capture) and measured rows (phase=measured,
+    folded in once a step time lands) are merged per fingerprint."""
+    progs = {}
+    for r in by_ev.get('perf_ledger', ()):
+        cur = progs.setdefault(r.get('fp'), {})
+        cur.update({k: v for k, v in r.items()
+                    if k not in ('ev', 'run', 't', 'phase')
+                    and v is not None})
+    bounds = {}
+    for d in progs.values():
+        b = d.get('roofline')
+        if b:
+            bounds[b] = bounds.get(b, 0) + 1
+    return {
+        'programs': len(progs),
+        'live_bytes_total': sum(d.get('live_bytes') or 0
+                                for d in progs.values()),
+        'compile_wall_s': sum(d.get('compile_wall_s') or 0.0
+                              for d in progs.values()),
+        'roofline_bounds': bounds,
+        'by_program': {
+            (d.get('program') or (fp or '?')[:12]): {
+                'flops': d.get('flops'),
+                'bytes_accessed': d.get('bytes_accessed'),
+                'live_bytes': d.get('live_bytes'),
+                'mfu': d.get('mfu'),
+                'roofline': d.get('roofline'),
+                'measured_ms': d.get('measured_ms'),
+                'compile_wall_s': d.get('compile_wall_s'),
+                'mesh': d.get('mesh'),
+            } for fp, d in progs.items()},
+    }
+
+
 def summarize(records, malformed=0):
     """Aggregate a record list into a JSON-ready summary dict."""
     by_ev = {}
@@ -457,6 +501,7 @@ def summarize(records, malformed=0):
         'zero': _zero_summary(by_ev),
         'analysis': _analysis_summary(by_ev),
         'tracing': _tracing_summary(by_ev),
+        'perf': _perf_summary(by_ev),
         'slowest_spans': [
             {'ev': r['ev'], 't': r.get('t'), 'dur_s': r['dur_s'],
              'detail': {k: v for k, v in r.items()
@@ -654,6 +699,25 @@ def render(summary, top=10):
                                       k['max_s'] * 1e3))
         for p in tr.get('critical_paths', ())[:3]:
             lines.append('  path: %s' % p)
+    pf = s.get('perf') or {}
+    if pf.get('programs'):
+        bounds = ', '.join('%d %s-bound' % (n, b) for b, n in
+                           sorted(pf['roofline_bounds'].items()))
+        lines.append(
+            'perf:     %d program ledger(s) | live %.2f MB | compile '
+            '%.2fs%s' % (pf['programs'],
+                         pf['live_bytes_total'] / 1e6,
+                         pf['compile_wall_s'],
+                         (' | %s' % bounds) if bounds else ''))
+        for name, d in sorted(pf['by_program'].items(),
+                              key=lambda kv: -(kv[1]['flops'] or 0)):
+            mfu = d.get('mfu')
+            lines.append(
+                '  %-20s %10.3f MFLOP %8.2f MB  mfu=%s  %s'
+                % (name[:20], (d['flops'] or 0) / 1e6,
+                   (d['bytes_accessed'] or 0) / 1e6,
+                   '%.4f' % mfu if mfu is not None else '-',
+                   d.get('roofline') or '-'))
     if s['anomalies']:
         lines.append('anomaly:  %d guard trips' % s['anomalies'])
     lines.append('events:   %s' % ', '.join(

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Per-program performance report + regression sentinel
+(OBSERVABILITY.md "Performance observatory").
+
+Three modes:
+
+- ``--journal run.jsonl`` — render the perf section of a recorded run:
+  per-program flops, bytes accessed, MFU, roofline classification, HBM
+  live bytes and compile wall, straight from the ``perf_ledger``
+  events the Executor journals on every compile miss (stdlib parse; no
+  framework import).
+- ``--smoke`` — run the deterministic CPU perf workload (the tier-1
+  bench programs: an MLP train step and an FC inference step, built
+  under ``unique_name.guard()`` so fingerprints are stable across
+  processes), capture their ledgers through the live Executor path,
+  and print the report. With ``--baseline PERF_BASELINE.json`` the run
+  is DIFFED against the committed baseline and the process exits
+  nonzero on any regression, naming the program: deterministic fields
+  (flops, bytes) must match within 2%; timing fields (``step_ms``,
+  ``mfu``), when the baseline carries them, gate at ``--tol``.
+- ``--smoke --update-baseline PATH [--with-timings]`` — (re)write the
+  baseline from the current run. The committed repo baseline holds
+  deterministic fields only; ``--with-timings`` adds step_ms/MFU for
+  same-box comparisons (never commit timings from a CI box).
+
+    python tools/perf_report.py --journal run.jsonl
+    python tools/perf_report.py --smoke --baseline PERF_BASELINE.json
+    python tools/perf_report.py --smoke --update-baseline PERF_BASELINE.json
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), '..',
+                                'PERF_BASELINE.json')
+
+
+def _force_cpu():
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
+
+# ---- journal mode (stdlib-only) -------------------------------------------
+def journal_ledgers(path):
+    """Merge the ``perf_ledger`` events of a journal into one dict per
+    program fingerprint (seal row first, measured updates folded in)."""
+    progs = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get('ev') != 'perf_ledger':
+                continue
+            fp = rec.get('fp')
+            cur = progs.setdefault(fp, {})
+            cur.update({k: v for k, v in rec.items()
+                        if k not in ('ev', 'run', 't', 'phase')
+                        and v is not None})
+    return progs
+
+
+def render(progs, out=sys.stdout):
+    if not progs:
+        print('no perf_ledger events (is capture enabled? '
+              'PTPU_PERF=1 / observability.perf.enable_capture)',
+              file=out)
+        return
+    print('perf observatory: %d program(s)' % len(progs), file=out)
+    hdr = ('  %-12s %-10s %12s %12s %8s %10s %10s %9s'
+           % ('program', 'mesh', 'MFLOP', 'MB accessed', 'MFU',
+              'roofline', 'live MB', 'compile'))
+    print(hdr, file=out)
+    watermark = 0
+    for fp, d in sorted(progs.items(), key=lambda kv: -(
+            kv[1].get('flops') or 0)):
+        name = d.get('program') or (fp or '?')[:12]
+        mfu = d.get('mfu')
+        live = d.get('live_bytes') or 0
+        watermark += live
+        print('  %-12s %-10s %12.3f %12.2f %8s %10s %10.2f %8ss'
+              % (name[:12], d.get('mesh', '-'),
+                 (d.get('flops') or 0) / 1e6,
+                 (d.get('bytes_accessed') or 0) / 1e6,
+                 '%.4f' % mfu if mfu is not None else '-',
+                 d.get('roofline', '-'), live / 1e6,
+                 '%.2f' % d.get('compile_wall_s', 0.0)), file=out)
+    print('  HBM watermark (sum of live bytes): %.2f MB'
+          % (watermark / 1e6), file=out)
+
+
+# ---- smoke workload --------------------------------------------------------
+def _smoke_programs():
+    """The deterministic tier-1 bench programs. Built under
+    ``unique_name.guard()`` so variable names — and therefore program
+    fingerprints, the baseline key — are stable across processes."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    specs = []
+
+    # 1) MLP train step: fc-relu-fc-softmax + Adam, batch 16
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            img = fluid.layers.data(name='img', shape=[64],
+                                    dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            h = fluid.layers.fc(input=img, size=32, act='relu')
+            pred = fluid.layers.fc(input=h, size=10, act='softmax')
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(
+                input=pred, label=label))
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.randn(16, 64).astype('float32'),
+            'label': rng.randint(0, 10, (16, 1)).astype('int64')}
+    specs.append(('mlp_train', main, startup, feed, [loss]))
+
+    # 2) FC inference step, batch 32
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 12
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[64],
+                                  dtype='float32')
+            h = fluid.layers.fc(input=x, size=48, act='relu')
+            y = fluid.layers.fc(input=h, size=8, act=None)
+    feed = {'x': rng.randn(32, 64).astype('float32')}
+    specs.append(('fc_infer', main, startup, feed, [y]))
+    return specs
+
+
+def run_smoke(steps=8, with_timings=False):
+    """Compile + run the smoke programs through the live Executor
+    capture path. Returns ({baseline_key: entry}, [ProgramLedger])."""
+    _force_cpu()
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.observability import perf
+
+    current, captured = {}, []
+    with perf.capture_scope(True):
+        for name, main, startup, feed, fetches in _smoke_programs():
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                exe.run(main, feed=feed, fetch_list=fetches)  # compile
+                walls = []
+                for _ in range(steps):
+                    t0 = time.perf_counter()
+                    exe.run(main, feed=feed, fetch_list=fetches)
+                    walls.append(time.perf_counter() - t0)
+            fp = main.fingerprint()
+            ledger = perf.get_ledger(fp)
+            if ledger is None:
+                continue
+            ledger.label = name
+            walls.sort()
+            perf.publish_step(fp, walls[len(walls) // 2])
+            key = perf.PerfBaseline.key(ledger.fingerprint,
+                                        ledger.shape_sig,
+                                        ledger.backend, ledger.mesh)
+            current[key] = perf.PerfBaseline.entry_from_ledger(
+                ledger, with_timings=with_timings)
+            captured.append(ledger)
+    return current, captured
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='per-program perf report + regression sentinel')
+    ap.add_argument('--journal', help='render a recorded journal')
+    ap.add_argument('--smoke', action='store_true',
+                    help='run the deterministic CPU perf workload')
+    ap.add_argument('--baseline', nargs='?', const=DEFAULT_BASELINE,
+                    help='diff the smoke run against this baseline '
+                         '(default: repo PERF_BASELINE.json); exits '
+                         'nonzero on regression')
+    ap.add_argument('--update-baseline', metavar='PATH',
+                    help='write the smoke run as the new baseline')
+    ap.add_argument('--with-timings', action='store_true',
+                    help='include step_ms/mfu in baseline entries '
+                         '(same-box comparisons only)')
+    ap.add_argument('--tol', type=float, default=0.25,
+                    help='relative tolerance for step-time/MFU '
+                         'regressions (default 0.25)')
+    ap.add_argument('--steps', type=int, default=8,
+                    help='timed steps per smoke program')
+    ap.add_argument('--json', action='store_true',
+                    help='emit machine-readable JSON instead of text')
+    args = ap.parse_args(argv)
+
+    if args.journal:
+        progs = journal_ledgers(args.journal)
+        if args.json:
+            print(json.dumps(progs, indent=1, sort_keys=True))
+        else:
+            render(progs)
+        return 0
+
+    if not args.smoke:
+        ap.error('one of --journal or --smoke is required')
+
+    from paddle_tpu.observability import perf
+    timings = args.with_timings or bool(args.baseline)
+    current, captured = run_smoke(steps=args.steps,
+                                  with_timings=timings)
+    if not captured:
+        print('FAIL: smoke workload captured no ledgers',
+              file=sys.stderr)
+        return 1
+    progs = {l.fingerprint: l.as_dict() for l in captured}
+    if args.json:
+        print(json.dumps({'programs': progs, 'entries': current},
+                         indent=1, sort_keys=True))
+    else:
+        render(progs)
+
+    if args.update_baseline:
+        base = perf.PerfBaseline(args.update_baseline)
+        for key, entry in current.items():
+            if not args.with_timings:
+                entry = {k: v for k, v in entry.items()
+                         if k not in ('step_ms', 'mfu')}
+            base.put(key, entry)
+        base.save()
+        print('baseline written: %s (%d entries)'
+              % (args.update_baseline, len(base.entries)))
+        return 0
+
+    if args.baseline:
+        base = perf.PerfBaseline(args.baseline).load()
+        if not base.entries:
+            print('FAIL: baseline %s missing or empty' % args.baseline,
+                  file=sys.stderr)
+            return 1
+        problems = base.diff(current, tol=args.tol)
+        if problems:
+            print('PERF REGRESSION (%d problem(s) vs %s):'
+                  % (len(problems), args.baseline), file=sys.stderr)
+            for p in problems:
+                print('  - %s' % p, file=sys.stderr)
+            return 1
+        print('perf baseline OK (%d program(s) vs %s)'
+              % (len(base.entries), args.baseline))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

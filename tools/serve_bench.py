@@ -282,6 +282,10 @@ def main(argv=None):
         # every smoke request is traced end to end; an empty span set
         # means the serving pipeline lost its tracing wiring
         problems += check_journal(journal_path, require='tracing')
+        # warmup ledgers every per-bucket compile when a journal is
+        # active (OBSERVABILITY.md "Performance observatory"); zero
+        # perf_ledger records means the capture path regressed
+        problems += check_journal(journal_path, require='perf')
     if problems:
         print('SMOKE REGRESSION:', file=sys.stderr)
         for p in problems:
