@@ -37,6 +37,33 @@ __all__ = ['Executor', 'CacheInfo', 'global_scope', 'scope_guard',
 
 CacheInfo = collections.namedtuple('CacheInfo', ['hits', 'misses', 'size'])
 
+def _coldstart_store():
+    """The active AOT cold-start store (SERVING.md "Self-driving
+    fleet"), or None when the ``PTPU_AOT_CACHE`` gate is closed. The
+    fleet package imports serving which imports this module, so the
+    reach into fleet.coldstart must be lazy (run time, import cycle
+    safe) — and when the gate is closed and the module was never
+    imported (no ``cache_scope`` override can exist), one env check
+    answers without importing the fleet tier at all."""
+    import os
+    import sys
+    mod = sys.modules.get('paddle_tpu.fleet.coldstart')
+    if mod is None:
+        if not os.environ.get('PTPU_AOT_CACHE'):
+            return None
+        from .fleet import coldstart as mod
+    return mod.default_store()
+
+
+def _mesh_committed(v):
+    """True for a jax.Array committed to more than one device. An
+    unsharded dispatch can still see such args when the scope is shared
+    with a sharded Executor (partition parity tests do exactly this);
+    a single-device sealed executable would refuse them at call time,
+    so the seal path must detect and stand down to lazy jit."""
+    s = getattr(v, 'sharding', None)
+    return s is not None and len(getattr(s, 'device_set', ())) > 1
+
 
 class VarBinding(object):
     """Live handle to a scope slot. Parity: the runtime ``Variable``
@@ -798,8 +825,32 @@ class Executor(object):
                 state_s = part.state_shardings(program, state_in_names)
             if sharded and (entry is None or part.multiprocess):
                 feeds_s = part.feed_shardings(feed)
+            aot_store = aot_token = None
+            aot_hit = False
             if entry is None:
                 self._cache_misses += 1
+                if not (profiling or dynamic or guard) \
+                        and not (sharded and part.multiprocess):
+                    aot_store = _coldstart_store()
+                if aot_store is not None:
+                    aot_token = dict(
+                        backend=jax.default_backend(),
+                        device_kind=getattr(self.place.jax_device(),
+                                            'device_kind', ''),
+                        devices=part.device_count if sharded else 1,
+                        mesh=_perf.mesh_signature(
+                            part.describe() if sharded else None))
+                    loaded = aot_store.load(key, **aot_token)
+                    if loaded is not None:
+                        # AOT warm start (fleet/coldstart.py): the
+                        # persisted executable replaces lowering AND
+                        # the XLA compile. Safe to skip the static
+                        # verify: the key embeds the program
+                        # fingerprint + pass/partition tokens, so the
+                        # entry was verified when first built.
+                        jitted = self._cache[key] = loaded
+                        aot_hit = True
+            if entry is None and not aot_hit:
                 if not dynamic:
                     # static verify BEFORE any lowering: a mis-wired
                     # program raises typed ProgramInvalid naming the
@@ -860,7 +911,7 @@ class Executor(object):
                     jitted = part.partition(fn, donate_argnums=(1,))
                 jitted = self._apply_tuning(key, jitted)
                 self._cache[key] = jitted
-            else:
+            elif entry is not None:
                 self._cache_hits += 1
                 jitted = entry
         was_miss = entry is None
@@ -878,7 +929,7 @@ class Executor(object):
             state = part.reconcile_state(state, state_s)
 
         _ledger = None
-        if was_miss and not (profiling or dynamic) \
+        if was_miss and not aot_hit and not (profiling or dynamic) \
                 and not (sharded and part.multiprocess) \
                 and _perf.capture_enabled():
             # perf observatory (OBSERVABILITY.md): ledger the program's
@@ -897,6 +948,37 @@ class Executor(object):
                         part.describe() if sharded else None),
                     devices=part.device_count if sharded else 1)
 
+        if was_miss and not aot_hit and aot_store is not None:
+            # seal the fresh compilation into the cold-start store:
+            # one eager AOT lower().compile() now (jit would have
+            # compiled lazily on the dispatch below anyway),
+            # serialized for the next replica's warmup; the dispatch
+            # uses the Compiled directly so the compile happens once.
+            # A non-lowerable callable (tuning-wrapped) returns None
+            # and stays on the lazy path.
+            with part.run_context() if sharded else \
+                    jax.default_device(self.place.jax_device()):
+                try:
+                    if not sharded and (
+                            any(map(_mesh_committed, feed.values()))
+                            or any(map(_mesh_committed,
+                                       state.values()))):
+                        compiled = None
+                    else:
+                        compiled = aot_store.aot_compile(
+                            jitted, feed, state,
+                            shardings=(feeds_s, state_s) if sharded
+                            else None)
+                except Exception:  # noqa: BLE001 — persistence is an
+                    # optimization; lazy jit still serves the request
+                    aot_store.m_failures.inc()
+                    compiled = None
+            if compiled is not None:
+                aot_store.save(key, compiled, **aot_token)
+                jitted = compiled
+                with self._cache_lock:
+                    self._cache[key] = compiled
+
         t_run = time.perf_counter()
         with part.run_context() if sharded else \
                 jax.default_device(self.place.jax_device()):
@@ -910,9 +992,11 @@ class Executor(object):
         self._m_run.observe(run_wall)
         h, m = self._m_hits.value, self._m_misses.value
         self._m_hit_rate.set(h / (h + m) if h + m else 0.0)
-        if was_miss:
+        if was_miss and not aot_hit:
             # jax.jit compiles lazily at the first call, so the real
             # XLA compile wall is lookup -> end of this first execution
+            # (an AOT warm start never compiled: its wall lives in
+            # coldstart_load_seconds / the 'coldstart' journal event)
             compile_wall = time.perf_counter() - t_lookup
             self._m_compile.observe(compile_wall)
             _obs.emit('compile_end', fp=key[0],

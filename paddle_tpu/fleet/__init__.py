@@ -19,24 +19,44 @@ continuous batching"):
   batch at step boundaries and finished slots retire immediately, so
   occupancy stays high under ragged sequence lengths instead of
   stop-and-wait batching to the slowest sequence.
+- :mod:`~paddle_tpu.fleet.autoscaler` — :class:`Autoscaler`: the
+  sense -> act loop (SERVING.md "Self-driving fleet"); scales the
+  fleet from live queue/shed/SLO signals with hysteresis, cooldowns
+  and min/max bounds, consulting the ledger-informed
+  :class:`~paddle_tpu.fleet.router.PlacementBudget` before every
+  scale-in.
+- :mod:`~paddle_tpu.fleet.coldstart` — the ``PTPU_AOT_CACHE`` AOT
+  executable store: compile-misses persist serialized executables so
+  a fresh replica's warmup deserializes in milliseconds instead of
+  recompiling.
 - :mod:`~paddle_tpu.fleet.errors` — typed fleet failures
-  (:class:`NoHealthyReplica`, :class:`RequeueExhausted`), all
+  (:class:`NoHealthyReplica`, :class:`RequeueExhausted`,
+  :class:`PlacementInfeasible`, :class:`ReplicaRetired`), all
   :class:`~paddle_tpu.serving.errors.ServingError` subclasses.
 
 Gate: ``tools/fleet_bench.py --replicas 3 --smoke`` (replica killed
 mid-load, zero dropped/untyped futures, p99 SLO held, bit-identical
-recovery, continuous decode exact + faster than stop-and-wait).
+recovery, continuous decode exact + faster than stop-and-wait,
+traffic-ramp scale-up within window, warm AOT cold start measurably
+faster than compiling).
 """
-from .errors import FleetError, NoHealthyReplica, RequeueExhausted  # noqa
-from .router import (Router, RoutedRequest, ACTIVE, QUARANTINED,  # noqa
-                     DEPLOYING, RESTARTING, DEAD, STATE_CODES)
+from .errors import (FleetError, NoHealthyReplica,  # noqa
+                     PlacementInfeasible, ReplicaRetired,
+                     RequeueExhausted)
+from .router import (Router, RoutedRequest, PlacementBudget,  # noqa
+                     ACTIVE, QUARANTINED, DEPLOYING, RESTARTING,
+                     DEAD, STATE_CODES)
 from .supervisor import ReplicaSupervisor  # noqa
+from .autoscaler import Autoscaler  # noqa
 from .decode import (DecodeEngine, DecodeRequest,  # noqa
                      recurrent_fc_cell, attention_history_cell)
+from . import coldstart  # noqa
 
 __all__ = [
-    'FleetError', 'NoHealthyReplica', 'RequeueExhausted',
-    'Router', 'RoutedRequest', 'ReplicaSupervisor',
+    'FleetError', 'NoHealthyReplica', 'PlacementInfeasible',
+    'ReplicaRetired', 'RequeueExhausted',
+    'Router', 'RoutedRequest', 'PlacementBudget', 'ReplicaSupervisor',
+    'Autoscaler', 'coldstart',
     'ACTIVE', 'QUARANTINED', 'DEPLOYING', 'RESTARTING', 'DEAD',
     'STATE_CODES',
     'DecodeEngine', 'DecodeRequest', 'recurrent_fc_cell',

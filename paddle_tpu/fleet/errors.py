@@ -8,7 +8,8 @@ failures when it moves from one server to a fleet.
 """
 from ..serving.errors import ServingError
 
-__all__ = ['FleetError', 'NoHealthyReplica', 'RequeueExhausted']
+__all__ = ['FleetError', 'NoHealthyReplica', 'PlacementInfeasible',
+           'ReplicaRetired', 'RequeueExhausted']
 
 
 class FleetError(ServingError):
@@ -20,6 +21,33 @@ class NoHealthyReplica(FleetError):
     draining — the router has nowhere to send the request. Clients
     should back off; the supervisor is restarting/probing replicas in
     the background."""
+
+
+class PlacementInfeasible(FleetError):
+    """Admitting the model onto a replica would exceed a placement
+    budget (SERVING.md "Self-driving fleet"): the error names the
+    budget dimension it would blow (``'hbm_bytes'`` or ``'mfu'``),
+    the offending replica, the model's ledgered demand and the
+    replica's current usage — raised at load time instead of OOMing
+    or saturating the roofline at serve time."""
+
+    def __init__(self, message, budget=None, replica=None, model=None,
+                 demand=None, limit=None, usage=None):
+        super(PlacementInfeasible, self).__init__(message)
+        self.budget = budget      # 'hbm_bytes' | 'mfu'
+        self.replica = replica
+        self.model = model
+        self.demand = demand
+        self.limit = limit
+        self.usage = usage
+
+
+class ReplicaRetired(FleetError):
+    """The replica was retired (scale-in) — it no longer exists in
+    the router, so restart/route/kill attempts against its id are
+    refused typed instead of resurrecting a retired id. The
+    supervisor treats this as 'drop tracking', never as a restart
+    failure to back off on (single ownership handoff)."""
 
 
 class RequeueExhausted(FleetError):

@@ -44,10 +44,12 @@ from .. import observability as _obs
 from ..serving.errors import (DeadlineExceeded, ModelNotFound,
                               ServerClosed, ServerOverloaded,
                               ServingError, WatchdogTimeout)
-from .errors import NoHealthyReplica, RequeueExhausted
+from .errors import (NoHealthyReplica, PlacementInfeasible,
+                     ReplicaRetired, RequeueExhausted)
 
-__all__ = ['Router', 'RoutedRequest', 'ACTIVE', 'QUARANTINED',
-           'DEPLOYING', 'RESTARTING', 'DEAD', 'STATE_CODES']
+__all__ = ['Router', 'RoutedRequest', 'PlacementBudget', 'ACTIVE',
+           'QUARANTINED', 'DEPLOYING', 'RESTARTING', 'DEAD',
+           'STATE_CODES']
 
 logger = logging.getLogger('paddle_tpu.fleet')
 
@@ -67,6 +69,77 @@ REQUEUEABLE = (ServerClosed, WatchdogTimeout)
 
 def _ring_hash(key):
     return zlib.crc32(str(key).encode('utf-8')) & 0xffffffff
+
+
+class PlacementBudget(object):
+    """Ledger-informed per-replica admission budget (SERVING.md
+    "Self-driving fleet").
+
+    ``hbm_bytes`` caps the summed live-byte demand (arguments +
+    outputs + temps, the perf observatory's ``live_bytes``) of the
+    models placed on any one replica; ``mfu_capacity`` caps their
+    summed measured MFU fractions (roofline headroom). A model's
+    demand comes from explicit ``hbm_bytes=`` / ``mfu=`` hints on
+    ``load_model``/``register_model``, else from the
+    :class:`~paddle_tpu.observability.perf.LedgerBook` entries of its
+    declared program ``fingerprints`` (max over shape buckets). A
+    model with no hints and no ledgers has zero demand — the budget
+    only ever constrains what the observatory has measured or the
+    operator has declared.
+    """
+
+    def __init__(self, hbm_bytes=None, mfu_capacity=None, book=None):
+        self.hbm_bytes = hbm_bytes
+        self.mfu_capacity = mfu_capacity
+        self._book = book
+
+    def _ledgers(self):
+        if self._book is not None:
+            return self._book
+        from ..observability import perf as _perf
+        return _perf.book()
+
+    def demand(self, rec):
+        """``(hbm_bytes, mfu)`` demand of one placement record."""
+        hbm, mfu = rec.get('hbm_bytes'), rec.get('mfu')
+        if hbm is None or mfu is None:
+            book = self._ledgers()
+            led_hbm = led_mfu = 0.0
+            for fp in rec.get('fingerprints') or ():
+                led = book.get(fp)
+                if led is None:
+                    continue
+                led_hbm = max(led_hbm, float(led.live_bytes))
+                m = led.mfu()
+                if m:
+                    led_mfu = max(led_mfu, float(m))
+            if hbm is None:
+                hbm = led_hbm
+            if mfu is None:
+                mfu = led_mfu
+        return float(hbm or 0.0), float(mfu or 0.0)
+
+    def check(self, name, rec, rid, usage_hbm, usage_mfu):
+        """Raise :class:`PlacementInfeasible` (naming the exceeded
+        budget) when adding ``name`` to a replica already using
+        ``usage_*`` would blow a limit."""
+        d_hbm, d_mfu = self.demand(rec)
+        if self.hbm_bytes is not None and d_hbm and \
+                usage_hbm + d_hbm > self.hbm_bytes:
+            raise PlacementInfeasible(
+                'placing model %r on replica %s exceeds the hbm_bytes '
+                'budget: demand %d + in-use %d > budget %d bytes'
+                % (name, rid, d_hbm, usage_hbm, self.hbm_bytes),
+                budget='hbm_bytes', replica=rid, model=name,
+                demand=d_hbm, limit=self.hbm_bytes, usage=usage_hbm)
+        if self.mfu_capacity is not None and d_mfu and \
+                usage_mfu + d_mfu > self.mfu_capacity:
+            raise PlacementInfeasible(
+                'placing model %r on replica %s exceeds the mfu '
+                'budget: demand %.4f + in-use %.4f > capacity %.4f'
+                % (name, rid, d_mfu, usage_mfu, self.mfu_capacity),
+                budget='mfu', replica=rid, model=name, demand=d_mfu,
+                limit=self.mfu_capacity, usage=usage_mfu)
 
 
 class _Replica(object):
@@ -229,12 +302,17 @@ class Router(object):
     wedge_restart_after : int
         Consecutive unhealthy supervisor polls before a quarantined
         replica is force-restarted instead of waiting it out.
+    placement_budget : PlacementBudget, optional
+        Ledger-informed admission gate: model loads that would push a
+        replica past its HBM or MFU budget raise a typed
+        :class:`~paddle_tpu.fleet.errors.PlacementInfeasible` instead
+        of OOMing at serve time.
     """
 
     def __init__(self, factory, replicas=2, replication=None,
                  supervise=True, poll_interval=0.2, max_requeues=None,
                  requeue_wait=5.0, warmup_on_load=True,
-                 wedge_restart_after=20):
+                 wedge_restart_after=20, placement_budget=None):
         if replicas < 1:
             raise ValueError('replicas must be >= 1')
         if replication is not None and \
@@ -248,8 +326,11 @@ class Router(object):
         self.requeue_wait = requeue_wait
         self.warmup_on_load = warmup_on_load
         self.wedge_restart_after = wedge_restart_after
+        self.placement_budget = placement_budget
         self._lock = threading.RLock()
         self._placements = {}        # model -> placement record
+        self._next_rid = replicas    # ids are never reused (scale-out)
+        self._retired = set()        # ids retired by scale-in
         self._closed = False
         reg = _obs.default_registry()
         self._m_requeued = reg.counter(
@@ -297,56 +378,95 @@ class Router(object):
         return c
 
     # ---- placement -------------------------------------------------------
-    def _place_ids(self, name):
+    def _place_ids(self, name, ids=None):
         """Deterministic ring placement: ``replication`` consecutive
         replica ids starting at hash(name) — the same model name lands
-        on the same replicas every time (sticky placement)."""
-        ids = sorted(self._replicas)
-        k = self.replication or len(ids)
+        on the same replicas every time (sticky placement) for a given
+        replica set; scale-out/scale-in re-derives the ring over the
+        new set (:meth:`_rebalance`). ``ids`` overrides the live set
+        for what-if simulation (:meth:`can_retire`)."""
+        if ids is None:
+            ids = sorted(self._replicas)
+        k = min(self.replication or len(ids), len(ids))
         start = _ring_hash(name) % len(ids)
         return [ids[(start + i) % len(ids)] for i in range(k)]
 
+    def _check_admission(self, name, rec, rids, assignment=None):
+        """Budget gate (under the router lock): raise typed
+        :class:`PlacementInfeasible` when placing ``name`` on any of
+        ``rids`` would exceed the per-replica budget given the other
+        models' demands. ``assignment`` (model -> ids) overrides the
+        live placements for what-if simulation."""
+        budget = self.placement_budget
+        if budget is None:
+            return
+        if assignment is None:
+            assignment = {n: r['ids'] for n, r in
+                          self._placements.items()}
+        for rid in rids:
+            usage_hbm = usage_mfu = 0.0
+            for other, orec in self._placements.items():
+                if other == name or rid not in assignment.get(other, ()):
+                    continue
+                oh, om = budget.demand(orec)
+                usage_hbm += oh
+                usage_mfu += om
+            budget.check(name, rec, rid, usage_hbm, usage_mfu)
+
     def load_model(self, name, dirname, model_filename=None,
-                   params_filename=None, warmup=None):
+                   params_filename=None, warmup=None, hbm_bytes=None,
+                   mfu=None, fingerprints=()):
         """Place + load a ``save_inference_model`` artifact on the
         model's replica ring. Dead/restarting replicas are skipped —
-        the restart replay loads the recorded artifact into them."""
-        with self._lock:
-            if self._closed:
-                raise ServerClosed('router is shut down')
-            ids = self._place_ids(name)
-            self._placements[name] = {
-                'kind': 'artifact', 'dirname': dirname,
-                'model_filename': model_filename,
-                'params_filename': params_filename, 'ids': ids,
-                'warmup': self.warmup_on_load if warmup is None
-                else warmup}
-            reps = [self._replicas[rid] for rid in ids]
-        for rep in reps:
-            if rep.state in (DEAD, RESTARTING):
-                continue
-            self._load_into(rep.server, name, self._placements[name])
-        _obs.emit('fleet', action='load', model=name, replicas=ids)
-        return ids
+        the restart replay loads the recorded artifact into them.
+        ``hbm_bytes``/``mfu`` declare the model's resource demand for
+        the placement budget; ``fingerprints`` instead derives it from
+        the perf observatory's ledgers for those programs."""
+        rec = {'kind': 'artifact', 'dirname': dirname,
+               'model_filename': model_filename,
+               'params_filename': params_filename,
+               'warmup': self.warmup_on_load if warmup is None
+               else warmup, 'hbm_bytes': hbm_bytes, 'mfu': mfu,
+               'fingerprints': tuple(fingerprints)}
+        return self._place(name, rec)
 
-    def register_model(self, name, builder, warmup=None):
+    def register_model(self, name, builder, warmup=None,
+                       hbm_bytes=None, mfu=None, fingerprints=()):
         """Place an in-memory model: ``builder()`` must return a fresh
         ``(program, feed_names, fetch_vars, scope)`` tuple per call —
         each replica (and each restart) gets its own scope, because
         server workers donate their scope's buffers."""
+        rec = {'kind': 'builder', 'builder': builder,
+               'warmup': self.warmup_on_load if warmup is None
+               else warmup, 'hbm_bytes': hbm_bytes, 'mfu': mfu,
+               'fingerprints': tuple(fingerprints)}
+        return self._place(name, rec)
+
+    def _place(self, name, rec):
+        """Shared placement commit: ring + budget check under the
+        lock, then the (slow) model loads outside it."""
         with self._lock:
             if self._closed:
                 raise ServerClosed('router is shut down')
             ids = self._place_ids(name)
-            self._placements[name] = {
-                'kind': 'builder', 'builder': builder, 'ids': ids,
-                'warmup': self.warmup_on_load if warmup is None
-                else warmup}
+            # budget admission BEFORE committing the record: an
+            # infeasible model must leave no trace (typed error, no
+            # partial placement, no OOM at serve time)
+            try:
+                self._check_admission(name, rec, ids)
+            except PlacementInfeasible as e:
+                _obs.emit('fleet', action='placement_infeasible',
+                          model=name, budget=e.budget,
+                          replica=e.replica, demand=e.demand,
+                          limit=e.limit, usage=e.usage)
+                raise
+            rec['ids'] = ids
+            self._placements[name] = rec
             reps = [self._replicas[rid] for rid in ids]
         for rep in reps:
             if rep.state in (DEAD, RESTARTING):
                 continue
-            self._load_into(rep.server, name, self._placements[name])
+            self._load_into(rep.server, name, rec)
         _obs.emit('fleet', action='load', model=name, replicas=ids)
         return ids
 
@@ -393,9 +513,10 @@ class Router(object):
                 raise ModelNotFound('no model placed as %r (have: %s)'
                                     % (name, sorted(self._placements)
                                        or '-'))
-            reps = [self._replicas[rid] for rid in rec['ids']
-                    if rid not in excluded and
-                    self._replicas[rid].state == ACTIVE]
+            reps = [rep for rep in
+                    (self._replicas.get(rid) for rid in rec['ids']
+                     if rid not in excluded)
+                    if rep is not None and rep.state == ACTIVE]
         scored = []
         for rep in reps:
             try:
@@ -538,7 +659,15 @@ class Router(object):
         with self._lock:
             if self._closed:
                 raise ServerClosed('router is shut down')
-            rep = self._replicas[rid]
+            rep = self._replicas.get(rid)
+            if rep is None:
+                # single ownership handoff: a replica the autoscaler
+                # retired no longer exists — the supervisor must drop
+                # it, never resurrect it
+                raise ReplicaRetired(
+                    'replica %d was retired%s — refusing restart'
+                    % (rid, '' if rid in self._retired
+                       else ' or never existed'))
             if rep.state == RESTARTING:
                 return rep
             old_server = rep.server
@@ -574,13 +703,177 @@ class Router(object):
         (ServerClosed) and clients requeue; the supervisor restarts
         it."""
         with self._lock:
-            rep = self._replicas[rid]
+            rep = self._replicas.get(rid)
+            if rep is None:
+                raise ReplicaRetired(
+                    'replica %d was retired — nothing to kill' % rid)
         _obs.emit('fleet', action='kill', replica=rid, abrupt=abrupt)
         try:
             rep.server.close(timeout=0.0 if abrupt else 30.0)
         finally:
             self._set_state(rep, DEAD, reason='killed')
         return rep
+
+    # ---- elastic fleet (autoscaler actuators) ----------------------------
+    def add_replica(self):
+        """Scale-out: build a fresh replica from the factory (a never
+        reused id), rebalance every placement ring over the grown
+        fleet and replay model loads onto the newcomer. With the AOT
+        cold-start cache enabled (fleet/coldstart.py) the replay's
+        warmup deserializes executables instead of recompiling, so the
+        new replica serves within milliseconds of the factory
+        returning. Returns the new replica id."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('router is shut down')
+            rid = self._next_rid
+            self._next_rid += 1
+        t0 = time.monotonic()
+        server = self.factory(rid)     # slow: outside the lock
+        with self._lock:
+            self._replicas[rid] = _Replica(rid, server)
+        self._publish_state(rid, ACTIVE)
+        self._rebalance(reason='scale-out replica %d' % rid)
+        _obs.emit('fleet', action='scale_up', replica=rid,
+                  replicas=sorted(self._replicas),
+                  dur_s=round(time.monotonic() - t0, 6))
+        return rid
+
+    def retire_replica(self, rid, timeout=5.0):
+        """Scale-in: permanently remove a replica — the single
+        ownership handoff. Under one lock hold the id leaves the
+        routing set, every placement ring and the supervisor's world;
+        then the survivors' rings rebalance (model loads replayed so
+        no sticky key strands on the retired id) and the old server
+        closes with a bounded drain — its in-flight requests fail
+        typed (ServerClosed) and requeue onto survivors. Per-replica
+        telemetry series are retired so dashboards agree with
+        ``health()``. Raises :class:`ReplicaRetired` for an id that
+        is already gone."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed('router is shut down')
+            rep = self._replicas.get(rid)
+            if rep is None:
+                raise ReplicaRetired(
+                    'replica %d is already retired' % rid)
+            floor = max(1, self.replication or 1)
+            if len(self._replicas) <= floor:
+                raise ValueError(
+                    'cannot retire replica %d: %d replica(s) is the '
+                    'floor for replication=%s'
+                    % (rid, floor, self.replication))
+            del self._replicas[rid]
+            self._retired.add(rid)
+            # strip the id from every ring NOW (same lock hold):
+            # routing between this point and the rebalance below must
+            # never resolve to the retired replica
+            for rec in self._placements.values():
+                if rid in rec['ids']:
+                    rec['ids'] = [i for i in rec['ids'] if i != rid]
+        _obs.emit('fleet', action='retire', replica=rid,
+                  replicas=sorted(self._replicas))
+        self._rebalance(reason='scale-in replica %d' % rid)
+        try:
+            rep.server.close(timeout=timeout)
+        except Exception:  # noqa: BLE001 — survivors keep serving
+            logger.exception('closing retired replica %d failed', rid)
+        reg = _obs.default_registry()
+        reg.remove('fleet_replica_state', replica=str(rid))
+        reg.remove('router_routed_total', replica=str(rid))
+        with self._lock:
+            self._m_routed.pop(rid, None)
+        return rid
+
+    def can_retire(self, rid):
+        """``(ok, reason)``: would retiring ``rid`` keep every
+        placement routable and inside the placement budget on the
+        survivors? The autoscaler asks before every scale-in so a
+        fleet that cannot absorb its models never shrinks into an
+        infeasible state."""
+        with self._lock:
+            if rid not in self._replicas:
+                return False, 'replica %d already retired' % rid
+            floor = max(1, self.replication or 1)
+            if len(self._replicas) <= floor:
+                return False, ('%d replica(s) is the floor for '
+                               'replication=%s'
+                               % (floor, self.replication))
+            if self.placement_budget is not None:
+                survivors = sorted(i for i in self._replicas
+                                   if i != rid)
+                sim = {n: self._place_ids(n, ids=survivors)
+                       for n in self._placements}
+                for name, rec in self._placements.items():
+                    added = [i for i in sim[name]
+                             if i not in rec['ids']]
+                    try:
+                        self._check_admission(name, rec, added,
+                                              assignment=sim)
+                    except PlacementInfeasible as e:
+                        return False, str(e)
+        return True, ''
+
+    def _rebalance(self, reason=''):
+        """Recompute every placement ring over the current replica set
+        and converge the servers: newly ringed replicas get the model
+        loaded (replayed + warmed), replicas leaving a ring drain it.
+        A placement the budget refuses on its new ring keeps its
+        surviving old replicas instead (journalled) — rebalance
+        degrades, it never OOMs. Sticky keys hash over the ring, so
+        they re-spread onto live replicas automatically."""
+        plan = []
+        with self._lock:
+            if not self._replicas:
+                return
+            for name, rec in sorted(self._placements.items()):
+                old_ids = list(rec['ids'])
+                new_ids = self._place_ids(name)
+                if new_ids == old_ids:
+                    continue
+                added = [i for i in new_ids if i not in old_ids]
+                try:
+                    self._check_admission(name, rec, added)
+                except PlacementInfeasible as e:
+                    _obs.emit('fleet', action='placement_infeasible',
+                              model=name, budget=e.budget,
+                              replica=e.replica, during='rebalance')
+                    logger.warning('rebalance: %s', e)
+                    continue
+                rec['ids'] = new_ids
+                removed = [i for i in old_ids if i not in new_ids]
+                plan.append((name, dict(rec), added, removed))
+        for name, rec, added, removed in plan:
+            for rid in added:
+                with self._lock:
+                    rep = self._replicas.get(rid)
+                if rep is None or rep.state in (DEAD, RESTARTING):
+                    continue   # the restart replay uses the record
+                try:
+                    self._load_into(rep.server, name, rec)
+                except Exception as e:  # noqa: BLE001 — a replica that
+                    # cannot take the load is a replica-health problem,
+                    # not a rebalance-stopping one
+                    logger.exception(
+                        'rebalance: loading %r onto replica %d failed',
+                        name, rid)
+                    self._note_replica_error(rid, e)
+            for rid in removed:
+                with self._lock:
+                    rep = self._replicas.get(rid)
+                if rep is None or rep.state in (DEAD, RESTARTING):
+                    continue
+                try:
+                    rep.server.drain(name, timeout=self.requeue_wait)
+                except ModelNotFound:
+                    pass
+                except Exception:  # noqa: BLE001 — best-effort unload
+                    logger.exception(
+                        'rebalance: draining %r off replica %d failed',
+                        name, rid)
+            _obs.emit('fleet', action='rebalance', model=name,
+                      replicas=rec['ids'], added=added,
+                      removed=removed, reason=reason)
 
     # ---- fleet-wide ops --------------------------------------------------
     def rolling_swap(self, name, dirname, model_filename=None,

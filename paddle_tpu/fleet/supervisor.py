@@ -21,6 +21,7 @@ import threading
 import time
 
 from .. import observability as _obs
+from .errors import ReplicaRetired
 from .router import ACTIVE, DEAD, QUARANTINED
 
 __all__ = ['ReplicaSupervisor']
@@ -78,14 +79,31 @@ class ReplicaSupervisor(object):
             if self._stop.is_set():
                 break
             with router._lock:
+                # single ownership handoff: a replica the autoscaler
+                # retired mid-scan (or swapped for a new generation)
+                # is no longer the supervisor's to restart — drop all
+                # tracking instead of fighting over it
+                if router._replicas.get(rep.id) is not rep:
+                    self._forget(rep.id)
+                    continue
                 state = rep.state
             if state == DEAD:
                 states[rep.id] = self._try_restart(rep)
             elif state in (ACTIVE, QUARANTINED):
                 states[rep.id] = router.check_replica(rep)
+                if states[rep.id] == ACTIVE:
+                    # a replica that recovered on its own (breaker
+                    # re-closed, worker unwedged) resets the restart
+                    # backoff: the next failure is a fresh incident,
+                    # not attempt N+1 of the old one
+                    self._forget(rep.id)
             else:
                 states[rep.id] = state      # deploying / restarting
         return states
+
+    def _forget(self, rid):
+        self._failures.pop(rid, None)
+        self._next_attempt.pop(rid, None)
 
     def _try_restart(self, rep):
         now = time.monotonic()
@@ -93,6 +111,11 @@ class ReplicaSupervisor(object):
             return DEAD
         try:
             self.router.restart_replica(rep.id)
+        except ReplicaRetired:
+            # scale-in won the race: the id is gone for good — not a
+            # failure to back off on, just the end of ownership
+            self._forget(rep.id)
+            return DEAD
         except Exception as e:  # noqa: BLE001 — restart is retried
             fails = self._failures.get(rep.id, 0) + 1
             self._failures[rep.id] = fails

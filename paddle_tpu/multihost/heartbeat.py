@@ -93,6 +93,7 @@ class HostMonitor(object):
             'multihost_peers',
             'hosts currently inside the heartbeat window')
         self._reg = reg
+        self._published = set()   # host ids with a live age gauge
 
     def ages(self, now=None):
         """host id -> heartbeat age in seconds, for every host file
@@ -135,6 +136,12 @@ class HostMonitor(object):
                 'host_heartbeat_age_seconds',
                 'seconds since a host last touched its heartbeat',
                 host=str(h)).set(round(age, 6))
+        # retire gauges for hosts whose heartbeat file is gone (a
+        # retired/relaunched-elsewhere host): a dashboard must agree
+        # with scan() about which hosts exist, not show a frozen age
+        for h in self._published - set(ages):
+            self._reg.remove('host_heartbeat_age_seconds', host=str(h))
+        self._published = set(ages)
         self._g_peers.set(len(alive))
         return {'alive': alive, 'stale': stale, 'missing': missing,
                 'ages': ages}

@@ -294,6 +294,29 @@ class MetricsRegistry(object):
             return self._metrics.get(
                 (name, tuple(sorted(labels.items()))))
 
+    def remove(self, name, **labels):
+        """Retire one interned series so dashboards stop showing a
+        replica/host that no longer exists (fleet retire, host loss).
+        Returns True when a series was removed. The name's type/help
+        registration survives — a future series under the same name
+        re-registers cheaply — and callers holding the old metric
+        object just mutate an orphan, which is safe."""
+        with self._lock:
+            return self._metrics.pop(
+                (name, tuple(sorted(labels.items()))), None) is not None
+
+    def remove_matching(self, name, **labels):
+        """Retire every series of ``name`` whose labels include the
+        given label values (all series of the name when no labels are
+        passed). Returns the number of series removed."""
+        want = set(labels.items())
+        with self._lock:
+            doomed = [k for k in self._metrics
+                      if k[0] == name and want.issubset(set(k[1]))]
+            for k in doomed:
+                del self._metrics[k]
+        return len(doomed)
+
     def reset(self):
         """Zero every registered series (registrations survive) — for
         separating benchmark phases without tearing down hot-path

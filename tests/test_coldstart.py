@@ -1,0 +1,231 @@
+"""AOT cold-start cache (fleet/coldstart.py): store round-trip,
+invalidation token, executor warm start (bit-identical, no recompile),
+ModelServer warmup through the store, graceful degradation on corrupt
+entries (SERVING.md "Self-driving fleet")."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.fleet import coldstart
+from paddle_tpu.serving import ModelServer
+
+pytestmark = pytest.mark.fleet
+
+IN_DIM, OUT_DIM = 6, 3
+
+
+def _build_program(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[IN_DIM],
+                                  dtype='float32')
+            h = fluid.layers.fc(input=x, size=8, act='relu')
+            y = fluid.layers.fc(input=h, size=OUT_DIM)
+    return main, startup, y
+
+
+def _save_artifact(tmp_path, name='m0', seed=7):
+    main, startup, y = _build_program(seed=seed)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / name)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ['x'], [y], exe,
+                                      main_program=main)
+    return d
+
+
+def _counter(name):
+    m = obs.default_registry().get(name)
+    return m.value if m is not None else 0
+
+
+# ---- the store -----------------------------------------------------------
+def test_gate_closed_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv(coldstart.AOT_CACHE_ENV, raising=False)
+    assert not coldstart.enabled()
+    assert coldstart.default_store() is None
+
+
+def test_env_gate_opens_store(tmp_path, monkeypatch):
+    monkeypatch.setenv(coldstart.AOT_CACHE_ENV, str(tmp_path))
+    assert coldstart.enabled()
+    store = coldstart.default_store()
+    assert store is not None and store.dirname == str(tmp_path)
+
+
+def test_key_hash_stable_and_distinct():
+    k1 = ('fp', b'\x01\x02', True, 'token')
+    assert coldstart.key_hash(k1) == coldstart.key_hash(k1)
+    assert coldstart.key_hash(k1) != coldstart.key_hash(k1 + ('x',))
+
+
+def test_store_roundtrip_and_invalidation(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    store = coldstart.AotStore(str(tmp_path))
+    fn = jax.jit(lambda a, b: (a @ b, b))
+    a = jnp.ones((2, 4), 'float32')
+    b = jnp.ones((4, 3), 'float32')
+    compiled = fn.lower(a, b).compile()
+    key = ('fp0', 'sig')
+    assert store.save(key, compiled, backend='cpu')
+    assert coldstart.key_hash(key) in store.entries()
+    loaded = store.load(key, backend='cpu')
+    assert loaded is not None
+    want = compiled(a, b)
+    got = loaded(a, b)
+    np.testing.assert_array_equal(np.asarray(want[0]),
+                                  np.asarray(got[0]))
+    # toolchain/topology skew: the token mismatch is a miss, never a
+    # wrong executable
+    inv0 = _counter('coldstart_invalidated_total')
+    assert store.load(key, backend='tpu-v9000') is None
+    assert _counter('coldstart_invalidated_total') == inv0 + 1
+
+
+def test_corrupt_entry_degrades_to_miss(tmp_path):
+    store = coldstart.AotStore(str(tmp_path))
+    key = ('fp-corrupt',)
+    with open(store.path(key), 'wb') as f:
+        f.write(b'not a pickle')
+    fails0 = _counter('coldstart_failures_total')
+    assert store.load(key, backend='cpu') is None
+    assert _counter('coldstart_failures_total') == fails0 + 1
+
+
+def test_wrong_token_schema_is_invalid(tmp_path):
+    store = coldstart.AotStore(str(tmp_path))
+    key = ('fp-schema',)
+    with open(store.path(key), 'wb') as f:
+        pickle.dump({'token': {'schema': -1}, 'payload': b'',
+                     'in_tree': None, 'out_tree': None}, f)
+    inv0 = _counter('coldstart_invalidated_total')
+    assert store.load(key, backend='cpu') is None
+    assert _counter('coldstart_invalidated_total') == inv0 + 1
+
+
+# ---- executor integration ------------------------------------------------
+def test_executor_warm_start_bit_identical(tmp_path):
+    main, startup, y = _build_program()
+    scope = fluid.Scope()
+    x = np.random.RandomState(0).randn(4, IN_DIM).astype('float32')
+    with coldstart.cache_scope(str(tmp_path)):
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            saves0 = _counter('coldstart_saves_total')
+            cold, = exe.run(main, feed={'x': x}, fetch_list=[y])
+            assert _counter('coldstart_saves_total') > saves0
+            # steady state: executor-cache hit, no store traffic
+            m0 = _counter('coldstart_misses_total')
+            again, = exe.run(main, feed={'x': x}, fetch_list=[y])
+            assert _counter('coldstart_misses_total') == m0
+            np.testing.assert_array_equal(cold, again)
+            # fresh executor (fresh compile cache) on the same scope:
+            # the miss deserializes instead of recompiling
+            hits0 = _counter('coldstart_hits_total')
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            warm, = exe2.run(main, feed={'x': x}, fetch_list=[y])
+            assert _counter('coldstart_hits_total') == hits0 + 1
+            np.testing.assert_array_equal(cold, warm)
+
+
+def test_executor_no_store_without_gate(tmp_path, monkeypatch):
+    monkeypatch.delenv(coldstart.AOT_CACHE_ENV, raising=False)
+    main, startup, y = _build_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        x = np.ones((2, IN_DIM), 'float32')
+        exe.run(main, feed={'x': x}, fetch_list=[y])
+    assert not os.path.exists(str(tmp_path / 'anything'))
+
+
+def test_warm_start_survives_corrupt_store(tmp_path):
+    """A truncated/garbage entry must fall back to compiling."""
+    main, startup, y = _build_program()
+    scope = fluid.Scope()
+    x = np.ones((2, IN_DIM), 'float32')
+    with coldstart.cache_scope(str(tmp_path)):
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ref, = exe.run(main, feed={'x': x}, fetch_list=[y])
+            # corrupt every entry, then force fresh compile caches
+            for name in os.listdir(str(tmp_path)):
+                with open(os.path.join(str(tmp_path), name), 'wb') as f:
+                    f.write(b'garbage')
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            out, = exe2.run(main, feed={'x': x}, fetch_list=[y])
+            np.testing.assert_array_equal(ref, out)
+
+
+def test_sharded_seal_and_warm_start(tmp_path):
+    """The sealed executable must carry the mesh shardings the live
+    dispatch uses: bare avals lower single-device and XLA refuses the
+    mesh-committed args at call time. Seal sharded, warm-hit sharded,
+    bit-identical to the unsharded result."""
+    import jax
+    from paddle_tpu.partition import Partitioner
+    if len(jax.devices()) < 2:
+        pytest.skip('needs a multi-device mesh')
+    main, startup, y = _build_program()
+    scope = fluid.Scope()
+    x = np.random.RandomState(2).randn(8, IN_DIM).astype('float32')
+    with coldstart.cache_scope(str(tmp_path)):
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace(),
+                                 partitioner=Partitioner(num_devices=2))
+            exe.run(startup)
+            saves0 = _counter('coldstart_saves_total')
+            cold, = exe.run(main, feed={'x': x}, fetch_list=[y])
+            assert _counter('coldstart_saves_total') > saves0
+            hits0 = _counter('coldstart_hits_total')
+            exe2 = fluid.Executor(fluid.CPUPlace(),
+                                  partitioner=Partitioner(num_devices=2))
+            warm, = exe2.run(main, feed={'x': x}, fetch_list=[y])
+            assert _counter('coldstart_hits_total') == hits0 + 1
+            np.testing.assert_array_equal(cold, warm)
+            # an unsharded executor over the same (now mesh-committed)
+            # scope must not seal-and-dispatch a single-device
+            # executable against mesh-committed state: it stands down
+            # to lazy jit and still agrees numerically
+            plain_exe = fluid.Executor(fluid.CPUPlace())
+            plain, = plain_exe.run(main, feed={'x': x}, fetch_list=[y])
+            np.testing.assert_allclose(cold, plain, atol=1e-5)
+
+
+# ---- serving warmup ------------------------------------------------------
+def test_server_warmup_deserializes_on_fresh_replica(tmp_path):
+    art = _save_artifact(tmp_path)
+    x = np.random.RandomState(1).randn(2, IN_DIM).astype('float32')
+    store_dir = str(tmp_path / 'aot')
+    with coldstart.cache_scope(store_dir):
+        with ModelServer(place=fluid.CPUPlace(),
+                         max_batch_size=4) as srv:
+            srv.load_model('m', art)
+            srv.warmup('m')
+            ref = np.asarray(srv.submit(
+                'm', {'x': x}).result(timeout=30.0)[0])
+        saves = _counter('coldstart_saves_total')
+        assert saves > 0
+        hits0 = _counter('coldstart_hits_total')
+        # a fresh replica (fresh process-equivalent: new server, new
+        # executor) warms from the store instead of recompiling
+        with ModelServer(place=fluid.CPUPlace(),
+                         max_batch_size=4) as srv2:
+            srv2.load_model('m', art)
+            srv2.warmup('m')
+            assert _counter('coldstart_hits_total') > hits0
+            out = np.asarray(srv2.submit(
+                'm', {'x': x}).result(timeout=30.0)[0])
+        np.testing.assert_array_equal(ref, out)

@@ -434,3 +434,55 @@ def test_router_requeue_exhaustion_is_typed(tmp_path):
         with pytest.raises(fleet.FleetError):
             req.result(timeout=30.0)
         assert victim in (a, b)
+
+
+def test_scale_out_then_scale_in_rebalances_sticky(tmp_path):
+    """ISSUE 16 satellite: the sticky-placement ring follows elastic
+    membership. Scale-out pulls the new replica into rings and serves
+    it live; scale-in re-derives rings over survivors, replays load,
+    and retires the victim's gauges — sticky keys keep resolving (to a
+    live replica) through both transitions."""
+    from paddle_tpu import observability as obs
+    d = _save_artifact(tmp_path)
+    x = np.random.RandomState(3).randn(2, IN_DIM).astype('float32')
+    with _router(replicas=2, replication=2) as router:
+        router.load_model('m', d)
+        ref = np.asarray(router.infer('m', {'x': x}, sticky_key='k',
+                                      timeout=30.0)[0])
+        before = set(router.placement('m'))
+        rid = router.add_replica()
+        assert rid == 2
+        # the ring was re-derived over 3 replicas and the model is
+        # loaded wherever it now lives (load replay, not lazy faulting)
+        after_out = set(router.placement('m'))
+        for r in after_out:
+            assert 'm' in router.replica(r).server.models()
+        out = np.asarray(router.infer('m', {'x': x}, sticky_key='k',
+                                      timeout=30.0)[0])
+        np.testing.assert_array_equal(ref, out)
+        # scale back in: retire the newest replica
+        router.retire_replica(rid)
+        assert set(router.placement('m')) == before
+        assert rid not in router.stats()['replicas']
+        out = np.asarray(router.infer('m', {'x': x}, sticky_key='k',
+                                      timeout=30.0)[0])
+        np.testing.assert_array_equal(ref, out)
+        # ISSUE 16 satellite: no stale per-replica series survive
+        reg = obs.default_registry()
+        assert reg.get('fleet_replica_state', replica=str(rid)) is None
+        assert reg.get('router_routed_total', replica=str(rid)) is None
+        # double-retire and restart-of-retired are typed drops
+        with pytest.raises(fleet.ReplicaRetired):
+            router.retire_replica(rid)
+        with pytest.raises(fleet.ReplicaRetired):
+            router.restart_replica(rid)
+
+
+def test_retire_below_replication_floor_refused(tmp_path):
+    d = _save_artifact(tmp_path)
+    with _router(replicas=2, replication=2) as router:
+        router.load_model('m', d)
+        ok, why = router.can_retire(0)
+        assert not ok and 'replication' in why
+        with pytest.raises(ValueError):
+            router.retire_replica(0)

@@ -113,3 +113,27 @@ def test_rule_dup_metric_name(tmp_path):
     assert dups and 'shared_total' in dups[0].detail
     assert {v.path.split(os.sep)[1] for v in dups} == \
         {'serving', 'fleet'}
+
+
+def test_rule_jit_on_warmup_path(tmp_path):
+    """ISSUE 16 satellite: a direct jax.jit/pjit in serving/ or
+    fleet/ bypasses the PTPU_AOT_CACHE store; only fleet/coldstart.py
+    may compile."""
+    src = 'import jax\nf = jax.jit(lambda x: x)\n'
+    p = tmp_path / 'mod.py'
+    p.write_text(src)
+    for rel, expect in [
+            (os.path.join('paddle_tpu', 'serving', 'server.py'), 1),
+            (os.path.join('paddle_tpu', 'fleet', 'router.py'), 1),
+            (os.path.join('paddle_tpu', 'fleet', 'coldstart.py'), 0),
+            (os.path.join('paddle_tpu', 'executor.py'), 0),
+            ('tools/bench.py', 0)]:
+        v, _ = lint_repo.lint_file(str(p), rel)
+        hits = [x for x in v if x.rule == 'jit-on-warmup-path']
+        assert len(hits) == expect, (rel, hits)
+    # pjit too, and bare-name jit calls
+    p.write_text('from jax.experimental.pjit import pjit\n'
+                 'g = pjit(lambda x: x)\n')
+    v, _ = lint_repo.lint_file(
+        str(p), os.path.join('paddle_tpu', 'fleet', 'autoscaler.py'))
+    assert any(x.rule == 'jit-on-warmup-path' for x in v)

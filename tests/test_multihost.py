@@ -362,3 +362,24 @@ def test_chained_dispatch_across_hosts(tmp_path, oracle_run):
     log = _worker_log(paths, 0, 0)
     assert 'fallback' not in log.lower()
     assert _losses(log) == oracle_run['losses']
+
+
+def test_heartbeat_gauge_retired_with_host(tmp_path):
+    """ISSUE 16 satellite: a host that leaves the fleet (file gone)
+    takes its ``host_heartbeat_age_seconds{host=}`` series with it
+    instead of freezing at the last observed age forever."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.multihost.heartbeat import heartbeat_path
+    hb = str(tmp_path)
+    for rank in (0, 1):
+        with open(heartbeat_path(hb, rank), 'w'):
+            pass
+    mon = multihost.HostMonitor(hb, window=5.0, expected=[0, 1])
+    mon.scan()
+    reg = obs.default_registry()
+    assert reg.get('host_heartbeat_age_seconds', host='1') is not None
+    os.remove(heartbeat_path(hb, 1))
+    scan = mon.scan()
+    assert scan['missing'] == [1]
+    assert reg.get('host_heartbeat_age_seconds', host='1') is None
+    assert reg.get('host_heartbeat_age_seconds', host='0') is not None

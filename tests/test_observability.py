@@ -402,3 +402,40 @@ def test_save_profile_is_self_describing(tmp_path):
     profiler.reset_profiler()
     assert json.loads(
         open(profiler.save_profile(path)).read())['events'] == []
+
+
+def test_registry_remove_series():
+    """ISSUE 16 satellite: retire/rebuild paths drop per-entity label
+    series so a long-lived fleet's registry doesn't grow monotonically
+    with every replica id ever used."""
+    reg = MetricsRegistry()
+    reg.gauge('fleet_replica_state', 'state', replica='0').set(1)
+    reg.gauge('fleet_replica_state', 'state', replica='1').set(1)
+    reg.counter('other_total', 'x').inc()
+    assert reg.remove('fleet_replica_state', replica='1')
+    assert reg.get('fleet_replica_state', replica='1') is None
+    # the sibling series and unrelated metrics survive
+    assert reg.get('fleet_replica_state', replica='0').value == 1
+    assert reg.get('other_total').value == 1
+    # removing a missing series is a no-op, not an error
+    assert not reg.remove('fleet_replica_state', replica='99')
+    # re-registering after removal works (fresh series)
+    g = reg.gauge('fleet_replica_state', 'state', replica='1')
+    assert g.value == 0
+
+
+def test_registry_remove_matching():
+    reg = MetricsRegistry()
+    for rid in range(3):
+        reg.counter('router_routed_total', 'n', replica=str(rid),
+                    model='m').inc(rid + 1)
+    reg.counter('router_routed_total', 'n', replica='0',
+                model='other').inc()
+    assert reg.remove_matching('router_routed_total',
+                               replica='0') == 2
+    assert reg.get('router_routed_total', replica='0',
+                   model='m') is None
+    assert reg.get('router_routed_total', replica='1',
+                   model='m').value == 2
+    assert reg.remove_matching('router_routed_total',
+                               replica='nope') == 0

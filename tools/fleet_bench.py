@@ -24,6 +24,20 @@ Two phases, both seeded and deterministic in shape:
    bit-identical to each other AND to a per-sequence (one slot at a
    time) decode; continuous tokens/s beats stop-and-wait.
 
+3. **Self-driving fleet** (SERVING.md "Self-driving fleet"): one
+   replica + supervisor + :class:`Autoscaler`; a traffic ramp must
+   scale the fleet out within a window, a mid-load kill must
+   re-balance with zero dropped/untyped futures and bit-identical
+   results, p99 must hold through both, idle must scale back to the
+   floor, and a placement-budget overcommit must be rejected with a
+   typed ``PlacementInfeasible`` naming the exceeded budget.
+
+4. **AOT cold start**: a fresh replica's ``warmup()`` against a
+   sealed ``PTPU_AOT_CACHE`` store must be measurably faster than the
+   compiling cold start, bit-identical, with store save + hit
+   journalled (gated via ``obs_report --require autoscale`` and
+   ``--require coldstart``).
+
 ``--smoke`` runs a short schedule of both phases, writes an
 observability journal and validates it via ``obs_report.py --require
 fleet`` AND ``--require tracing`` semantics — including that the
@@ -38,6 +52,7 @@ gate alongside ``chaos_bench.py --smoke`` and
     python tools/fleet_bench.py --replicas 2 --mesh 2   # sharded
 """
 import argparse
+import collections
 import json
 import os
 import sys
@@ -61,17 +76,20 @@ def _force_cpu():
         pass
 
 
-def _build_artifact(workdir, seed=7):
+def _build_artifact(workdir, seed=7, in_dim=IN_DIM, hidden=32,
+                    out_dim=OUT_DIM, depth=1):
     import paddle_tpu.fluid as fluid
     exe = fluid.Executor(fluid.CPUPlace())
     main, startup = fluid.Program(), fluid.Program()
     startup.random_seed = seed
     with fluid.program_guard(main, startup):
         with fluid.unique_name.guard():
-            x = fluid.layers.data(name='x', shape=[IN_DIM],
+            x = fluid.layers.data(name='x', shape=[in_dim],
                                   dtype='float32')
-            h = fluid.layers.fc(input=x, size=32, act='relu')
-            y = fluid.layers.fc(input=h, size=OUT_DIM, act=None)
+            h = x
+            for _ in range(depth):
+                h = fluid.layers.fc(input=h, size=hidden, act='relu')
+            y = fluid.layers.fc(input=h, size=out_dim, act=None)
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
@@ -355,6 +373,272 @@ def run_decode_phase(slots=8, n_sequences=48, max_len=32, seed=3,
     }
 
 
+def run_autoscale_phase(max_replicas=3, n_requests=96, clients=4,
+                        max_batch=8, seed=5, slo_p99=5.0,
+                        scale_window_s=20.0, idle_window_s=25.0):
+    """Closed-loop self-driving fleet phase (SERVING.md "Self-driving
+    fleet"): start at ONE replica under a supervisor + autoscaler,
+    ramp traffic until the autoscaler scales out, kill a replica
+    mid-load (supervisor repairs, ring re-balances), then go idle and
+    watch it scale back to the floor. Gates:
+
+    - scale-up happens inside ``scale_window_s`` of sustained ramp;
+    - the killed replica's work re-balances (no dropped/untyped
+      futures) and every result is bit-identical to the fault-free
+      reference;
+    - p99 holds ``slo_p99`` through ramp + kill;
+    - the fleet returns to one replica inside ``idle_window_s`` once
+      traffic stops;
+    - a placement-budget rejection is typed (PlacementInfeasible
+      naming the exceeded budget), never an OOM-by-overcommit.
+    """
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fleet import (Autoscaler, PlacementBudget,
+                                  PlacementInfeasible, Router)
+    from paddle_tpu.serving import ModelServer, ServingError
+
+    problems = []
+    rng = np.random.RandomState(seed)
+    # requests heavy enough (milliseconds of matmul each) that a
+    # client window of them is a real sustained queue on one replica —
+    # a featherweight model drains faster than Python can submit and
+    # the ramp would never register
+    auto_in, auto_batch = 512, 128
+    inputs = [rng.randn(auto_batch, auto_in).astype('float32')
+              for _ in range(n_requests)]
+
+    with tempfile.TemporaryDirectory(prefix='fleet_auto_') as workdir:
+        artifact = _build_artifact(workdir, seed=seed, in_dim=auto_in,
+                                   hidden=1024, out_dim=OUT_DIM,
+                                   depth=2)
+        reference = _reference_fn(artifact)
+        expected = [reference(x) for x in inputs]
+
+        def factory(rid):
+            return ModelServer(place=fluid.CPUPlace(),
+                               max_batch_size=auto_batch,
+                               max_queue_depth=max(64, n_requests),
+                               watchdog_poll=0.05)
+
+        router = Router(factory, replicas=1, poll_interval=0.05,
+                        placement_budget=PlacementBudget(
+                            hbm_bytes=1 << 30))
+        scaler = Autoscaler(router, min_replicas=1,
+                            max_replicas=max_replicas,
+                            high_queue=1.5, low_queue=0.25,
+                            sustain=2, up_cooldown=0.5,
+                            down_cooldown=1.0, interval=0.05)
+        outcomes = [None] * n_requests
+        latencies = [None] * n_requests
+        t_start = time.monotonic()
+        with router:
+            router.load_model('m', artifact, hbm_bytes=1 << 20)
+
+            # ---- ledger-informed admission control is typed --------------
+            try:
+                router.load_model('hog', artifact, hbm_bytes=2 << 30)
+                problems.append('placement budget admitted a model '
+                                'whose demand exceeds the HBM budget')
+            except PlacementInfeasible as e:
+                if 'hbm_bytes' not in str(e):
+                    problems.append(
+                        'PlacementInfeasible does not name the '
+                        'exceeded budget: %r' % (e,))
+
+            scaler.start()
+            try:
+                def client(cid):
+                    # sliding submit window: each client keeps a batch
+                    # of requests in flight, so the single replica's
+                    # queue stays over the high watermark (sustained
+                    # ramp) until the fleet grows to absorb it
+                    pending = collections.deque()
+
+                    def reap(down_to):
+                        while len(pending) > down_to:
+                            i, req, t0 = pending.popleft()
+                            try:
+                                out, = req.result(timeout=60.0)
+                                outcomes[i] = ('ok', np.asarray(out))
+                            except ServingError as e:
+                                outcomes[i] = ('typed_error', e)
+                            except Exception as e:  # noqa: BLE001
+                                outcomes[i] = ('untyped_error', e)
+                            latencies[i] = time.monotonic() - t0
+
+                    for i in range(cid, n_requests, clients):
+                        t0 = time.monotonic()
+                        give_up = t0 + 30.0
+                        req = None
+                        while req is None:
+                            try:
+                                req = router.submit(
+                                    'm', {'x': inputs[i]})
+                            except ServingError:
+                                if time.monotonic() > give_up:
+                                    outcomes[i] = ('stuck', None)
+                                    break
+                                time.sleep(0.01)
+                        if req is None:
+                            continue
+                        pending.append((i, req, t0))
+                        reap(16)
+                    reap(0)
+
+                threads = [threading.Thread(target=client, args=(c,),
+                                            daemon=True)
+                           for c in range(clients)]
+                for t in threads:
+                    t.start()
+
+                # gate 1: scale-up inside the window
+                give_up = time.monotonic() + scale_window_s
+                while time.monotonic() < give_up and \
+                        scaler.scale_ups == 0:
+                    time.sleep(0.05)
+                scaled_up_s = time.monotonic() - t_start
+                if scaler.scale_ups == 0:
+                    problems.append(
+                        'autoscaler never scaled out within %.0fs of '
+                        'sustained ramp' % scale_window_s)
+
+                # chaos mid-load: kill the newest replica; the
+                # supervisor owns the repair, the ring re-balances
+                killed = None
+                if scaler.scale_ups:
+                    with router._lock:
+                        killed = max(router._replicas)
+                    router.kill_replica(killed, abrupt=True)
+                for t in threads:
+                    t.join(120.0)
+
+                # gate 4: idle -> back to the floor
+                give_up = time.monotonic() + idle_window_s
+                while time.monotonic() < give_up and \
+                        len(router.stats()['replicas']) > 1:
+                    time.sleep(0.1)
+                final_replicas = len(router.stats()['replicas'])
+                if final_replicas > 1:
+                    problems.append(
+                        'fleet never scaled back to the 1-replica '
+                        'floor within %.0fs idle (still %d)'
+                        % (idle_window_s, final_replicas))
+            finally:
+                scaler.stop()
+            fleet_stats = router.stats()
+
+        # ---- invariants --------------------------------------------------
+        ok = sum(1 for o in outcomes if o and o[0] == 'ok')
+        typed = sum(1 for o in outcomes if o and o[0] == 'typed_error')
+        untyped = [repr(o[1]) for o in outcomes
+                   if o and o[0] == 'untyped_error']
+        dropped = sum(1 for o in outcomes if o is None) + \
+            sum(1 for o in outcomes if o and o[0] == 'stuck')
+        if untyped:
+            problems.append('untyped client errors: %s' % untyped[:3])
+        if dropped:
+            problems.append('%d request(s) dropped/stuck' % dropped)
+        if typed:
+            problems.append('%d request(s) failed typed despite the '
+                            'supervisor' % typed)
+        mismatches = sum(
+            1 for i, o in enumerate(outcomes)
+            if o and o[0] == 'ok' and
+            not np.array_equal(o[1], expected[i]))
+        if mismatches:
+            problems.append(
+                '%d result(s) differ from the fault-free reference '
+                'across scale-out + kill' % mismatches)
+        lats = [l for l in latencies if l is not None]
+        p50, p99 = _percentile(lats, 0.50), _percentile(lats, 0.99)
+        if p99 > slo_p99:
+            problems.append('p99 latency %.3fs exceeds the %.2fs SLO '
+                            'through the ramp + kill' % (p99, slo_p99))
+
+    return {
+        'config': {'max_replicas': max_replicas,
+                   'n_requests': n_requests, 'clients': clients,
+                   'seed': seed, 'slo_p99': slo_p99,
+                   'killed_replica': killed},
+        'outcomes': {'ok': ok, 'typed_errors': typed,
+                     'untyped_errors': len(untyped),
+                     'dropped': dropped,
+                     'scale_ups': scaler.scale_ups,
+                     'scale_downs': scaler.scale_downs,
+                     'scaled_up_after_s': round(scaled_up_s, 2),
+                     'final_replicas': final_replicas},
+        'latency': {'p50_s': round(p50, 4), 'p99_s': round(p99, 4)},
+        'fleet': fleet_stats,
+        'problems': problems,
+    }
+
+
+def run_coldstart_phase(min_speedup=1.5, seed=11):
+    """AOT cold-start phase: warm a model on one server (compiles +
+    seals the executables to the store), then measure a FRESH server's
+    ``warmup()`` against the same store vs one compiling from scratch.
+    Gates: warm warmup is ``min_speedup``x faster than cold, outputs
+    bit-identical, and the store recorded both a save and a hit."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu.fleet import coldstart
+    from paddle_tpu.serving import ModelServer
+
+    problems = []
+    rng = np.random.RandomState(seed)
+    x = rng.randn(4, IN_DIM).astype('float32')
+
+    def counter(name):
+        m = obs.default_registry().get(name)
+        return m.value if m is not None else 0
+
+    def timed_warmup(store_dir, artifact):
+        with coldstart.cache_scope(store_dir):
+            with ModelServer(place=fluid.CPUPlace(),
+                             max_batch_size=8) as srv:
+                srv.load_model('m', artifact)
+                t0 = time.monotonic()
+                srv.warmup('m')
+                wall = time.monotonic() - t0
+                out = np.asarray(srv.submit(
+                    'm', {'x': x}).result(timeout=30.0)[0])
+        return wall, out
+
+    with tempfile.TemporaryDirectory(prefix='fleet_cold_') as workdir:
+        artifact = _build_artifact(workdir, seed=seed)
+        store_dir = os.path.join(workdir, 'aot')
+        saves0 = counter('coldstart_saves_total')
+        hits0 = counter('coldstart_hits_total')
+        # cold: fills the store (compile + seal)
+        cold_wall, ref = timed_warmup(store_dir, artifact)
+        if counter('coldstart_saves_total') <= saves0:
+            problems.append('cold warmup sealed nothing to the AOT '
+                            'store')
+        # warm: a fresh replica (new server + executor, fresh compile
+        # cache) deserializes instead of recompiling
+        warm_wall, out = timed_warmup(store_dir, artifact)
+        if counter('coldstart_hits_total') <= hits0:
+            problems.append('warm warmup never hit the AOT store')
+        if not np.array_equal(ref, out):
+            problems.append('AOT-warmed replica output differs from '
+                            'the compiling replica')
+        speedup = cold_wall / warm_wall if warm_wall else float('inf')
+        if speedup < min_speedup:
+            problems.append(
+                'AOT warm start %.1fms is not measurably faster than '
+                'the %.1fms cold compile (%.2fx < %.2fx)'
+                % (warm_wall * 1e3, cold_wall * 1e3, speedup,
+                   min_speedup))
+    return {
+        'config': {'seed': seed, 'min_speedup': min_speedup},
+        'cold_warmup_ms': round(cold_wall * 1e3, 1),
+        'warm_warmup_ms': round(warm_wall * 1e3, 1),
+        'speedup': round(speedup, 2),
+        'bit_identical': np.array_equal(ref, out),
+        'problems': problems,
+    }
+
+
 def check_requeue_trace(journal_path):
     """Tracing gate for the kill-mid-load smoke: the journal must hold
     at least one requeued request whose span tree reconstructs end to
@@ -402,6 +686,8 @@ def main(argv=None):
     ap.add_argument('--no-kill', action='store_true',
                     help='skip the chaos kill (pure load run)')
     ap.add_argument('--no-decode-phase', action='store_true')
+    ap.add_argument('--no-autoscale-phase', action='store_true')
+    ap.add_argument('--no-coldstart-phase', action='store_true')
     ap.add_argument('--smoke', action='store_true',
                     help='short seeded schedule; exit nonzero if any '
                          'fleet or decode invariant breaks')
@@ -450,6 +736,12 @@ def main(argv=None):
             decode = None if args.no_decode_phase else \
                 run_decode_phase(slots=8, n_sequences=32, max_len=24,
                                  seed=3)
+            autoscale = None if args.no_autoscale_phase else \
+                run_autoscale_phase(max_replicas=3, n_requests=72,
+                                    clients=args.clients,
+                                    max_batch=args.max_batch)
+            cold = None if args.no_coldstart_phase else \
+                run_coldstart_phase()
         else:
             fleet = run_fleet_chaos(
                 replicas=args.replicas, n_requests=args.requests,
@@ -459,6 +751,13 @@ def main(argv=None):
             decode = None if args.no_decode_phase else \
                 run_decode_phase(slots=8, n_sequences=64, max_len=32,
                                  seed=3)
+            autoscale = None if args.no_autoscale_phase else \
+                run_autoscale_phase(max_replicas=max(3, args.replicas),
+                                    n_requests=args.requests,
+                                    clients=args.clients,
+                                    max_batch=args.max_batch)
+            cold = None if args.no_coldstart_phase else \
+                run_coldstart_phase()
     finally:
         if jctx is not None:
             observability.perf.enable_capture(_perf_prev)
@@ -467,6 +766,10 @@ def main(argv=None):
     problems = list(fleet['problems'])
     if decode is not None:
         problems += decode['problems']
+    if autoscale is not None:
+        problems += autoscale['problems']
+    if cold is not None:
+        problems += cold['problems']
     if journal_path:
         print('journal written to %s' % journal_path)
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -477,10 +780,19 @@ def main(argv=None):
         problems += check_journal(journal_path, require='tracing')
         # perf rides it too: every replica compile must have ledgered
         problems += check_journal(journal_path, require='perf')
+        if autoscale is not None:
+            # the closed loop must have acted, not just observed
+            problems += check_journal(journal_path,
+                                      require='autoscale')
+        if cold is not None:
+            problems += check_journal(journal_path,
+                                      require='coldstart')
         if args.smoke and not args.no_kill:
             problems += check_requeue_trace(journal_path)
 
-    results = {'fleet': fleet, 'decode': decode, 'problems': problems}
+    results = {'fleet': fleet, 'decode': decode,
+               'autoscale': autoscale, 'coldstart': cold,
+               'problems': problems}
     if args.json:
         with open(args.json, 'w') as f:
             json.dump(results, f, indent=2, sort_keys=True,
@@ -504,6 +816,18 @@ def main(argv=None):
                  decode['stop_and_wait']['tokens_per_sec'],
                  100 * decode['stop_and_wait']['mean_occupancy'],
                  decode['speedup'], decode['exact_vs_per_sequence']))
+    if autoscale is not None:
+        ao = autoscale['outcomes']
+        print('autoscale: %d ok, %d scale-ups (first after %.1fs), '
+              '%d scale-downs, final fleet %d | p99 %.0fms'
+              % (ao['ok'], ao['scale_ups'], ao['scaled_up_after_s'],
+                 ao['scale_downs'], ao['final_replicas'],
+                 autoscale['latency']['p99_s'] * 1e3))
+    if cold is not None:
+        print('coldstart: cold warmup %.0fms -> AOT-warmed %.0fms '
+              '(%.1fx), bit_identical=%s'
+              % (cold['cold_warmup_ms'], cold['warm_warmup_ms'],
+                 cold['speedup'], cold['bit_identical']))
     if problems:
         print('FLEET INVARIANTS BROKEN:', file=sys.stderr)
         for p in problems:

@@ -40,6 +40,12 @@ Rules (each encodes a convention the codebase actually relies on):
   go through ``observability.perf`` (``capture_compiled`` /
   ``program_ledger``); ``Executor.cost_analysis`` is the one pinned
   legacy entry point.
+- ``jit-on-warmup-path``: a direct ``jax.jit()``/``pjit()`` call in
+  ``paddle_tpu/serving/`` or ``paddle_tpu/fleet/`` outside
+  ``fleet/coldstart.py`` — replica warmup compiles must flow through
+  ``Executor.run`` so the ``PTPU_AOT_CACHE`` cold-start store
+  (SERVING.md "Self-driving fleet") can serve them; a bypassing jit
+  silently turns millisecond warm starts back into recompiles.
 
 The embedded ``ALLOWLIST`` pins known, accepted occurrences (ratchet
 style): the tool exits nonzero only on violations NOT in the allowlist,
@@ -57,6 +63,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCOPE = ('paddle_tpu', 'tools')
 METRIC_PACKAGES = ('serving', 'fleet', 'multihost', 'observability')
 METRIC_FACTORIES = ('counter', 'histogram', 'gauge')
+# packages on the serving warmup path: compiles here must flow through
+# the Executor (whose miss path consults the AOT cold-start store) —
+# a direct jax.jit/pjit would silently bypass PTPU_AOT_CACHE and turn
+# millisecond warm starts back into full recompiles. fleet/coldstart.py
+# is the one sanctioned compile site (the seal path itself).
+JIT_FORBIDDEN_PACKAGES = ('serving', 'fleet')
+JIT_SANCTIONED = os.path.join('paddle_tpu', 'fleet', 'coldstart.py')
 
 # rule:path:detail -> accepted occurrences. Add entries ONLY with a
 # review note; the lint test pins this set.
@@ -257,6 +270,15 @@ def lint_file(path, relpath):
             func = node.func
             callee = func.attr if isinstance(func, ast.Attribute) \
                 else (func.id if isinstance(func, ast.Name) else None)
+            if callee in ('jit', 'pjit') \
+                    and _package_of(relpath) in JIT_FORBIDDEN_PACKAGES \
+                    and relpath != JIT_SANCTIONED:
+                out.append(Violation(
+                    'jit-on-warmup-path', relpath, node.lineno,
+                    '%s() compiles outside the Executor: the warmup '
+                    'path must go through Executor.run so the '
+                    'PTPU_AOT_CACHE store (fleet/coldstart.py) can '
+                    'serve it' % _src(func)))
             if callee == 'start_span' \
                     and relpath != os.path.join('paddle_tpu',
                                                 'observability',

@@ -31,7 +31,12 @@ tracing`` for a run that must hold completed distributed-tracing spans
 the gate; fault injection legitimately leaves them); ``--require
 perf`` for a run that must have captured per-program performance
 ledgers (``perf_ledger`` records, OBSERVABILITY.md "Performance
-observatory"); ``--require any`` for presence only).
+observatory"); ``--require autoscale`` for a self-driving fleet run —
+``autoscale`` records must include at least one acted scale_up /
+scale_down decision (SERVING.md "Self-driving fleet"); ``--require
+coldstart`` for an AOT-warmed run — ``coldstart`` records must show
+both a store save and a warm hit; ``--require any`` for presence
+only).
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
 load run writes.
 """
@@ -72,6 +77,14 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # the Executor's compile-miss path — OBSERVABILITY.md
                # "Performance observatory")
                'perf': 'perf_ledger',
+               # a self-driving fleet run must show autoscale decisions
+               # (SERVING.md "Self-driving fleet"); the gate further
+               # insists at least one decision actually resized the
+               # fleet (scale_up / scale_down), not just holds
+               'autoscale': 'autoscale',
+               # an AOT-warmed run must show cold-start store traffic
+               # (save on the compiling replica, hit on the warmed one)
+               'coldstart': 'coldstart',
                'any': None}
 
 
@@ -767,6 +780,22 @@ def check_journal(path, require='step'):
                     'journal contains zero step_end records with '
                     'pipeline fields (feed_wait) — was the run made '
                     'with a pre-pipelining trainer?')
+    if require == 'autoscale':
+        acted = sum(1 for r in records if r['ev'] == 'autoscale'
+                    and r.get('action') in ('scale_up', 'scale_down'))
+        if not acted:
+            problems.append(
+                'journal holds autoscale records but no scale_up / '
+                'scale_down decision — the control loop never acted')
+    if require == 'coldstart':
+        actions = {r.get('action') for r in records
+                   if r['ev'] == 'coldstart'}
+        if 'save' not in actions:
+            problems.append('coldstart journal shows no AOT save — '
+                            'nothing was ever sealed to the store')
+        if 'hit' not in actions:
+            problems.append('coldstart journal shows no AOT hit — '
+                            'no warmup ever deserialized')
     if require == 'multihost':
         # a host loss the monitor only noticed after its own heartbeat
         # window means detection is broken even if recovery worked
