@@ -730,6 +730,77 @@ def bench_decode(on_tpu):
         (cont_tps, 100 * cont_stats['mean_occupancy'], sw_tps,
          100 * sw_stats['mean_occupancy'], out['continuous_speedup'],
          out['continuous_batching']['exact_match']))
+
+    # ---- paged KV-cache vs slotted continuous batching --------------
+    # ISSUE 17 / SERVING.md "Paged KV-cache & disaggregated prefill":
+    # the paged attention cell behind a PagePool sized to the SAME KV
+    # bytes as the slotted engine (slots*max_len == num_pages*page_size
+    # by construction) holds 3x the resident sequences, and at a
+    # heavily ragged length mix the extra admission waves the slotted
+    # engine needs show up as wall-clock. Outputs are gated
+    # bit-identical between the two engines.
+    import paddle_tpu.kvcache as kvc
+    from paddle_tpu.fleet.decode import attention_history_cell
+    kv_seed = 3
+    kv_dict, kv_word, kv_hidden, kv_max_len = 64, 16, 32, 32
+    page_size, num_pages = 8, 32
+    kv_slots, paged_slots = 8, 24
+    assert kv_slots * kv_max_len == num_pages * page_size
+    n_kv = 96
+    rng = np.random.RandomState(kv_seed)
+    kv_lengths = [int(rng.randint(1, 7)) for _ in range(n_kv)]
+    for i in range(0, n_kv, 8):
+        kv_lengths[i] = kv_max_len // 2
+    kv_firsts = [int(rng.randint(1, kv_dict)) for _ in range(n_kv)]
+
+    def _run_kv(make_engine):
+        eng = make_engine()
+        eng.decode(first_id=1, max_new_tokens=2)       # warm compile
+        t0 = time.perf_counter()
+        reqs = [eng.submit(first_id=kv_firsts[i],
+                           max_new_tokens=kv_lengths[i])
+                for i in range(n_kv)]
+        outs = [r.result(timeout=600.0) for r in reqs]
+        wall = time.perf_counter() - t0
+        eng.close()
+        return outs, wall
+
+    def _slotted_engine():
+        cell, kspecs = attention_history_cell(
+            kv_dict, word_dim=kv_word, hidden=kv_hidden,
+            max_len=kv_max_len)
+        return DecodeEngine(cell, kspecs, slots=kv_slots,
+                            max_len=kv_max_len, seed=kv_seed)
+
+    kv_spec = kvc.stock_spec(kv_dict, word_dim=kv_word,
+                             hidden=kv_hidden, max_len=kv_max_len,
+                             page_size=page_size, num_pages=num_pages,
+                             seed=kv_seed)
+    kv_slotted, kv_slotted_wall = _run_kv(_slotted_engine)
+    kv_paged, kv_paged_wall = _run_kv(
+        lambda: kvc.make_paged_engine(kv_spec, slots=paged_slots)[0])
+    kv_tokens = sum(kv_lengths)
+    paged_tps = kv_tokens / max(kv_paged_wall, 1e-9)
+    kv_slotted_tps = kv_tokens / max(kv_slotted_wall, 1e-9)
+    out['paged_decode'] = {
+        'sequences': n_kv, 'tokens': kv_tokens,
+        'page_size': page_size, 'num_pages': num_pages,
+        'slotted_slots': kv_slots, 'paged_slots': paged_slots,
+        'paged_tokens_per_sec': round(paged_tps, 1),
+        'slotted_tokens_per_sec': round(kv_slotted_tps, 1),
+        'sequences_resident_ratio': round(
+            paged_slots / float(kv_slots), 2),
+        'exact_match': bool(all(np.array_equal(a, b) for a, b in
+                                zip(kv_paged, kv_slotted))),
+    }
+    out['decode_paged_speedup'] = round(
+        paged_tps / max(kv_slotted_tps, 1e-9), 2)
+    log('decode paged kv-cache: %.0f tok/s vs slotted %.0f tok/s '
+        '(%.2fx) at %.1fx sequences-resident, equal KV bytes, '
+        'exact=%s' % (
+            paged_tps, kv_slotted_tps, out['decode_paged_speedup'],
+            out['paged_decode']['sequences_resident_ratio'],
+            out['paged_decode']['exact_match']))
     return out
 
 
@@ -1650,6 +1721,11 @@ def _headline(record):
         'decode_jit_speedup': _dig(record, 'decode', 'jitted_speedup'),
         'decode_continuous_speedup': _dig(record, 'decode',
                                           'continuous_speedup'),
+        'decode_paged_speedup': _dig(record, 'decode',
+                                     'decode_paged_speedup'),
+        'decode_paged_sequences_resident': _dig(
+            record, 'decode', 'paged_decode',
+            'sequences_resident_ratio'),
         'input_pipeline_speedup': _dig(record, 'input_pipeline',
                                        'speedup'),
         'zero_steps_per_sec_ratio': _dig(record, 'zero',

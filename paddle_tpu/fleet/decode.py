@@ -29,6 +29,23 @@ pinned by ``tests/test_fleet.py``.
 admission (only refill when every slot retired) — the baseline
 ``bench.py bench_decode`` and ``tools/fleet_bench.py`` measure the
 continuous engine against.
+
+``admission='paged'`` (SERVING.md "Paged KV-cache & disaggregated
+prefill") moves the cell's KV state out of the per-slot ``state_specs``
+into a shared :class:`~paddle_tpu.kvcache.pool.PagePool`: admission
+becomes "allocate ``ceil(len / page_size)`` pages", so resident KV
+bytes track actual sequence lengths instead of ``slots * max_len``,
+and the compiled slot count can grow past what dense KV allowed. The
+cell signature gains the pool plumbing —
+``cell_fn(pre_ids, states, pos, pools, table, page, offset) ->
+(probs, new_states, new_pools)`` (see
+:func:`paddle_tpu.kvcache.paged_attention_cell`) — and ``submit``
+accepts prefilled pages (``init_pages`` + ``pos0``) so a dedicated
+prefill replica can hand a prompt's KV straight to this engine. A
+request the free list cannot serve waits at the queue head
+(backpressure, journalled) instead of failing; one that can NEVER fit
+raises typed :class:`~paddle_tpu.kvcache.pool.PoolExhausted` at
+submit.
 """
 import collections
 import threading
@@ -54,12 +71,15 @@ class DecodeRequest(object):
 
     __slots__ = ('init_states', 'first_id', 'max_new_tokens',
                  'submit_time', '_event', '_tokens', '_error',
-                 'trace', '_qspan')
+                 'trace', '_qspan', 'pos0', 'init_pages')
 
-    def __init__(self, init_states, first_id, max_new_tokens):
+    def __init__(self, init_states, first_id, max_new_tokens,
+                 pos0=0, init_pages=None):
         self.init_states = init_states
         self.first_id = first_id
         self.max_new_tokens = max_new_tokens
+        self.pos0 = pos0              # prefilled prefix length (paged)
+        self.init_pages = init_pages  # name -> [page arrays] (paged)
         self.submit_time = time.monotonic()
         self._event = threading.Event()
         self._tokens = None
@@ -95,12 +115,13 @@ class DecodeRequest(object):
 
 
 class _Slot(object):
-    __slots__ = ('req', 'tokens', 'span')
+    __slots__ = ('req', 'tokens', 'span', 'pages')
 
     def __init__(self, req):
         self.req = req
         self.tokens = []
         self.span = None      # decode/active span, admit -> retire
+        self.pages = None     # pool page ids (paged admission only)
 
 
 class DecodeEngine(object):
@@ -128,18 +149,26 @@ class DecodeEngine(object):
     end_id : int or None
         Token that retires a slot early; None decodes to the
         per-request ``max_new_tokens`` only.
-    admission : 'continuous' | 'stop_and_wait'
+    admission : 'continuous' | 'stop_and_wait' | 'paged'
         Continuous admits into free slots every step boundary;
         stop_and_wait only refills once EVERY slot retired (the
-        baseline policy).
+        baseline policy); paged is continuous admission gated on page
+        allocation from ``page_pool`` (see module doc).
+    page_pool : paddle_tpu.kvcache.PagePool, required when paged
+        The shared KV page pool the cell's pool tensors live in. The
+        engine owns its pages for the lifetime of each sequence;
+        ``page_pool.nbytes`` is what a fleet placement should declare
+        as ``kv_bytes`` to the :class:`~paddle_tpu.fleet.router.
+        PlacementBudget`.
     """
 
     def __init__(self, cell_fn, state_specs, slots=8, max_len=64,
                  end_id=None, init_id=1, place=None, partitioner=None,
-                 seed=0, admission='continuous'):
-        if admission not in ('continuous', 'stop_and_wait'):
-            raise ValueError("admission must be 'continuous' or "
-                             "'stop_and_wait', got %r" % admission)
+                 seed=0, admission='continuous', page_pool=None):
+        if admission not in ('continuous', 'stop_and_wait', 'paged'):
+            raise ValueError("admission must be 'continuous', "
+                             "'stop_and_wait' or 'paged', got %r"
+                             % admission)
         if slots < 1:
             raise ValueError('slots must be >= 1')
         self.slots = int(slots)
@@ -148,6 +177,18 @@ class DecodeEngine(object):
         self.init_id = int(init_id)
         self.admission = admission
         self.place = place or _places.CPUPlace()
+        self.pool = page_pool
+        self.max_pages = 0
+        if admission == 'paged':
+            if page_pool is None:
+                raise ValueError("admission='paged' needs a page_pool")
+            if self.max_len % page_pool.page_size != 0:
+                raise ValueError(
+                    'max_len (%d) must be a multiple of the pool page '
+                    'size (%d)' % (self.max_len, page_pool.page_size))
+            self.max_pages = self.max_len // page_pool.page_size
+        elif page_pool is not None:
+            raise ValueError("page_pool requires admission='paged'")
         self.specs = []
         for spec in state_specs:
             name, shape = spec[0], tuple(int(d) for d in spec[1])
@@ -163,10 +204,20 @@ class DecodeEngine(object):
         self._states = {
             name: np.zeros((S,) + shape, dtype=dtype)
             for name, shape, dtype in self.specs}
+        if admission == 'paged':
+            # per-slot block-table rows + this step's write coordinates
+            # (a dead slot writes to page == num_pages: out of range,
+            # so its one-hot row is all zeros and nothing lands)
+            self._tables = np.zeros((S, self.max_pages), dtype=np.int64)
+            self._page = np.full((S, 1), self.pool.num_pages,
+                                 dtype=np.int64)
+            self._off = np.zeros((S, 1), dtype=np.int64)
         self._table = [None] * S          # slot index -> _Slot | None
         self._pending = collections.deque()
         self._cond = threading.Condition()
         self._closed = False
+        self._abort = False
+        self._blocked = False             # paged backpressure latch
         # stats (worker-thread only; snapshot via stats())
         self._steps = 0
         self._slot_steps = 0              # sum of live slots over steps
@@ -177,6 +228,12 @@ class DecodeEngine(object):
         self._g_occ = reg.gauge(
             'decode_slot_occupancy',
             'live fraction of the decode engine slot table')
+        self._g_frag = None
+        if admission == 'paged':
+            self._g_frag = reg.gauge(
+                'kvcache_pool_fragmentation',
+                'internal fragmentation of allocated KV pages: '
+                '1 - written_rows / (allocated_pages * page_size)')
         self._worker = threading.Thread(target=self._loop,
                                         name='decode-engine', daemon=True)
         self._worker.start()
@@ -185,6 +242,7 @@ class DecodeEngine(object):
     def _build(self, cell_fn, seed):
         self._main, self._startup = Program(), Program()
         self._startup.random_seed = seed
+        paged = self.admission == 'paged'
         with program_guard(self._main, self._startup):
             with unique_name.guard():
                 ids = layers.data(name='dec_ids', shape=[1],
@@ -196,7 +254,35 @@ class DecodeEngine(object):
                     states[name] = layers.data(
                         name='dec_state_%s' % name, shape=list(shape),
                         dtype=dtype)
-                probs, new_states = cell_fn(ids, states, pos)
+                if paged:
+                    # the pool tensors are whole-program operands (no
+                    # batch dim): fed and fetched like decode state,
+                    # but shared by every slot through the block table
+                    pools = {}
+                    for name, shape, dtype in self.pool.specs:
+                        pools[name] = layers.data(
+                            name='kv_pool_%s' % name,
+                            shape=[self.pool.num_pages,
+                                   self.pool.page_size] + list(shape),
+                            dtype=dtype, append_batch_size=False)
+                    table = layers.data(name='kv_table',
+                                        shape=[self.max_pages],
+                                        dtype='int64')
+                    page = layers.data(name='kv_page', shape=[1],
+                                       dtype='int64')
+                    off = layers.data(name='kv_off', shape=[1],
+                                      dtype='int64')
+                    probs, new_states, new_pools = cell_fn(
+                        ids, states, pos, pools, table, page, off)
+                    missing = [n for n, _, _ in self.pool.specs
+                               if n not in (new_pools or {})]
+                    if missing:
+                        raise ValueError(
+                            'a paged cell_fn must return a new pool '
+                            'tensor for every pool spec; missing %s'
+                            % missing)
+                else:
+                    probs, new_states = cell_fn(ids, states, pos)
                 missing = [n for n, _, _ in self.specs
                            if n not in (new_states or {})]
                 if missing:
@@ -206,20 +292,54 @@ class DecodeEngine(object):
                 _, next_ids = layers.topk(probs, k=1)
         self._fetch = [next_ids] + [new_states[n]
                                     for n, _, _ in self.specs]
+        if paged:
+            self._fetch += [new_pools[n] for n, _, _ in self.pool.specs]
         self.executor.run(self._startup, scope=self.scope)
 
     # ---- client surface --------------------------------------------------
     def submit(self, init_states=None, max_new_tokens=None,
-               first_id=None):
+               first_id=None, pos0=0, init_pages=None, trace=None):
         """Enqueue one sequence; returns a :class:`DecodeRequest`.
         ``init_states`` maps state name -> per-slot-shaped array
         (missing states start as zeros); ``max_new_tokens`` caps this
-        sequence's emission (default: the engine's ``max_len``)."""
+        sequence's emission (default: the engine's ``max_len``).
+
+        Paged engines additionally accept a prefilled prefix:
+        ``pos0`` positions already written, with their page contents in
+        ``init_pages`` (pool-spec name -> list of
+        ``[page_size, ...]`` arrays covering positions
+        ``[0, pos0)``) — how a prefill replica's KV pages enter this
+        engine (SERVING.md). ``trace`` parents the request's
+        ``decode/request`` span under a caller-owned trace (the
+        prefill->decode hop stays one tree)."""
         mnt = self.max_len if max_new_tokens is None \
             else int(max_new_tokens)
-        if not 1 <= mnt <= self.max_len:
-            raise ValueError('max_new_tokens must be in [1, %d], got %d'
-                             % (self.max_len, mnt))
+        pos0 = int(pos0)
+        if pos0 and self.admission != 'paged':
+            raise ValueError('pos0/init_pages need a paged engine')
+        if not 1 <= mnt or pos0 + mnt > self.max_len:
+            raise ValueError(
+                'pos0 (%d) + max_new_tokens (%d) must fit in '
+                '(0, %d]' % (pos0, mnt, self.max_len))
+        if self.admission == 'paged':
+            from ..kvcache.pool import PoolExhausted
+            need = self.pool.pages_for(pos0 + mnt)
+            if need > self.pool.num_pages:
+                raise PoolExhausted(
+                    'sequence needs %d page(s); the whole pool holds '
+                    '%d — it can never be admitted'
+                    % (need, self.pool.num_pages), needed=need,
+                    free=self.pool.free_pages,
+                    num_pages=self.pool.num_pages)
+            want = self.pool.pages_for(pos0) if pos0 else 0
+            for name, _, _ in self.pool.specs:
+                got = len((init_pages or {}).get(name, ()))
+                if got != want:
+                    raise ValueError(
+                        'init_pages[%r] holds %d page(s); pos0=%d '
+                        'needs %d' % (name, got, pos0, want))
+        elif init_pages:
+            raise ValueError('init_pages need a paged engine')
         inits = {}
         for name, shape, dtype in self.specs:
             if init_states and name in init_states:
@@ -235,9 +355,10 @@ class DecodeEngine(object):
             raise ValueError('unknown init states %s' % sorted(unknown))
         req = DecodeRequest(inits,
                             self.init_id if first_id is None
-                            else int(first_id), mnt)
+                            else int(first_id), mnt,
+                            pos0=pos0, init_pages=init_pages)
         qspan = _obs.start_span('decode/request', activate=False,
-                                max_new_tokens=mnt)
+                                parent=trace, max_new_tokens=mnt)
         if qspan.context is not None:
             req._qspan = qspan
             req.trace = qspan.context
@@ -271,34 +392,65 @@ class DecodeEngine(object):
                 'mean_occupancy': (self._slot_steps /
                                    (steps * self.slots)) if steps
                 else 0.0,
+                'pool': self.pool.stats() if self.pool is not None
+                else None,
             }
 
     def close(self, drain=True, timeout=60.0):
         """Shut down the engine. ``drain=True`` finishes every pending
         and in-flight sequence first; ``drain=False`` fails them with
-        typed :class:`ServerClosed`."""
+        typed :class:`ServerClosed`.
+
+        Either way no future is ever left unresolved: if the drain
+        cannot finish inside ``timeout`` (a wedged step, or a paged
+        request the pool can never serve before shutdown), the
+        leftovers fail typed too and the count is journalled — fleet
+        requeue sees a REQUEUEABLE error, never a hang."""
         with self._cond:
             if self._closed:
                 return
             self._closed = True
             if not drain:
-                failed = list(self._pending)
-                self._pending.clear()
-                for s in self._table:
-                    if s is not None:
-                        if s.span is not None:
-                            s.span.end(error='ServerClosed')
-                        failed.append(s.req)
-                self._table = [None] * self.slots
-                for req in failed:
-                    req.set_error(ServerClosed(
-                        'decode engine closed before the sequence '
-                        'finished'))
+                self._fail_all_locked(ServerClosed(
+                    'decode engine closed before the sequence '
+                    'finished'))
             self._cond.notify_all()
         self._worker.join(timeout)
+        if self._worker.is_alive() or self._pending or \
+                any(s is not None for s in self._table):
+            # the drain did not converge: abort the worker and fail
+            # whatever is still queued or in flight with the typed
+            # error requeue understands, instead of returning with
+            # unresolved futures behind us
+            with self._cond:
+                self._abort = True
+                self._fail_all_locked(ServerClosed(
+                    'decode engine closed before the sequence '
+                    'was admitted or finished (drain timed out '
+                    'after %.1fs)' % timeout))
+                self._cond.notify_all()
         j = _obs.get_journal()
         if j is not None:
             j.flush()   # span_ends for drained sequences hit disk now
+
+    def _fail_all_locked(self, error):
+        """Fail every pending + in-flight request typed (caller holds
+        the cond); journals how many futures were resolved this way."""
+        failed = list(self._pending)
+        self._pending.clear()
+        for s in self._table:
+            if s is not None:
+                if s.span is not None:
+                    s.span.end(error=type(error).__name__)
+                if s.pages:
+                    self.pool.free(s.pages)
+                failed.append(s.req)
+        self._table = [None] * self.slots
+        if failed:
+            _obs.emit('decode', action='close_failed_pending',
+                      count=len(failed), error=type(error).__name__)
+        for req in failed:
+            req.set_error(error)
 
     def __enter__(self):
         return self
@@ -311,9 +463,12 @@ class DecodeEngine(object):
     def _loop(self):
         while True:
             with self._cond:
-                while not self._closed and not self._pending and \
+                while not self._closed and not self._abort and \
+                        not self._pending and \
                         all(s is None for s in self._table):
                     self._cond.wait(0.05)
+                if self._abort:
+                    return
                 if self._closed and not self._pending and \
                         all(s is None for s in self._table):
                     return
@@ -323,25 +478,18 @@ class DecodeEngine(object):
                 self._step(admitted)
             except Exception as e:  # noqa: BLE001 — engine must not die
                 # silently: fail every in-flight/pending future typed.
-                with self._cond:
-                    failed = []
-                    for s in self._table:
-                        if s is not None:
-                            if s.span is not None:
-                                s.span.end(error=type(e).__name__)
-                            failed.append(s.req)
-                    self._table = [None] * self.slots
-                    failed.extend(self._pending)
-                    self._pending.clear()
                 err = e if isinstance(e, ServingError) else \
                     ServingError('decode step failed: %r' % (e,))
-                for req in failed:
-                    req.set_error(err)
+                with self._cond:
+                    self._fail_all_locked(err)
 
     def _admit_locked(self):
         """Move pending requests into free slots (caller holds the
         cond). Continuous mode refills any free slot; stop_and_wait
-        only refills a fully-retired table."""
+        only refills a fully-retired table; paged additionally gates
+        each admission on page allocation — a head-of-line request the
+        free list cannot serve blocks admission (FIFO backpressure,
+        journalled once per stall) until retirements free pages."""
         if self.admission == 'stop_and_wait' and \
                 any(s is not None for s in self._table):
             return 0
@@ -351,8 +499,25 @@ class DecodeEngine(object):
                 break
             if self._table[i] is not None:
                 continue
-            req = self._pending.popleft()
+            req = self._pending[0]
+            pages = None
+            if self.admission == 'paged':
+                from ..kvcache.pool import PoolExhausted
+                need = self.pool.pages_for(req.pos0 +
+                                           req.max_new_tokens)
+                try:
+                    pages = self.pool.alloc(need)
+                except PoolExhausted as e:
+                    if not self._blocked:
+                        self._blocked = True
+                        _obs.emit('kvcache', action='backpressure',
+                                  needed=e.needed, free=e.free,
+                                  pending=len(self._pending))
+                    break
+                self._blocked = False
+            self._pending.popleft()
             slot = _Slot(req)
+            slot.pages = pages
             if req.trace is not None:
                 # queue wait is pre-measured (submit -> admit), so it
                 # journals as a finished span; the slot's lifetime span
@@ -366,11 +531,20 @@ class DecodeEngine(object):
                 slot.span = aspan if aspan.context is not None else None
             self._table[i] = slot
             self._ids[i, 0] = req.first_id
-            self._pos[i, 0] = 0
+            self._pos[i, 0] = req.pos0
             for name, shape, dtype in self.specs:
                 init = req.init_states.get(name)
                 self._states[name][i] = init if init is not None \
                     else np.zeros(shape, dtype=dtype)
+            if pages is not None:
+                self._tables[i] = 0
+                self._tables[i, :len(pages)] = pages
+                if req.init_pages:
+                    # a prefilled prefix: the prompt's KV pages land
+                    # in this engine's pool under the new page ids
+                    for name, _, _ in self.pool.specs:
+                        for k, arr in enumerate(req.init_pages[name]):
+                            self.pool.data[name][pages[k]] = arr
             admitted += 1
         self._admitted += admitted
         return admitted
@@ -398,20 +572,44 @@ class DecodeEngine(object):
                 sspan.end()
 
     def _step_traced(self, live, admitted):
+        paged = self.admission == 'paged'
         feed = {'dec_ids': self._ids, 'dec_pos': self._pos}
         for name, _, _ in self.specs:
             feed['dec_state_%s' % name] = self._states[name]
+        if paged:
+            P = self.pool.page_size
+            for i in range(self.slots):
+                slot = self._table[i]
+                if slot is None:
+                    self._page[i, 0] = self.pool.num_pages  # no write
+                    self._off[i, 0] = 0
+                else:
+                    p = int(self._pos[i, 0])
+                    self._page[i, 0] = self._tables[i, p // P]
+                    self._off[i, 0] = p % P
+            feed['kv_table'] = self._tables
+            feed['kv_page'] = self._page
+            feed['kv_off'] = self._off
+            for name, _, _ in self.pool.specs:
+                feed['kv_pool_%s' % name] = self.pool.data[name]
         outs = self.executor.run(self._main, feed=feed,
                                  fetch_list=self._fetch,
                                  scope=self.scope)
         next_ids = np.asarray(outs[0]).reshape(self.slots, -1)
-        for (name, _, _), out in zip(self.specs, outs[1:]):
+        n_state = len(self.specs)
+        for (name, _, _), out in zip(self.specs, outs[1:1 + n_state]):
             # copy: fetches can be read-only views of device buffers,
             # and admit() writes slot rows in place
             self._states[name] = np.array(out)
+        if paged:
+            for (name, _, _), out in zip(self.pool.specs,
+                                         outs[1 + n_state:]):
+                self.pool.data[name] = np.array(out)
         retired = 0
         for i in live:
             slot = self._table[i]
+            if slot is None:
+                continue        # close() aborted us mid-step
             tok = int(next_ids[i, 0])
             slot.tokens.append(tok)
             self._pos[i, 0] += 1
@@ -423,6 +621,8 @@ class DecodeEngine(object):
                 retired += 1
                 if slot.span is not None:
                     slot.span.end(tokens=len(slot.tokens))
+                if slot.pages:
+                    self.pool.free(slot.pages)
                 slot.req.set_result(
                     np.asarray(slot.tokens, dtype=np.int64))
             else:
@@ -432,9 +632,22 @@ class DecodeEngine(object):
         self._retired += retired
         occupancy = len(live) / float(self.slots)
         self._g_occ.set(occupancy)
+        extra = {}
+        if paged:
+            rows = pages = 0
+            for i, s in enumerate(self._table):
+                if s is not None:
+                    rows += int(self._pos[i, 0])
+                    pages += len(s.pages)
+            frag = 1.0 - rows / float(pages * self.pool.page_size) \
+                if pages else 0.0
+            self._g_frag.set(frag)
+            extra = {'resident': len(live),
+                     'pool_used': self.pool.used_pages,
+                     'fragmentation': round(frag, 4)}
         _obs.emit('decode', step=self._steps, live=len(live),
                   admitted=admitted, retired=retired,
-                  occupancy=round(occupancy, 4))
+                  occupancy=round(occupancy, 4), **extra)
 
 
 # ---- stock cells ---------------------------------------------------------

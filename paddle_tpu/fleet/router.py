@@ -100,7 +100,11 @@ class PlacementBudget(object):
         return _perf.book()
 
     def demand(self, rec):
-        """``(hbm_bytes, mfu)`` demand of one placement record."""
+        """``(hbm_bytes, mfu)`` demand of one placement record.
+        ``kv_bytes`` — a paged engine's KV page-pool footprint
+        (``PagePool.nbytes``) — rides on top of the model's own hbm
+        demand: pool pages are committed for the replica's lifetime,
+        not per request, so placement must budget them like weights."""
         hbm, mfu = rec.get('hbm_bytes'), rec.get('mfu')
         if hbm is None or mfu is None:
             book = self._ledgers()
@@ -117,7 +121,8 @@ class PlacementBudget(object):
                 hbm = led_hbm
             if mfu is None:
                 mfu = led_mfu
-        return float(hbm or 0.0), float(mfu or 0.0)
+        return float(hbm or 0.0) + float(rec.get('kv_bytes') or 0.0), \
+            float(mfu or 0.0)
 
     def check(self, name, rec, rid, usage_hbm, usage_mfu):
         """Raise :class:`PlacementInfeasible` (naming the exceeded
@@ -144,7 +149,7 @@ class PlacementBudget(object):
 
 class _Replica(object):
     __slots__ = ('id', 'server', 'state', 'generation', 'restarts',
-                 'unhealthy_polls')
+                 'unhealthy_polls', 'role')
 
     def __init__(self, rid, server):
         self.id = rid
@@ -153,6 +158,11 @@ class _Replica(object):
         self.generation = 0
         self.restarts = 0
         self.unhealthy_polls = 0
+        # placement role: 'serve' (ModelServer) or 'prefill'
+        # (kvcache.PrefillServer / a remote cell spawned with
+        # kind='prefill') — role-tagged placements only ring over
+        # replicas whose cells match
+        self.role = getattr(server, 'role', 'serve')
 
 
 class RoutedRequest(object):
@@ -378,15 +388,23 @@ class Router(object):
         return c
 
     # ---- placement -------------------------------------------------------
-    def _place_ids(self, name, ids=None):
+    def _place_ids(self, name, ids=None, role=None):
         """Deterministic ring placement: ``replication`` consecutive
         replica ids starting at hash(name) — the same model name lands
         on the same replicas every time (sticky placement) for a given
         replica set; scale-out/scale-in re-derives the ring over the
         new set (:meth:`_rebalance`). ``ids`` overrides the live set
-        for what-if simulation (:meth:`can_retire`)."""
+        for what-if simulation (:meth:`can_retire`). ``role`` narrows
+        the ring to replicas whose cell carries that role — how
+        prefill placements land only on prefill replicas."""
         if ids is None:
             ids = sorted(self._replicas)
+        if role is not None:
+            ids = [rid for rid in ids
+                   if rid in self._replicas
+                   and self._replicas[rid].role == role]
+        if not ids:
+            return []
         k = min(self.replication or len(ids), len(ids))
         start = _ring_hash(name) % len(ids)
         return [ids[(start + i) % len(ids)] for i in range(k)]
@@ -415,23 +433,28 @@ class Router(object):
 
     def load_model(self, name, dirname, model_filename=None,
                    params_filename=None, warmup=None, hbm_bytes=None,
-                   mfu=None, fingerprints=()):
+                   mfu=None, fingerprints=(), kv_bytes=None):
         """Place + load a ``save_inference_model`` artifact on the
         model's replica ring. Dead/restarting replicas are skipped —
         the restart replay loads the recorded artifact into them.
         ``hbm_bytes``/``mfu`` declare the model's resource demand for
         the placement budget; ``fingerprints`` instead derives it from
-        the perf observatory's ledgers for those programs."""
+        the perf observatory's ledgers for those programs;
+        ``kv_bytes`` adds a paged engine's page-pool footprint on top
+        (committed for the replica's lifetime, budgeted like
+        weights)."""
         rec = {'kind': 'artifact', 'dirname': dirname,
                'model_filename': model_filename,
                'params_filename': params_filename,
                'warmup': self.warmup_on_load if warmup is None
                else warmup, 'hbm_bytes': hbm_bytes, 'mfu': mfu,
-               'fingerprints': tuple(fingerprints)}
+               'fingerprints': tuple(fingerprints),
+               'kv_bytes': kv_bytes}
         return self._place(name, rec)
 
     def register_model(self, name, builder, warmup=None,
-                       hbm_bytes=None, mfu=None, fingerprints=()):
+                       hbm_bytes=None, mfu=None, fingerprints=(),
+                       kv_bytes=None):
         """Place an in-memory model: ``builder()`` must return a fresh
         ``(program, feed_names, fetch_vars, scope)`` tuple per call —
         each replica (and each restart) gets its own scope, because
@@ -439,7 +462,26 @@ class Router(object):
         rec = {'kind': 'builder', 'builder': builder,
                'warmup': self.warmup_on_load if warmup is None
                else warmup, 'hbm_bytes': hbm_bytes, 'mfu': mfu,
-               'fingerprints': tuple(fingerprints)}
+               'fingerprints': tuple(fingerprints),
+               'kv_bytes': kv_bytes}
+        return self._place(name, rec)
+
+    def register_prefill(self, name, spec, warmup=None, hbm_bytes=None,
+                         mfu=None, kv_bytes=None):
+        """Place a prompt-ingestion model on the fleet's
+        ``role='prefill'`` replicas (SERVING.md "Paged KV-cache &
+        disaggregated prefill"). ``spec`` is the declarative cell dict
+        (:func:`paddle_tpu.kvcache.stock_spec`) — plain picklable
+        data, so the placement record replays onto restarted replicas
+        and ships over the remote-cell protocol unchanged. Routing,
+        requeue-on-failure, budget admission and the restart replay
+        all work exactly as for serve placements; only the ring is
+        narrowed to prefill replicas."""
+        rec = {'kind': 'prefill', 'spec': dict(spec),
+               'role': 'prefill',
+               'warmup': self.warmup_on_load if warmup is None
+               else warmup, 'hbm_bytes': hbm_bytes, 'mfu': mfu,
+               'fingerprints': (), 'kv_bytes': kv_bytes}
         return self._place(name, rec)
 
     def _place(self, name, rec):
@@ -448,7 +490,11 @@ class Router(object):
         with self._lock:
             if self._closed:
                 raise ServerClosed('router is shut down')
-            ids = self._place_ids(name)
+            ids = self._place_ids(name, role=rec.get('role'))
+            if not ids:
+                raise NoHealthyReplica(
+                    'model %r needs a replica with role %r — the '
+                    'fleet has none' % (name, rec.get('role')))
             # budget admission BEFORE committing the record: an
             # infeasible model must leave no trace (typed error, no
             # partial placement, no OOM at serve time)
@@ -475,6 +521,8 @@ class Router(object):
             server.load_model(name, rec['dirname'],
                               model_filename=rec['model_filename'],
                               params_filename=rec['params_filename'])
+        elif rec['kind'] == 'prefill':
+            server.register_prefill(name, rec['spec'])
         else:
             program, feed_names, fetch_vars, scope = rec['builder']()
             server.register_model(name, program, feed_names,
@@ -564,12 +612,15 @@ class Router(object):
             'model %r: no routable replica (placed on %s)'
             % (name, self.placement(name)))
 
-    def submit(self, name, feeds, deadline=None, sticky_key=None):
+    def submit(self, name, feeds, deadline=None, sticky_key=None,
+               trace=None):
         """Route one request; returns a :class:`RoutedRequest`.
         ``deadline`` is relative seconds covering the whole fleet-side
         lifetime (requeues included). ``sticky_key`` biases routing to
         a stable replica for that key (cache affinity) without
-        sacrificing failover."""
+        sacrificing failover. ``trace`` parents the fleet-side span
+        under a caller-held one (a DisaggregatedDecoder keeps the
+        prefill hop and the decode leg in one tree this way)."""
         with self._lock:
             if self._closed:
                 raise ServerClosed('router is shut down')
@@ -578,7 +629,7 @@ class Router(object):
         # the whole fleet-side lifetime (attempts + requeue hops) is
         # ONE root span; every replica attempt parents under it
         span = _obs.start_span('fleet/request', activate=False,
-                               model=name)
+                               parent=trace, model=name)
         if span.context is None:
             span = None
         try:
@@ -686,6 +737,7 @@ class Router(object):
                 self._load_into(server, name, rec)
             with self._lock:
                 rep.server = server
+                rep.role = getattr(server, 'role', 'serve')
                 rep.generation += 1
                 rep.restarts += 1
             self._set_state(rep, ACTIVE, reason='restarted')
@@ -799,10 +851,20 @@ class Router(object):
                 return False, ('%d replica(s) is the floor for '
                                'replication=%s'
                                % (floor, self.replication))
+            survivors = sorted(i for i in self._replicas if i != rid)
+            # role routability: a role-tagged placement must keep at
+            # least one replica of its role among the survivors
+            for name, rec in self._placements.items():
+                role = rec.get('role')
+                if role is not None and not self._place_ids(
+                        name, ids=survivors, role=role):
+                    return False, (
+                        'model %r needs a replica with role %r and '
+                        '%d is the last one' % (name, role, rid))
             if self.placement_budget is not None:
-                survivors = sorted(i for i in self._replicas
-                                   if i != rid)
-                sim = {n: self._place_ids(n, ids=survivors)
+                sim = {n: self._place_ids(
+                    n, ids=survivors,
+                    role=self._placements[n].get('role'))
                        for n in self._placements}
                 for name, rec in self._placements.items():
                     added = [i for i in sim[name]
@@ -828,7 +890,10 @@ class Router(object):
                 return
             for name, rec in sorted(self._placements.items()):
                 old_ids = list(rec['ids'])
-                new_ids = self._place_ids(name)
+                new_ids = self._place_ids(name, role=rec.get('role'))
+                if not new_ids:
+                    continue   # no replica of this role left: keep
+                    # the old ring; routing fails typed meanwhile
                 if new_ids == old_ids:
                     continue
                 added = [i for i in new_ids if i not in old_ids]

@@ -64,8 +64,14 @@ def _recv_msg(sock):
 
 
 # ---- worker side ---------------------------------------------------------
-def serve(port_file, place=None):
-    """Worker-process main loop: one ModelServer, one connection.
+def serve(port_file, place=None, kind='serve'):
+    """Worker-process main loop: one server cell, one connection.
+
+    ``kind`` picks the cell behind the protocol: ``'serve'`` is a
+    plain ModelServer; ``'prefill'`` a
+    :class:`~paddle_tpu.kvcache.prefill.PrefillServer` (prompt
+    ingestion for disaggregated decode — the generic ``getattr``
+    dispatch below covers its ``register_prefill`` op unchanged).
 
     Binds 127.0.0.1:0, publishes the port atomically through
     ``port_file``, serves requests until ``close`` or EOF. ``submit``
@@ -83,8 +89,15 @@ def serve(port_file, place=None):
     if jpath:
         jnl = _obs.RunJournal(jpath)
         _obs.set_journal(jnl)
-    from ..serving import ModelServer
-    srv = ModelServer(place=place)
+    if kind == 'prefill':
+        from ..kvcache.prefill import PrefillServer
+        srv = PrefillServer(place=place)
+    elif kind == 'serve':
+        from ..serving import ModelServer
+        srv = ModelServer(place=place)
+    else:
+        raise ValueError("cell kind must be 'serve' or 'prefill', "
+                         'got %r' % (kind,))
     lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     lsock.bind(('127.0.0.1', 0))
     lsock.listen(1)
@@ -201,6 +214,9 @@ class RemoteCell(object):
     def __init__(self, proc, sock, name='remote-cell'):
         self.proc = proc
         self.name = name
+        self.role = 'serve'        # spawn_cell sets 'prefill' for a
+        # kind='prefill' worker; the Router's role-aware placement
+        # reads it off the cell like any in-process server
         self.journal_path = None   # set by spawn_cell when tracing
         self._sock = sock
         self._send_lock = threading.Lock()
@@ -290,6 +306,12 @@ class RemoteCell(object):
                           model_filename=model_filename,
                           params_filename=params_filename)
 
+    def register_prefill(self, name, spec):
+        """Prefill-cell op: build the engine for ``name`` from its
+        declarative spec dict in the worker process (the spec is plain
+        data, so it pickles through the protocol untouched)."""
+        return self._call('register_prefill', name, spec)
+
     def unload_model(self, name, timeout=None):
         return self._call('unload_model', name, timeout=timeout)
 
@@ -338,10 +360,13 @@ class RemoteCell(object):
 
 
 def spawn_cell(name='remote-cell', devices=1, env=None,
-               startup_timeout=180.0):
+               startup_timeout=180.0, kind='serve'):
     """Start a cell worker process and connect to it. The child forces
     the CPU backend with ``devices`` host devices (same recipe as the
-    test workers); the parent blocks until the port file appears."""
+    test workers); the parent blocks until the port file appears.
+    ``kind='prefill'`` runs a prefill cell (prompt ingestion) instead
+    of a ModelServer — the returned proxy carries ``role='prefill'``
+    so the Router pins prefill placements to it."""
     workdir = tempfile.mkdtemp(prefix='ptpu_cell_')
     port_file = os.path.join(workdir, 'port')
     child_env = dict(os.environ)
@@ -368,7 +393,8 @@ def spawn_cell(name='remote-cell', devices=1, env=None,
                   if p])
     proc = subprocess.Popen(
         [sys.executable, '-m', 'paddle_tpu.multihost.remote',
-         '--port-file', port_file], env=child_env)
+         '--port-file', port_file, '--cell-kind', kind],
+        env=child_env)
     deadline = time.monotonic() + startup_timeout
     while not os.path.exists(port_file):
         if proc.poll() is not None:
@@ -386,6 +412,7 @@ def spawn_cell(name='remote-cell', devices=1, env=None,
     sock = socket.create_connection(('127.0.0.1', port), timeout=30.0)
     sock.settimeout(None)
     cell = RemoteCell(proc, sock, name=name)
+    cell.role = kind
     cell.journal_path = journal_path
     return cell
 
@@ -395,8 +422,10 @@ def _main(argv=None):
     parser = argparse.ArgumentParser(
         description='paddle_tpu remote serving cell worker')
     parser.add_argument('--port-file', required=True)
+    parser.add_argument('--cell-kind', default='serve',
+                        choices=('serve', 'prefill'))
     args = parser.parse_args(argv)
-    serve(args.port_file)
+    serve(args.port_file, kind=args.cell_kind)
     return 0
 
 

@@ -238,6 +238,22 @@ class Partitioner(object):
             return None
         return (None,) * d + (axis,)
 
+    def kv_pool_spec(self, shape, axis='dp'):
+        """The spec a paged KV pool tensor (``[num_pages, page_size,
+        ...feature]``) shards under: the PAGE axis (dim 0), or None
+        (replicated) when ``num_pages`` does not divide the mesh
+        extent. Pages are independent allocation granules — no op
+        reads across page ids except the block-table gather — so the
+        page dim is the only safe one to cut; feature dims stay whole
+        because the paged cell's scatter-add and gather address them
+        densely."""
+        extent = self.axis_extent(axis)
+        if extent <= 1:
+            return None
+        if not shape or int(shape[0]) % extent != 0:
+            return None
+        return (axis,)
+
     def named_sharding(self, spec=()):
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.mesh, P(*spec))

@@ -111,3 +111,9 @@ def pytest_configure(config):
         'cost/memory ledgers on the compile-miss path, MFU/roofline '
         'math, the PerfBaseline regression sentinel, tools/'
         'perf_report.py (tier-1; filter with -m "not perfobs")')
+    config.addinivalue_line(
+        'markers',
+        'kvcache: tests of the paged KV-cache subsystem — PagePool '
+        'allocator, paged-attention bit-identity, admission '
+        'backpressure, prefill engine/server, disaggregated '
+        'prefill->decode (tier-1; filter with -m "not kvcache")')

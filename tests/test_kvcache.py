@@ -1,0 +1,352 @@
+"""paddle_tpu.kvcache: paged KV-cache pool, paged attention,
+disaggregated prefill (SERVING.md "Paged KV-cache & disaggregated
+prefill").
+
+Acceptance pins (ISSUE 17):
+- the PagePool allocator is FIFO, all-or-nothing, typed on exhaustion,
+  and keeps ``used + free == num_pages`` through ragged schedules;
+- paged decode is bit-identical to the PR 9 slotted engine AND to a
+  per-sequence (slots=1) decode on the same ragged set;
+- admission under an exhausted pool is backpressure (the request
+  waits, journalled), never a drop;
+- ``DecodeEngine.close()`` fails queued-but-unadmitted requests with
+  typed ``ServerClosed`` and journals the count;
+- a prefill replica's pages hand off into a decode engine that
+  continues bit-identical to the slotted oracle, locally, through the
+  Router (role-routed placement, one prefill replica killed mid-run)
+  and over the remote-cell protocol;
+- ``PlacementBudget`` folds engine KV bytes into the hbm axis;
+  ``Partitioner.kv_pool_spec`` shards the page axis only.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.kvcache as kvc
+from paddle_tpu import observability as obs
+from paddle_tpu.fleet.decode import DecodeEngine, attention_history_cell
+from paddle_tpu.fleet.errors import NoHealthyReplica, PlacementInfeasible
+from paddle_tpu.fleet.router import PlacementBudget, Router
+from paddle_tpu.kvcache import BlockTable, PagePool, PoolExhausted
+from paddle_tpu.serving import ModelServer
+from paddle_tpu.serving.errors import ServerClosed
+
+pytestmark = pytest.mark.kvcache
+
+DICT, WORD, HID, L = 40, 16, 16, 16
+PS, NP = 4, 16
+SEED = 3
+
+
+def _spec(**kw):
+    base = dict(word_dim=WORD, hidden=HID, max_len=L, page_size=PS,
+                num_pages=NP, seed=SEED)
+    base.update(kw)
+    return kvc.stock_spec(DICT, **base)
+
+
+def _slotted(slots):
+    cell, specs = attention_history_cell(DICT, word_dim=WORD,
+                                         hidden=HID, max_len=L)
+    return DecodeEngine(cell, specs, slots=slots, max_len=L, seed=SEED)
+
+
+def _ragged(n, seed=SEED):
+    rng = np.random.RandomState(seed)
+    lengths = [int(rng.randint(1, 6)) for _ in range(n)]
+    for i in range(0, n, 6):
+        lengths[i] = L // 2
+    firsts = [int(rng.randint(1, DICT)) for _ in range(n)]
+    return lengths, firsts
+
+
+def _run(eng, lengths, firsts):
+    reqs = [eng.submit(first_id=f, max_new_tokens=m)
+            for f, m in zip(firsts, lengths)]
+    return [r.result(timeout=120.0) for r in reqs]
+
+
+# ---- allocator -----------------------------------------------------------
+def test_pool_alloc_is_fifo_and_reuses_oldest_free():
+    pool = PagePool([('kv', [WORD])], num_pages=8, page_size=PS)
+    assert pool.alloc(3) == [0, 1, 2]
+    assert pool.alloc(2) == [3, 4]
+    pool.free([2, 0])
+    pool.free([1])
+    # the remaining original tail first, then freed pages in free order
+    assert pool.alloc(6) == [5, 6, 7, 2, 0, 1]
+    assert pool.free_pages == 0
+
+
+def test_pool_exhausted_is_typed_and_all_or_nothing():
+    pool = PagePool([('kv', [WORD])], num_pages=4, page_size=PS)
+    pool.alloc(3)
+    with pytest.raises(PoolExhausted) as ei:
+        pool.alloc(2)
+    assert ei.value.needed == 2
+    assert ei.value.free == 1
+    assert ei.value.num_pages == 4
+    # the failed grab took nothing: the last page is still allocatable
+    assert pool.alloc(1) == [3]
+
+
+def test_pool_free_validates_range_and_double_free():
+    pool = PagePool([('kv', [WORD])], num_pages=4, page_size=PS)
+    pages = pool.alloc(2)
+    with pytest.raises(ValueError):
+        pool.free([7])
+    pool.free(pages)
+    with pytest.raises(ValueError):
+        pool.free([pages[0]])
+
+
+def test_pool_zeroes_pages_on_alloc():
+    pool = PagePool([('kv', [WORD])], num_pages=4, page_size=PS)
+    pages = pool.alloc(2)
+    pool.data['kv'][pages] = 7.0
+    pool.free(pages)
+    again = pool.alloc(4)
+    assert set(again) >= set(pages)
+    assert not pool.data['kv'].any()
+
+
+def test_pool_invariants_under_ragged_schedule():
+    pool = PagePool([('kv', [WORD]), ('h', [HID])], num_pages=NP,
+                    page_size=PS)
+    rng = np.random.RandomState(0)
+    held = []
+    for _ in range(200):
+        if held and (rng.rand() < 0.4 or pool.free_pages == 0):
+            pool.free(held.pop(rng.randint(len(held))))
+        else:
+            n = int(rng.randint(1, 4))
+            try:
+                held.append(pool.alloc(n))
+            except PoolExhausted:
+                assert pool.free_pages < n
+        assert pool.used_pages + pool.free_pages == NP
+    st = pool.stats()
+    assert st['peak_used_pages'] <= NP
+    assert st['allocs'] >= 1 and st['frees'] >= 1
+    assert st['nbytes'] == pool.nbytes
+    assert pool.nbytes == NP * pool.page_bytes
+    assert pool.pages_for(1) == 1
+    assert pool.pages_for(PS) == 1
+    assert pool.pages_for(PS + 1) == 2
+
+
+def test_pool_journal_events(tmp_path):
+    path = str(tmp_path / 'run.jsonl')
+    pool = PagePool([('kv', [WORD])], num_pages=4, page_size=PS)
+    with obs.journal(path):
+        pool.free(pool.alloc(2))
+    records, _ = obs.read_journal(path)
+    kv = [r for r in records if r['ev'] == 'kvcache']
+    assert [r['action'] for r in kv] == ['alloc', 'free']
+    assert kv[0]['pages'] == 2 and kv[0]['used'] == 2
+    assert kv[1]['free'] == 4
+
+
+def test_block_table_row_and_addressing():
+    bt = BlockTable([5, 2, 9], page_size=PS)
+    assert len(bt) == 3 and bt.capacity() == 3 * PS
+    assert bt.page_for(0) == 5 and bt.page_for(PS) == 2
+    assert bt.page_for(2 * PS + 1) == 9 and bt.offset(2 * PS + 1) == 1
+    row = bt.row(5, pad=0)
+    assert row.dtype == np.int64
+    assert list(row) == [5, 2, 9, 0, 0]
+    with pytest.raises(ValueError):
+        bt.row(2)
+
+
+# ---- paged decode bit-identity ------------------------------------------
+def test_paged_decode_bit_identical_to_slotted_and_per_sequence():
+    lengths, firsts = _ragged(18)
+    eng = _slotted(4)
+    slotted = _run(eng, lengths, firsts)
+    eng.close()
+    eng, _pool = kvc.make_paged_engine(_spec(), slots=8)
+    paged = _run(eng, lengths, firsts)
+    assert eng.stats()['pool']['used_pages'] == 0   # all pages returned
+    eng.close()
+    for a, b in zip(paged, slotted):
+        assert np.array_equal(a, b)
+    eng = _slotted(1)
+    per_seq = _run(eng, lengths, firsts)
+    eng.close()
+    for a, b in zip(paged, per_seq):
+        assert np.array_equal(a, b)
+
+
+def test_paged_admission_backpressures_and_completes(tmp_path):
+    # 8 pages x 4 positions = 2 resident max-length sequences; 6
+    # submitted: admission MUST wait for retirements (backpressure),
+    # and every sequence still completes bit-identical to per-sequence
+    path = str(tmp_path / 'run.jsonl')
+    lengths = [L] * 6
+    firsts = list(range(1, 7))
+    with obs.journal(path):
+        eng, _pool = kvc.make_paged_engine(_spec(num_pages=8), slots=4)
+        paged = _run(eng, lengths, firsts)
+        eng.close()
+    records, _ = obs.read_journal(path)
+    bp = [r for r in records if r['ev'] == 'kvcache' and
+          r['action'] == 'backpressure']
+    assert bp, 'exhausted pool admitted without a backpressure event'
+    eng = _slotted(1)
+    per_seq = _run(eng, lengths, firsts)
+    eng.close()
+    for a, b in zip(paged, per_seq):
+        assert np.array_equal(a, b)
+
+
+def test_submit_rejects_sequence_that_can_never_fit():
+    eng, _pool = kvc.make_paged_engine(_spec(num_pages=2), slots=2)
+    try:
+        with pytest.raises(PoolExhausted) as ei:
+            eng.submit(first_id=1, max_new_tokens=L)
+        assert ei.value.needed == L // PS
+        assert ei.value.num_pages == 2
+    finally:
+        eng.close()
+
+
+def test_close_fails_unadmitted_requests_typed_and_journals(tmp_path):
+    # the pool holds exactly one max-length sequence; the second
+    # request is queued-but-unadmitted when close() lands
+    path = str(tmp_path / 'run.jsonl')
+    with obs.journal(path):
+        eng, _pool = kvc.make_paged_engine(_spec(num_pages=4), slots=2)
+        first = eng.submit(first_id=1, max_new_tokens=L)
+        blocked = eng.submit(first_id=2, max_new_tokens=L)
+        eng.close(drain=False)
+        for req in (first, blocked):
+            with pytest.raises(ServerClosed):
+                req.result(timeout=30.0)
+    records, _ = obs.read_journal(path)
+    closed = [r for r in records if r['ev'] == 'decode' and
+              r['action'] == 'close_failed_pending']
+    assert closed and closed[0]['count'] == 2
+    assert closed[0]['error'] == 'ServerClosed'
+
+
+# ---- prefill handoff -----------------------------------------------------
+def test_prefill_handoff_matches_slotted_oracle():
+    eng = _slotted(2)
+    oracle = {p: eng.decode(first_id=p, max_new_tokens=10,
+                            timeout=120.0) for p in (1, 9)}
+    eng.close()
+    pe = kvc.PrefillEngine(_spec())
+    eng, _pool = kvc.make_paged_engine(_spec(), slots=4)
+    try:
+        for p, want in oracle.items():
+            for k in (1, 3, 6):   # prompt = id + greedy prefix
+                prompt = np.concatenate([[p], want[:k - 1]])
+                r = pe.prefill(prompt)
+                assert r['pos0'] == k
+                assert r['next_id'] == int(want[k - 1])
+                got = eng.submit(
+                    init_states=r['states'], init_pages=r['pages'],
+                    pos0=r['pos0'], first_id=r['next_id'],
+                    max_new_tokens=10 - k).result(timeout=120.0)
+                assert np.array_equal(
+                    np.concatenate([[r['next_id']], got]), want[k - 1:])
+    finally:
+        eng.close()
+
+
+def test_prefill_server_close_resolves_every_future_typed():
+    srv = kvc.PrefillServer()
+    spec = _spec()
+    srv.register_prefill('pf', spec)
+    assert srv.role == 'prefill'
+    assert srv.health()['models']['pf']['state'] == 'ready'
+    reqs = [srv.submit('pf', {'prompt_ids': [1, 5]}) for _ in range(4)]
+    srv.close()
+    done = failed = 0
+    for r in reqs:
+        try:
+            out = r.result(timeout=30.0)
+            assert out['pos0'] == 2
+            done += 1
+        except ServerClosed:
+            failed += 1
+    assert done + failed == 4
+    with pytest.raises(ServerClosed):
+        srv.submit('pf', {'prompt_ids': [1]})
+
+
+# ---- disaggregated prefill through the Router ---------------------------
+def _role_factory(spec):
+    def factory(rid):
+        if rid < 2:
+            return kvc.PrefillServer()
+        return ModelServer()
+    return factory
+
+
+def test_router_role_placement_and_disagg_decode_through_kill():
+    spec = _spec()
+    eng = _slotted(2)
+    oracle = {p: eng.decode(first_id=p, max_new_tokens=8,
+                            timeout=120.0) for p in (1, 7, 13)}
+    eng.close()
+    with Router(_role_factory(spec), replicas=3, replication=2,
+                poll_interval=0.05) as router:
+        ids = router.register_prefill('pf', spec, warmup=False)
+        assert set(ids) <= {0, 1}   # only prefill-role replicas
+        dec = kvc.DisaggregatedDecoder(router, 'pf', spec, slots=4)
+        try:
+            for p in (1, 7):
+                got = dec.decode([p], 8, timeout=120.0)
+                assert np.array_equal(got, oracle[p])
+            router.kill_replica(ids[0])   # requeue or restart: opaque
+            got = dec.decode([13], 8, timeout=120.0)
+            assert np.array_equal(got, oracle[13])
+        finally:
+            dec.close()
+
+
+def test_register_prefill_needs_a_prefill_replica():
+    with Router(lambda rid: ModelServer(), replicas=2,
+                supervise=False) as router:
+        with pytest.raises(NoHealthyReplica) as ei:
+            router.register_prefill('pf', _spec(), warmup=False)
+        assert 'prefill' in str(ei.value)
+
+
+def test_can_retire_refuses_last_prefill_replica():
+    spec = _spec()
+
+    def factory(rid):
+        return kvc.PrefillServer() if rid == 0 else ModelServer()
+
+    with Router(factory, replicas=3, replication=1,
+                supervise=False) as router:
+        router.register_prefill('pf', spec, warmup=False)
+        rid = router.placement('pf')[0]
+        ok, reason = router.can_retire(rid)
+        assert not ok and 'prefill' in reason
+
+
+# ---- placement budget + partitioner --------------------------------------
+def test_placement_budget_folds_kv_bytes_into_hbm():
+    budget = PlacementBudget(hbm_bytes=1000)
+    with pytest.raises(PlacementInfeasible) as ei:
+        budget.check('m', {'hbm_bytes': 500, 'mfu': 0.0,
+                           'kv_bytes': 600}, 0, 0, 0)
+    assert ei.value.demand == 1100.0
+    # without the KV pool the same model fits
+    budget.check('m', {'hbm_bytes': 500, 'mfu': 0.0}, 0, 0, 0)
+
+
+def test_partitioner_kv_pool_spec_cuts_page_axis_only():
+    from paddle_tpu.partition import Partitioner
+    part = Partitioner(num_devices=2)
+    axis = part.mesh.axis_names[0]
+    assert part.kv_pool_spec((NP, PS, WORD), axis=axis) == (axis,)
+    # indivisible page axis and 1-extent meshes replicate
+    assert part.kv_pool_spec((NP + 1, PS, WORD), axis=axis) is None
+    assert part.kv_pool_spec((NP, PS, WORD), axis='nope') is None
+    one = Partitioner(num_devices=1)
+    assert one.kv_pool_spec((NP, PS, WORD),
+                            axis=one.mesh.axis_names[0]) is None

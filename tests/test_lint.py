@@ -137,3 +137,28 @@ def test_rule_jit_on_warmup_path(tmp_path):
     v, _ = lint_repo.lint_file(
         str(p), os.path.join('paddle_tpu', 'fleet', 'autoscaler.py'))
     assert any(x.rule == 'jit-on-warmup-path' for x in v)
+
+
+def test_rule_kv_alloc_outside_pool(tmp_path):
+    """ISSUE 17 satellite: raw numpy KV buffers in serving/ or fleet/
+    dodge the PagePool's kv_bytes accounting; only the kvcache package
+    (and non-KV buffers anywhere) may allocate directly."""
+    src = 'import numpy as np\nkv_cache = np.zeros((4, 8))\n'
+    p = tmp_path / 'mod.py'
+    p.write_text(src)
+    for rel, expect in [
+            (os.path.join('paddle_tpu', 'fleet', 'decode.py'), 1),
+            (os.path.join('paddle_tpu', 'serving', 'server.py'), 1),
+            (os.path.join('paddle_tpu', 'kvcache', 'pool.py'), 0),
+            (os.path.join('paddle_tpu', 'executor.py'), 0)]:
+        v, _ = lint_repo.lint_file(str(p), rel)
+        hits = [x for x in v if x.rule == 'kv-alloc-outside-pool']
+        assert len(hits) == expect, (rel, hits)
+    # non-KV-named buffers in fleet/ are fine; np.empty on a KV name
+    # is not
+    p.write_text('import numpy as np\nscratch = np.zeros((4, 8))\n'
+                 'page_kv = np.empty((2, 2))\n')
+    v, _ = lint_repo.lint_file(
+        str(p), os.path.join('paddle_tpu', 'fleet', 'decode.py'))
+    hits = [x.detail for x in v if x.rule == 'kv-alloc-outside-pool']
+    assert len(hits) == 1 and 'page_kv' in hits[0]

@@ -38,6 +38,15 @@ Two phases, both seeded and deterministic in shape:
    journalled (gated via ``obs_report --require autoscale`` and
    ``--require coldstart``).
 
+5. **Paged KV-cache + disaggregated prefill** (SERVING.md "Paged
+   KV-cache & disaggregated prefill"): the same ragged set decoded
+   paged vs slotted at EQUAL KV bytes must be bit-identical and
+   faster with ~3x the sequences resident; then prompts stream
+   through ``role='prefill'`` replicas into a local paged decode
+   engine with one prefill replica killed mid-load — zero failures,
+   oracle-exact tokens, p99 held, ``obs_report --require kvcache``
+   green, and one trace tree spanning the prefill->decode hop.
+
 ``--smoke`` runs a short schedule of both phases, writes an
 observability journal and validates it via ``obs_report.py --require
 fleet`` AND ``--require tracing`` semantics — including that the
@@ -639,6 +648,253 @@ def run_coldstart_phase(min_speedup=1.5, seed=11):
     }
 
 
+def run_kvcache_phase(seed=3, n_sequences=96, n_prompts=12,
+                      min_speedup=1.0, min_resident_ratio=2.9,
+                      slo_p99=30.0):
+    """Paged KV-cache + disaggregated prefill phase (SERVING.md
+    "Paged KV-cache & disaggregated prefill").
+
+    Part A — paged vs slotted at EQUAL KV bytes: the same ragged
+    sequence set decodes through the PR 9 slotted engine (8 slots x
+    dense ``max_len`` KV) and a paged engine whose page pool holds the
+    same bytes but serves 24 resident sequences. Gates: tokens
+    bit-identical to the slotted engine AND to a per-sequence (slots=1)
+    decode; paged tokens/s beats slotted; sequences-resident capacity
+    ratio exceeds ``min_resident_ratio``.
+
+    Part B — disaggregated prefill as placement: a Router over
+    ``role='prefill'`` replicas plus a serve replica; prompts stream
+    through :class:`DisaggregatedDecoder` (prefill remote-to-the-
+    engine, decode local), one prefill replica is killed mid-load.
+    Gates: every request completes bit-identical to the slotted
+    oracle through the kill; p99 holds; the journal holds the
+    ``kvcache`` events the obs gate requires and a trace tree
+    spanning the prefill->decode hop.
+    """
+    import paddle_tpu.kvcache as kvc
+    from paddle_tpu.fleet import Router
+    from paddle_tpu.fleet.decode import (DecodeEngine,
+                                         attention_history_cell)
+
+    problems = []
+    dict_size, word_dim, hidden, max_len = 64, 16, 32, 32
+    page_size, num_pages = 8, 32
+    slotted_slots, paged_slots = 8, 24
+    # equal KV bytes by construction: 8 slots x 32 positions dense ==
+    # 32 pages x 8 positions pooled
+    assert slotted_slots * max_len == num_pages * page_size
+    spec = kvc.stock_spec(dict_size, word_dim=word_dim, hidden=hidden,
+                          max_len=max_len, page_size=page_size,
+                          num_pages=num_pages, seed=seed)
+    rng = np.random.RandomState(seed)
+    # heavily ragged: mostly short, a half-max straggler per eighth —
+    # the shape where dense per-slot KV strands the most memory (the
+    # slotted engine commits max_len positions per admission either
+    # way; the paged one commits ceil(len/page_size) pages)
+    lengths = [int(rng.randint(1, 7)) for _ in range(n_sequences)]
+    for i in range(0, n_sequences, 8):
+        lengths[i] = max_len // 2
+    firsts = [int(rng.randint(1, dict_size)) for _ in
+              range(n_sequences)]
+
+    def run_slotted(slots):
+        cell, specs = attention_history_cell(
+            dict_size, word_dim=word_dim, hidden=hidden,
+            max_len=max_len)
+        eng = DecodeEngine(cell, specs, slots=slots, max_len=max_len,
+                           seed=seed)
+        eng.decode(first_id=1, max_new_tokens=2)   # warm the compile
+        t0 = time.monotonic()
+        reqs = [eng.submit(first_id=firsts[i],
+                           max_new_tokens=lengths[i])
+                for i in range(n_sequences)]
+        outs = [r.result(timeout=300.0) for r in reqs]
+        wall = time.monotonic() - t0
+        stats = eng.stats()
+        eng.close()
+        return outs, wall, stats
+
+    def run_paged():
+        eng, pool = kvc.make_paged_engine(spec, slots=paged_slots)
+        eng.decode(first_id=1, max_new_tokens=2)   # warm the compile
+        t0 = time.monotonic()
+        reqs = [eng.submit(first_id=firsts[i],
+                           max_new_tokens=lengths[i])
+                for i in range(n_sequences)]
+        outs = [r.result(timeout=300.0) for r in reqs]
+        wall = time.monotonic() - t0
+        stats = eng.stats()
+        eng.close()
+        return outs, wall, stats
+
+    slotted, slotted_wall, slotted_stats = run_slotted(slotted_slots)
+    paged, paged_wall, paged_stats = run_paged()
+    if not all(np.array_equal(a, b) for a, b in zip(paged, slotted)):
+        problems.append('paged decode differs from the slotted engine')
+    # per-sequence reference: one slot at a time
+    per_seq, _, _ = run_slotted(1)
+    if not all(np.array_equal(a, b) for a, b in zip(paged, per_seq)):
+        problems.append('paged decode differs from per-sequence decode')
+
+    tokens = sum(lengths)
+    paged_tps = tokens / paged_wall if paged_wall else 0.0
+    slotted_tps = tokens / slotted_wall if slotted_wall else 0.0
+    speedup = paged_tps / slotted_tps if slotted_tps else 0.0
+    if speedup <= min_speedup:
+        problems.append(
+            'paged decode %.1f tok/s is not faster than slotted '
+            '%.1f tok/s (%.2fx <= %.2fx) at equal KV bytes on a '
+            'ragged length distribution'
+            % (paged_tps, slotted_tps, speedup, min_speedup))
+    resident_ratio = paged_slots / float(slotted_slots)
+    if resident_ratio <= min_resident_ratio:
+        problems.append(
+            'paged engine holds %.1fx the slotted resident sequences '
+            'at equal KV bytes (<= %.1fx bound)'
+            % (resident_ratio, min_resident_ratio))
+
+    # ---- part B: disaggregated prefill through the Router ---------------
+    # slotted oracle for prompt continuations: a greedy prefix of the
+    # slotted decode IS a teacher-forced prompt, so prefilling it must
+    # reproduce the remaining tokens exactly
+    mnt = 12
+    oracle = {}
+    cell, specs = attention_history_cell(dict_size, word_dim=word_dim,
+                                         hidden=hidden, max_len=max_len)
+    with DecodeEngine(cell, specs, slots=4, max_len=max_len,
+                      seed=seed) as eng:
+        for p in range(1, n_prompts + 1):
+            oracle[p] = eng.decode(first_id=p, max_new_tokens=mnt,
+                                   timeout=300.0)
+
+    def factory(rid):
+        if rid < 2:
+            return kvc.PrefillServer()
+        from paddle_tpu.serving import ModelServer
+        return ModelServer()
+
+    results = [None] * n_prompts
+    latencies = [None] * n_prompts
+    router = Router(factory, replicas=3, replication=2,
+                    poll_interval=0.05)
+    with router:
+        pf_ids = router.register_prefill('pf', spec, warmup=False)
+        if not all(router.replica(r).role == 'prefill'
+                   for r in pf_ids):
+            problems.append('prefill model placed on a non-prefill '
+                            'replica: %s' % pf_ids)
+        dec = kvc.DisaggregatedDecoder(router, 'pf', spec,
+                                       slots=paged_slots)
+        dec.decode([1], 2, timeout=120.0)          # warm the compile
+
+        def client(i):
+            # prompt: first token + a greedy prefix of the oracle
+            k = 1 + (i % 4)
+            p = i + 1
+            prompt = np.concatenate([[p], oracle[p][:k - 1]])
+            t0 = time.monotonic()
+            try:
+                out = dec.decode(prompt, mnt - k + 1, timeout=120.0)
+                results[i] = ('ok', out, k)
+            except Exception as e:  # noqa: BLE001 — judged below
+                results[i] = ('error', e, k)
+            latencies[i] = time.monotonic() - t0
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(n_prompts)]
+        for t in threads[:n_prompts // 2]:
+            t.start()
+        # mid-load chaos: yank a prefill replica; routed prompts fail
+        # typed (ServerClosed) and requeue onto the survivor
+        router.kill_replica(pf_ids[0])
+        for t in threads[n_prompts // 2:]:
+            t.start()
+        for t in threads:
+            t.join(240.0)
+        dec.close()
+
+    failed = [repr(r[1]) for r in results if r and r[0] == 'error']
+    if failed:
+        problems.append('disagg request(s) failed through the prefill '
+                        'kill: %s' % failed[:3])
+    hung = sum(1 for r in results if r is None)
+    if hung:
+        problems.append('%d disagg request(s) never resolved' % hung)
+    mismatches = 0
+    for i, r in enumerate(results):
+        if r is None or r[0] != 'ok':
+            continue
+        _, out, k = r
+        if not np.array_equal(out, oracle[i + 1][k - 1:]):
+            mismatches += 1
+    if mismatches:
+        problems.append('%d disagg result(s) differ from the slotted '
+                        'oracle' % mismatches)
+    lats = [l for l in latencies if l is not None]
+    p99 = _percentile(lats, 0.99)
+    if p99 > slo_p99:
+        problems.append('disagg p99 %.3fs exceeds the %.2fs bound '
+                        'through the prefill-replica kill'
+                        % (p99, slo_p99))
+
+    return {
+        'config': {'seed': seed, 'sequences': n_sequences,
+                   'prompts': n_prompts, 'max_len': max_len,
+                   'page_size': page_size, 'num_pages': num_pages,
+                   'slotted_slots': slotted_slots,
+                   'paged_slots': paged_slots, 'tokens': tokens},
+        'paged': {'tokens_per_sec': round(paged_tps, 1),
+                  'steps': paged_stats['steps'],
+                  'pool': paged_stats.get('pool')},
+        'slotted': {'tokens_per_sec': round(slotted_tps, 1),
+                    'steps': slotted_stats['steps']},
+        'decode_paged_speedup': round(speedup, 2),
+        'sequences_resident_ratio': round(resident_ratio, 2),
+        'disagg': {'ok': sum(1 for r in results
+                             if r and r[0] == 'ok'),
+                   'failed': len(failed), 'hung': hung,
+                   'p99_s': round(p99, 4),
+                   'killed_prefill_replica': pf_ids[0]},
+        'problems': problems,
+    }
+
+
+def check_disagg_trace(journal_path):
+    """Tracing gate for the disaggregation phase: at least one
+    ``kvcache/request`` root must reconstruct with BOTH legs under it
+    — the routed prefill (``fleet/request`` parenting a closed
+    ``kvcache/prefill``) and the local continuation
+    (``decode/request``) — one tree spanning the prefill->decode hop.
+    Returns a list of problems."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from trace_report import build_store
+    store = build_store([journal_path])
+    roots = store.by_kind('kvcache/request').get('kvcache/request', [])
+    for sp in roots:
+        kids = [store.spans[c]
+                for c in store.children.get(sp['span'], [])]
+        has_decode = any(k['name'] == 'decode/request' for k in kids)
+        has_prefill = False
+        for hop in kids:
+            if hop['name'] != 'fleet/request':
+                continue
+            under = [store.spans[c]
+                     for c in store.children.get(hop['span'], [])]
+            if any(u['name'] == 'kvcache/prefill' and u['closed']
+                   for u in under):
+                has_prefill = True
+        if has_decode and has_prefill:
+            return []
+    if not roots:
+        return ['tracing: journal holds no kvcache/request span — '
+                'the disaggregated path is not traced']
+    return ['tracing: %d kvcache/request span(s) found but none '
+            'reconstructs a full kvcache/request -> {fleet/request '
+            '-> kvcache/prefill, decode/request} tree spanning the '
+            'hop' % len(roots)]
+
+
 def check_requeue_trace(journal_path):
     """Tracing gate for the kill-mid-load smoke: the journal must hold
     at least one requeued request whose span tree reconstructs end to
@@ -688,6 +944,7 @@ def main(argv=None):
     ap.add_argument('--no-decode-phase', action='store_true')
     ap.add_argument('--no-autoscale-phase', action='store_true')
     ap.add_argument('--no-coldstart-phase', action='store_true')
+    ap.add_argument('--no-kvcache-phase', action='store_true')
     ap.add_argument('--smoke', action='store_true',
                     help='short seeded schedule; exit nonzero if any '
                          'fleet or decode invariant breaks')
@@ -742,6 +999,8 @@ def main(argv=None):
                                     max_batch=args.max_batch)
             cold = None if args.no_coldstart_phase else \
                 run_coldstart_phase()
+            kvcache = None if args.no_kvcache_phase else \
+                run_kvcache_phase(seed=3, n_sequences=72, n_prompts=8)
         else:
             fleet = run_fleet_chaos(
                 replicas=args.replicas, n_requests=args.requests,
@@ -758,6 +1017,8 @@ def main(argv=None):
                                     max_batch=args.max_batch)
             cold = None if args.no_coldstart_phase else \
                 run_coldstart_phase()
+            kvcache = None if args.no_kvcache_phase else \
+                run_kvcache_phase(seed=3)
     finally:
         if jctx is not None:
             observability.perf.enable_capture(_perf_prev)
@@ -770,6 +1031,8 @@ def main(argv=None):
         problems += autoscale['problems']
     if cold is not None:
         problems += cold['problems']
+    if kvcache is not None:
+        problems += kvcache['problems']
     if journal_path:
         print('journal written to %s' % journal_path)
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -787,12 +1050,18 @@ def main(argv=None):
         if cold is not None:
             problems += check_journal(journal_path,
                                       require='coldstart')
+        if kvcache is not None:
+            # paged pools + at least one disaggregated prompt must
+            # have journalled, and the prefill->decode hop must leave
+            # one reconstructable trace tree
+            problems += check_journal(journal_path, require='kvcache')
+            problems += check_disagg_trace(journal_path)
         if args.smoke and not args.no_kill:
             problems += check_requeue_trace(journal_path)
 
     results = {'fleet': fleet, 'decode': decode,
                'autoscale': autoscale, 'coldstart': cold,
-               'problems': problems}
+               'kvcache': kvcache, 'problems': problems}
     if args.json:
         with open(args.json, 'w') as f:
             json.dump(results, f, indent=2, sort_keys=True,
@@ -828,6 +1097,16 @@ def main(argv=None):
               '(%.1fx), bit_identical=%s'
               % (cold['cold_warmup_ms'], cold['warm_warmup_ms'],
                  cold['speedup'], cold['bit_identical']))
+    if kvcache is not None:
+        kd = kvcache['disagg']
+        print('kvcache: paged %.1f tok/s vs slotted %.1f tok/s '
+              '(%.2fx) at %.1fx sequences-resident | disagg %d ok '
+              '%d failed through prefill kill, p99 %.0fms'
+              % (kvcache['paged']['tokens_per_sec'],
+                 kvcache['slotted']['tokens_per_sec'],
+                 kvcache['decode_paged_speedup'],
+                 kvcache['sequences_resident_ratio'],
+                 kd['ok'], kd['failed'], kd['p99_s'] * 1e3))
     if problems:
         print('FLEET INVARIANTS BROKEN:', file=sys.stderr)
         for p in problems:

@@ -46,6 +46,14 @@ Rules (each encodes a convention the codebase actually relies on):
   ``Executor.run`` so the ``PTPU_AOT_CACHE`` cold-start store
   (SERVING.md "Self-driving fleet") can serve them; a bypassing jit
   silently turns millisecond warm starts back into recompiles.
+- ``kv-alloc-outside-pool``: a raw numpy buffer allocation
+  (``np.zeros``/``empty``/``full``/``ones``) bound to a KV-named
+  target in ``paddle_tpu/serving/`` or ``paddle_tpu/fleet/`` — KV
+  cache storage is owned by ``paddle_tpu/kvcache/`` (the PagePool),
+  so the placement budget's ``kv_bytes`` axis and the
+  ``kvcache_pool_*`` gauges account every resident KV byte; a
+  side-channel KV buffer is memory the fleet schedules blind to
+  (SERVING.md "Paged KV-cache & disaggregated prefill").
 
 The embedded ``ALLOWLIST`` pins known, accepted occurrences (ratchet
 style): the tool exits nonzero only on violations NOT in the allowlist,
@@ -70,6 +78,12 @@ METRIC_FACTORIES = ('counter', 'histogram', 'gauge')
 # is the one sanctioned compile site (the seal path itself).
 JIT_FORBIDDEN_PACKAGES = ('serving', 'fleet')
 JIT_SANCTIONED = os.path.join('paddle_tpu', 'fleet', 'coldstart.py')
+# packages where KV-cache bytes must come from the kvcache.PagePool
+# (so kv_bytes placement budgeting and the pool gauges see them) —
+# a raw numpy KV buffer here is memory the fleet schedules blind to
+KV_FORBIDDEN_PACKAGES = ('serving', 'fleet')
+KV_ALLOC_FNS = ('zeros', 'empty', 'full', 'ones', 'zeros_like',
+                'empty_like', 'full_like', 'ones_like')
 
 # rule:path:detail -> accepted occurrences. Add entries ONLY with a
 # review note; the lint test pins this set.
@@ -279,6 +293,26 @@ def lint_file(path, relpath):
                     'path must go through Executor.run so the '
                     'PTPU_AOT_CACHE store (fleet/coldstart.py) can '
                     'serve it' % _src(func)))
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr in KV_ALLOC_FNS \
+                and isinstance(node.value.func.value, ast.Name) \
+                and node.value.func.value.id in ('np', 'numpy') \
+                and _package_of(relpath) in KV_FORBIDDEN_PACKAGES:
+            for target in node.targets:
+                if 'kv' in _src(target).lower():
+                    out.append(Violation(
+                        'kv-alloc-outside-pool', relpath, node.lineno,
+                        '%s = np.%s(...): KV buffers come from '
+                        'kvcache.PagePool.alloc() so kv_bytes '
+                        'budgeting and the pool gauges account them'
+                        % (_src(target), node.value.func.attr)))
+                    break
+        if isinstance(node, ast.Call):
+            func = node.func
+            callee = func.attr if isinstance(func, ast.Attribute) \
+                else (func.id if isinstance(func, ast.Name) else None)
             if callee == 'start_span' \
                     and relpath != os.path.join('paddle_tpu',
                                                 'observability',
@@ -352,8 +386,10 @@ def main(argv=None):
     if args.list:
         print('scope: %s' % ', '.join(SCOPE))
         print('rules: bare-except, lock-outside-with, unguarded-emit, '
-              'span-not-ended, direct-cost-analysis, dup-metric-name '
-              '(across %s)' % '/'.join(METRIC_PACKAGES))
+              'span-not-ended, direct-cost-analysis, '
+              'jit-on-warmup-path, kv-alloc-outside-pool, '
+              'dup-metric-name (across %s)'
+              % '/'.join(METRIC_PACKAGES))
         return 0
     violations = lint_tree()
     new = [v for v in violations if v.key() not in ALLOWLIST]

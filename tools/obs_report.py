@@ -35,8 +35,11 @@ observatory"); ``--require autoscale`` for a self-driving fleet run —
 ``autoscale`` records must include at least one acted scale_up /
 scale_down decision (SERVING.md "Self-driving fleet"); ``--require
 coldstart`` for an AOT-warmed run — ``coldstart`` records must show
-both a store save and a warm hit; ``--require any`` for presence
-only).
+both a store save and a warm hit; ``--require kvcache`` for a
+paged-KV / disaggregated-prefill run — ``kvcache`` records must show
+both page-pool allocs and at least one prefilled prompt (SERVING.md
+"Paged KV-cache & disaggregated prefill"); ``--require any`` for
+presence only).
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
 load run writes.
 """
@@ -85,6 +88,12 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # an AOT-warmed run must show cold-start store traffic
                # (save on the compiling replica, hit on the warmed one)
                'coldstart': 'coldstart',
+               # a paged-KV / disaggregated-prefill run must show
+               # page-pool lifecycle events (SERVING.md "Paged
+               # KV-cache & disaggregated prefill"); the gate further
+               # insists at least one prompt was actually prefilled
+               # (action='prefill'), not just pages cycled
+               'kvcache': 'kvcache',
                'any': None}
 
 
@@ -796,6 +805,17 @@ def check_journal(path, require='step'):
         if 'hit' not in actions:
             problems.append('coldstart journal shows no AOT hit — '
                             'no warmup ever deserialized')
+    if require == 'kvcache':
+        actions = {r.get('action') for r in records
+                   if r['ev'] == 'kvcache'}
+        if 'prefill' not in actions:
+            problems.append(
+                'kvcache journal shows page traffic but no prefill — '
+                'no prompt was ever disaggregated')
+        if 'alloc' not in actions:
+            problems.append(
+                'kvcache journal shows no page alloc — the pool was '
+                'never exercised')
     if require == 'multihost':
         # a host loss the monitor only noticed after its own heartbeat
         # window means detection is broken even if recovery worked
