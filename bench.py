@@ -1543,6 +1543,145 @@ def bench_perf_obs_overhead(on_tpu):
     return out
 
 
+def bench_telemetry_overhead(on_tpu):
+    """Telemetry-plane overhead gate (OBSERVABILITY.md "Telemetry
+    plane"): the bench_perf_obs_overhead loop with the journal
+    installed in BOTH modes and the two live-telemetry costs toggled
+    together — the flight recorder's event ring
+    (``flight.set_ring_enabled``) and a live scrape endpoint
+    (``serve_telemetry``) being polled for ``/metrics`` every 50ms by
+    a background scraper for the whole timed window. What this times
+    is the steady-state cost of being observable: the per-emit deque
+    append plus exposition rendering stealing cycles from the train
+    loop's GIL. Contract: on-mode steps/s within 1% of off-mode, same
+    median-of-8-adjacent-pair-ratios verdict as the perf-observatory
+    gate (pairing cancels thermal/scheduler drift, alternating
+    within-pair order cancels a systematic second-run penalty, the
+    median throws out GC-pause pairs)."""
+    import gc
+    import tempfile
+    import threading
+    from urllib.request import urlopen
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import flight as _flight
+
+    batch = 64
+    steps = 100 if on_tpu else 96
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(batch * steps, 784).astype('float32')
+    labels = rng.randint(0, 10, (batch * steps, 1)).astype('int64')
+
+    def reader():
+        for i in range(0, len(imgs), batch):
+            yield [(imgs[j], labels[j]) for j in range(i, i + batch)]
+
+    def train_func():
+        img = fluid.layers.data(name='img', shape=[784],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='int64')
+        h = fluid.layers.fc(input=img, size=200, act='relu')
+        pred = fluid.layers.fc(input=h, size=10, act='softmax')
+        return fluid.layers.mean(fluid.layers.cross_entropy(
+            input=pred, label=label))
+
+    place = fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace()
+
+    def one_run():
+        trainer = fluid.Trainer(train_func=train_func,
+                                optimizer=fluid.optimizer.Adam(
+                                    learning_rate=1e-3),
+                                place=place)
+        marks = {}
+
+        def handler(ev):
+            if isinstance(ev, fluid.BeginEpochEvent) and ev.epoch == 1:
+                marks['t0'] = time.perf_counter()
+            elif isinstance(ev, fluid.EndEpochEvent) and ev.epoch == 1:
+                marks['t1'] = time.perf_counter()
+
+        trainer.train(num_epochs=2, event_handler=handler,
+                      reader=reader, feed_order=['img', 'label'])
+        return steps / (marks['t1'] - marks['t0'])
+
+    def gated_run(workdir, i, on):
+        path = os.path.join(workdir, 'tel_%d_%d.jsonl' % (i, on))
+        _flight.clear()
+        prev = _flight.set_ring_enabled(on)
+        gc.collect()    # level the allocator field between pair legs
+        srv, scraper = None, None
+        stop = threading.Event()
+        scrapes = [0]
+        try:
+            if on:
+                srv = obs.serve_telemetry()
+
+                def _scrape():
+                    while not stop.wait(0.05):
+                        try:
+                            with urlopen(srv.url + '/metrics',
+                                         timeout=5.0) as resp:
+                                resp.read()
+                            scrapes[0] += 1
+                        except OSError:
+                            pass
+
+                scraper = threading.Thread(target=_scrape, daemon=True)
+                scraper.start()
+            with obs.journal(path, buffer_lines=1 << 20,
+                             flush_interval=1e9):
+                sps = one_run()
+            ring_events = len(_flight.ring())
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(2.0)
+            if srv is not None:
+                srv.close()
+            _flight.set_ring_enabled(prev)
+            _flight.clear()
+        return sps, scrapes[0], ring_events
+
+    off, on = [], []
+    scrape_count = ring_depth = 0
+    with tempfile.TemporaryDirectory(prefix='bench_telemetry_') as wd:
+        for i in range(8):
+            for leg in ((False, True) if i % 2 == 0
+                        else (True, False)):
+                sps, scrapes, ring_events = gated_run(wd, i, leg)
+                if leg:
+                    on.append(sps)
+                    assert scrapes > 0, \
+                        'the on-leg endpoint was never scraped'
+                    assert ring_events > 0, \
+                        'the on-leg ring captured nothing'
+                    scrape_count = max(scrape_count, scrapes)
+                    ring_depth = max(ring_depth, ring_events)
+                else:
+                    off.append(sps)
+                    assert ring_events == 0, \
+                        'ring-off leg captured %d events' % ring_events
+    best_off, best_on = max(off), max(on)
+    ratios = sorted(o2 / o1 for o1, o2 in zip(off, on) if o1)
+    overhead = 1.0 - ratios[len(ratios) // 2] if ratios else 0.0
+    out = {
+        'batch_size': batch, 'steps_per_epoch': steps,
+        'telemetry_off_steps_per_sec': round(best_off, 2),
+        'telemetry_on_steps_per_sec': round(best_on, 2),
+        'scrapes_per_run': scrape_count,
+        'ring_events_per_run': ring_depth,
+        'overhead_fraction': round(overhead, 4),
+        'within_1pct': overhead <= 0.01,
+    }
+    log('telemetry_overhead: off %.1f vs on %.1f steps/s '
+        '(overhead %.1f%%, %d scrapes, %d ring events/run) '
+        'within_1pct=%s' % (best_off, best_on, 100 * overhead,
+                            scrape_count, ring_depth,
+                            out['within_1pct']))
+    return out
+
+
 def main():
     record = {
         'metric': 'resnet50_train_images_per_sec_per_chip',
@@ -1628,6 +1767,7 @@ def main():
                     ('input_pipeline', bench_input_pipeline),
                     ('tracing_overhead', bench_tracing_overhead),
                     ('perf_obs_overhead', bench_perf_obs_overhead),
+                    ('telemetry_overhead', bench_telemetry_overhead),
                     ('compiler', bench_compiler),
                     ('partition', bench_partition),
                     ('zero', bench_zero),

@@ -53,7 +53,8 @@ class Signals(object):
 
     __slots__ = ('replicas', 'active', 'routable', 'queued',
                  'queue_per_replica', 'shed_rate', 'shed_delta',
-                 'submitted_delta', 'p99_s', 'p99_stage')
+                 'submitted_delta', 'p99_s', 'p99_stage', 'slo_burn',
+                 'slo_breached')
 
     def as_dict(self):
         return {k: getattr(self, k) for k in self.__slots__}
@@ -93,13 +94,18 @@ class Autoscaler(object):
         ``() -> {'p99_s': float, 'stage': str}`` — wired to the span
         store by tools/fleet_bench.py so decisions carry the traced
         critical-path stage, not just a number.
+    slo_probe : callable, optional
+        ``() -> max burn rate across declared SLOs`` — wire
+        :meth:`~paddle_tpu.observability.slo.SLOEngine.signal` here
+        and any objective burning its error budget at >= 1x becomes
+        scale-out pressure, independent of the raw watermarks.
     """
 
     def __init__(self, router, min_replicas=1, max_replicas=4,
                  high_queue=4.0, low_queue=0.5, high_shed_rate=0.05,
                  p99_slo_s=None, sustain=3, up_cooldown=5.0,
                  down_cooldown=10.0, interval=0.5, p99_probe=None,
-                 clock=time.monotonic):
+                 slo_probe=None, clock=time.monotonic):
         floor = max(1, router.replication or 1)
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError('need 1 <= min_replicas <= max_replicas')
@@ -115,6 +121,7 @@ class Autoscaler(object):
         self.down_cooldown = down_cooldown
         self.interval = interval
         self.p99_probe = p99_probe
+        self.slo_probe = slo_probe
         self.clock = clock
         self._stop = threading.Event()
         self._thread = None
@@ -224,6 +231,8 @@ class Autoscaler(object):
         sig.shed_rate = shed_d / float(sub_d + shed_d) \
             if (sub_d + shed_d) else 0.0
         sig.p99_s, sig.p99_stage = self._probe_p99()
+        sig.slo_burn = self._probe_slo()
+        sig.slo_breached = sig.slo_burn >= 1.0
         self._g_replicas.set(sig.replicas)
         self._g_queue.set(0.0 if sig.queue_per_replica == float('inf')
                           else sig.queue_per_replica)
@@ -247,6 +256,15 @@ class Autoscaler(object):
         except Exception:  # noqa: BLE001
             return 0.0, ''
 
+    def _probe_slo(self):
+        if self.slo_probe is None:
+            return 0.0
+        try:
+            return float(self.slo_probe() or 0.0)
+        except Exception:  # noqa: BLE001 — probe is advisory
+            logger.exception('slo probe failed')
+            return 0.0
+
     # ---- the control loop ------------------------------------------------
     def tick(self, now=None):
         """One sense -> decide -> act pass. Returns the action taken:
@@ -267,6 +285,8 @@ class Autoscaler(object):
                            % (sig.p99_s, self.p99_slo_s,
                               ' at stage %s' % sig.p99_stage
                               if sig.p99_stage else ''))
+        if sig.slo_breached:
+            reasons.append('slo burn rate %.2fx >= 1x' % sig.slo_burn)
         over = bool(reasons)
         under = (not over and sig.routable >= sig.replicas and
                  sig.queue_per_replica < self.low_queue and

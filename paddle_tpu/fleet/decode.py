@@ -237,6 +237,8 @@ class DecodeEngine(object):
         self._worker = threading.Thread(target=self._loop,
                                         name='decode-engine', daemon=True)
         self._worker.start()
+        _obs.telemetry.register_health_provider(
+            'decode-%x' % id(self), self)
 
     # ---- program construction --------------------------------------------
     def _build(self, cell_fn, seed):
@@ -396,6 +398,17 @@ class DecodeEngine(object):
                 else None,
             }
 
+    def health(self):
+        """Liveness doc for the telemetry plane's ``/health`` route:
+        engine status plus the same counters :meth:`stats` reports."""
+        doc = self.stats()
+        with self._cond:
+            closed, blocked = self._closed, self._blocked
+        doc['status'] = ('closed' if closed else
+                         'backpressured' if blocked else 'ok')
+        doc['worker_alive'] = self._worker.is_alive()
+        return doc
+
     def close(self, drain=True, timeout=60.0):
         """Shut down the engine. ``drain=True`` finishes every pending
         and in-flight sequence first; ``drain=False`` fails them with
@@ -415,6 +428,7 @@ class DecodeEngine(object):
                     'decode engine closed before the sequence '
                     'finished'))
             self._cond.notify_all()
+        _obs.telemetry.unregister_health_provider('decode-%x' % id(self))
         self._worker.join(timeout)
         if self._worker.is_alive() or self._pending or \
                 any(s is not None for s in self._table):

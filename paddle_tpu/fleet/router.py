@@ -353,6 +353,9 @@ class Router(object):
             self._replicas[rid] = _Replica(rid, factory(rid))
             self._publish_state(rid, ACTIVE)
         _obs.emit('fleet', action='create', replicas=replicas)
+        # live telemetry: /health carries the fleet-wide readiness doc
+        _obs.telemetry.register_health_provider(
+            'router-%x' % id(self), self)
         self.supervisor = None
         if supervise:
             from .supervisor import ReplicaSupervisor
@@ -760,6 +763,10 @@ class Router(object):
                 raise ReplicaRetired(
                     'replica %d was retired — nothing to kill' % rid)
         _obs.emit('fleet', action='kill', replica=rid, abrupt=abrupt)
+        # freeze the postmortem BEFORE closing: the bundle must carry
+        # the dying replica's still-open spans and queue state, which
+        # the ServerClosed storm below is about to clear
+        _obs.flight.trip('replica_kill', replica=rid, abrupt=abrupt)
         try:
             rep.server.close(timeout=0.0 if abrupt else 30.0)
         finally:
@@ -1063,6 +1070,7 @@ class Router(object):
                 return
             self._closed = True
             reps = list(self._replicas.values())
+        _obs.telemetry.unregister_health_provider('router-%x' % id(self))
         if self.supervisor is not None:
             self.supervisor.stop()
         for rep in reps:

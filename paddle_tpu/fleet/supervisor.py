@@ -126,6 +126,8 @@ class ReplicaSupervisor(object):
             _obs.emit('fleet', action='restart_failed', replica=rep.id,
                       attempt=fails, backoff_s=round(backoff, 3),
                       error=repr(e))
+            _obs.flight.trip('restart_failed', replica=rep.id,
+                             attempt=fails, error=repr(e))
             logger.warning('restart of replica %d failed (attempt %d, '
                            'next in %.1fs): %r', rep.id, fails,
                            backoff, e)

@@ -92,6 +92,15 @@ def _spawn(cmd, rank, world, gen, port, hb_dir, hb_interval,
     if _obs.journal_active() and _obs.JOURNAL_ENV not in env:
         env[_obs.JOURNAL_ENV] = os.path.join(
             hb_dir, 'journal_g%d_r%d.jsonl' % (gen, rank))
+    # telemetry env contract: a PTPU_TELEMETRY launch gives every
+    # worker its own scrape endpoint, ports published as files under
+    # the heartbeat dir (scan_port_dir / TelemetryAggregator.add_dir
+    # pick them up); flight-recorder bundles land next to them
+    if env.get(_obs.TELEMETRY_ENV):
+        env.setdefault(_obs.TELEMETRY_DIR_ENV,
+                       os.path.join(hb_dir, 'telemetry'))
+        env.setdefault(_obs.FLIGHT_ENV,
+                       os.path.join(hb_dir, 'flight'))
     env.update(extra_env or {})
     out = None
     if log_dir:

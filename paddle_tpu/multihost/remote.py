@@ -83,12 +83,18 @@ def serve(port_file, place=None, kind='serve'):
     ``submit`` (pickled through the protocol) continue their tree in
     this process's own journal, flushed per message so a ``kill -9``
     leaves the in-flight ``span_begin`` on disk — the unclosed span
-    trace_report reports for work that died with the host."""
+    trace_report reports for work that died with the host.
+
+    When ``PTPU_TELEMETRY`` is truthy the worker also serves its own
+    scrape endpoint (``/metrics`` / ``/health`` / ``/ledgers``),
+    publishing the port through ``PTPU_TELEMETRY_DIR`` when set; the
+    parent can also fetch it in-band with the ``telemetry_port`` op."""
     jpath = os.environ.get(_obs.JOURNAL_ENV)
     jnl = None
     if jpath:
         jnl = _obs.RunJournal(jpath)
         _obs.set_journal(jnl)
+    tel = _obs.install_env_telemetry(name='cell-%d' % os.getpid())
     if kind == 'prefill':
         from ..kvcache.prefill import PrefillServer
         srv = PrefillServer(place=place)
@@ -153,6 +159,10 @@ def serve(port_file, place=None, kind='serve'):
             if op == 'ping':
                 _reply(mid, True, os.getpid())
                 continue
+            if op == 'telemetry_port':
+                _reply(mid, True,
+                       tel.port if tel is not None else None)
+                continue
             try:
                 value = getattr(srv, op)(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 — forwarded typed
@@ -169,6 +179,8 @@ def serve(port_file, place=None, kind='serve'):
         except Exception:  # noqa: BLE001 — already closed
             pass
         conn.close()
+        if tel is not None:
+            tel.close()
         if jnl is not None:
             _obs.set_journal(None)
             jnl.close()
@@ -287,6 +299,12 @@ class RemoteCell(object):
 
     def health(self):
         return self._call('health', _timeout=10.0)
+
+    def telemetry_port(self):
+        """The worker's scrape-endpoint port, or None when the cell
+        was spawned without ``PTPU_TELEMETRY`` — feed it to
+        :meth:`TelemetryAggregator.add_endpoint` for fleet rollups."""
+        return self._call('telemetry_port', _timeout=10.0)
 
     def load_score(self, model_name=None):
         try:

@@ -26,6 +26,21 @@ Two complementary surfaces, both stdlib-only and import-cycle-free:
   ``perf_mfu{program=}`` / roofline gauges joined from measured step
   walls, and the :class:`PerfBaseline` regression sentinel behind
   ``tools/perf_report.py``.
+- :mod:`~paddle_tpu.observability.telemetry` — the live telemetry
+  plane: a per-process HTTP scrape endpoint (``/metrics`` /
+  ``/health`` / ``/ledgers``), the ``PTPU_TELEMETRY`` env contract,
+  and the :class:`TelemetryAggregator` merging every endpoint into
+  fleet-wide rollups under ``host=``/``replica=`` labels
+  (``tools/fleet_top.py`` renders it live).
+- :mod:`~paddle_tpu.observability.slo` — declared objectives (p99
+  latency, shed/error rate) evaluated as multi-window burn rates,
+  published as ``slo_burn_rate{slo=}`` gauges and consumable by the
+  fleet autoscaler.
+- :mod:`~paddle_tpu.observability.flight` — the crash flight
+  recorder: an always-on bounded ring of recent journal-grade events
+  that dumps an atomic postmortem bundle (ring + metrics + unclosed
+  spans + health + ledgers) on watchdog/breaker/anomaly trips, kills
+  and SIGTERM, rendered by ``tools/postmortem.py``.
 """
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, default_registry,
@@ -41,6 +56,17 @@ from .tracing import (TraceContext, Span, NULL_SPAN,  # noqa: F401
 from . import perf  # noqa: F401
 from .perf import (ProgramLedger, LedgerBook, PerfBaseline,  # noqa
                    PERF_ENV)
+from . import flight  # noqa: F401
+from .flight import FLIGHT_ENV  # noqa: F401
+from . import telemetry  # noqa: F401
+from .telemetry import (TelemetryAggregator,  # noqa: F401
+                        TelemetryServer, serve_telemetry,
+                        install_env_telemetry, parse_exposition,
+                        register_health_provider,
+                        unregister_health_provider, collect_health,
+                        TELEMETRY_ENV, TELEMETRY_DIR_ENV)
+from . import slo as slo  # noqa: F401
+from .slo import SLO, SLOEngine  # noqa: F401
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
@@ -54,4 +80,10 @@ __all__ = [
     'sample_rate', 'parent_from_env', 'TRACE_PARENT_ENV',
     'TRACE_SAMPLE_ENV',
     'perf', 'ProgramLedger', 'LedgerBook', 'PerfBaseline', 'PERF_ENV',
+    'flight', 'FLIGHT_ENV',
+    'telemetry', 'TelemetryAggregator', 'TelemetryServer',
+    'serve_telemetry', 'install_env_telemetry', 'parse_exposition',
+    'register_health_provider', 'unregister_health_provider',
+    'collect_health', 'TELEMETRY_ENV', 'TELEMETRY_DIR_ENV',
+    'slo', 'SLO', 'SLOEngine',
 ]

@@ -49,6 +49,8 @@ import threading
 import time
 import uuid
 
+from . import flight as _flight
+
 __all__ = ['SCHEMA_VERSION', 'JOURNAL_ENV', 'RunJournal', 'set_journal',
            'get_journal', 'journal', 'journal_active', 'emit',
            'read_journal', 'install_env_journal']
@@ -80,7 +82,7 @@ class RunJournal(object):
     """Buffered, thread-safe JSONL event writer with a stable run id."""
 
     def __init__(self, path, run_id=None, buffer_lines=128,
-                 flush_interval=2.0, max_bytes=None):
+                 flush_interval=2.0, max_bytes=None, max_rotations=1):
         self.path = path
         self.run_id = run_id or uuid.uuid4().hex[:12]
         self._lock = threading.Lock()
@@ -89,6 +91,7 @@ class RunJournal(object):
         self._buffer_lines = int(buffer_lines)
         self._flush_interval = float(flush_interval)
         self._max_bytes = int(max_bytes) if max_bytes else 0
+        self._max_rotations = max(1, int(max_rotations))
         self._bytes = 0
         self.rotations = 0
         self._t0 = time.monotonic()
@@ -142,12 +145,19 @@ class RunJournal(object):
         self._last_flush = now
 
     def _rotate_locked(self):
-        """Roll the current file to ``<path>.1`` (one generation kept)
-        and restart the live file with a fresh ``run_begin`` carrying
-        the ORIGINAL wall anchor — ``t`` offsets keep counting from the
-        run's ``_t0``, so clock alignment in timeline/trace_report is
-        unchanged across a rotation."""
+        """Roll the current file into a ``<path>.1`` .. ``<path>.N``
+        shift chain (``max_rotations`` generations kept; the default of
+        one preserves the historic single-``.1`` behavior, a postmortem
+        that needs to reach further back raises it) and restart the
+        live file with a fresh ``run_begin`` carrying the ORIGINAL wall
+        anchor — ``t`` offsets keep counting from the run's ``_t0``, so
+        clock alignment in timeline/trace_report is unchanged across a
+        rotation."""
         self._f.close()
+        for i in range(self._max_rotations - 1, 0, -1):
+            src = '%s.%d' % (self.path, i)
+            if os.path.exists(src):
+                os.replace(src, '%s.%d' % (self.path, i + 1))
         os.replace(self.path, self.path + '.1')
         self._f = open(self.path, 'w')
         self._bytes = 0
@@ -234,8 +244,12 @@ def install_env_journal(**kwargs):
 
 
 def emit(ev, **fields):
-    """Record into the installed journal; a no-op (one None check)
-    when none is installed — safe to call on any hot path."""
+    """Record into the installed journal — a module-global None check
+    when none is installed, safe on any hot path — AND mirror the event
+    into the flight recorder's bounded ring (flight.py), which stays on
+    even without a journal so a postmortem bundle always has the last
+    ~N events leading up to a trip."""
+    _flight.note(ev, fields)
     j = _JOURNAL
     if j is not None:
         j.record(ev, **fields)

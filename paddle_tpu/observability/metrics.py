@@ -32,13 +32,28 @@ __all__ = ['Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
 DEFAULT_SECONDS_EDGES = tuple(2.0 ** i for i in range(-17, 7))
 
 
+def _escape_label_value(v):
+    """Prometheus text-format label escaping: backslash, double quote
+    and line feed in a label value (program fingerprints, host names,
+    error strings) would otherwise render an unparsable series line."""
+    s = v if isinstance(v, str) else str(v)
+    if '\\' in s:
+        s = s.replace('\\', '\\\\')
+    if '"' in s:
+        s = s.replace('"', '\\"')
+    if '\n' in s:
+        s = s.replace('\n', '\\n')
+    return s
+
+
 def _fmt_labels(labels, extra=None):
     items = list(labels)
     if extra:
         items += list(extra)
     if not items:
         return ''
-    return '{%s}' % ','.join('%s="%s"' % (k, v) for k, v in items)
+    return '{%s}' % ','.join('%s="%s"' % (k, _escape_label_value(v))
+                             for k, v in items)
 
 
 def _fmt_value(v):

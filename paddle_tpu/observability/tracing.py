@@ -36,6 +36,7 @@ import threading
 import time
 import uuid
 
+from . import flight as _flight
 from .journal import emit as _emit, journal_active as _journal_active
 from .metrics import default_registry
 
@@ -167,6 +168,7 @@ class Span(object):
             self._active = False
         c = self.context
         if c.sampled:
+            _flight.note_span_end(c)
             _emit('span_end', name=self.name, trace=c.trace_id,
                   span=c.span_id, parent=c.parent_id,
                   dur_s=round(dur, 6), **fields)
@@ -251,6 +253,9 @@ def start_span(name, parent=None, activate=True, **fields):
     sp = Span(name, ctx)
     if ctx.sampled:
         _spans_counter().inc()
+        # the flight recorder's live-span table is what lets a
+        # postmortem bundle name the work still open at death
+        _flight.note_span_begin(name, ctx)
         _emit('span_begin', name=name, trace=ctx.trace_id,
               span=ctx.span_id, parent=ctx.parent_id, **fields)
     if activate:

@@ -37,6 +37,8 @@ def _record_trip(guard, counter_key, kind, where, value=None):
         kind=kind).inc()
     _obs.emit('anomaly', kind=kind, where=where, policy=guard.policy,
               value=value)
+    _obs.flight.trip('anomaly', kind=kind, where=where,
+                     policy=guard.policy)
 
 POLICIES = ('raise', 'skip_batch', 'rollback_to_checkpoint')
 

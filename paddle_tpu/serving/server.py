@@ -154,6 +154,10 @@ class ModelServer(object):
         self._abandoned = []           # worker threads close() gave up on
         self._lock = threading.RLock()
         self._closed = False
+        # live telemetry: /health merges this server's readiness doc
+        # (weakly registered — GC'd servers drop out on their own)
+        _obs.telemetry.register_health_provider(
+            'server-%x' % id(self), self)
 
     # ---- model management ------------------------------------------------
     def load_model(self, name, dirname, model_filename=None,
@@ -516,6 +520,11 @@ class ModelServer(object):
     # ---- guardrail callbacks ---------------------------------------------
     def _on_breaker_transition(self, name, to_state, reason):
         self.stats.record_breaker_transition(name, to_state, reason)
+        if to_state == OPEN:
+            # breaker opening is crash-adjacent: freeze a postmortem
+            # bundle (ring + metrics + unclosed spans) while the
+            # evidence is still in memory
+            _obs.flight.trip('breaker_open', model=name, reason=reason)
 
     def _on_watchdog_trip(self, entry):
         name = entry['model']
@@ -541,6 +550,9 @@ class ModelServer(object):
         self.stats.record_watchdog_trip(
             name, stage=entry['stage'], failed=len(pending),
             overrun=entry.get('overrun', 0.0))
+        _obs.flight.trip('watchdog', model=name, stage=entry['stage'],
+                         failed=len(pending),
+                         overrun=entry.get('overrun', 0.0))
         for req in pending:
             req.set_error(err)
         logger.warning('watchdog tripped %s on model %r (%d futures '
@@ -595,6 +607,7 @@ class ModelServer(object):
             if w.is_alive():
                 self._abandon_worker(name, batchers.get(name), w)
         self.watchdog.stop()
+        _obs.telemetry.unregister_health_provider('server-%x' % id(self))
         # push buffered journal tail to disk: a SIGTERM'd or killed
         # replica must not lose the spans of its last in-flight batch
         j = _obs.get_journal()

@@ -117,3 +117,9 @@ def pytest_configure(config):
         'allocator, paged-attention bit-identity, admission '
         'backpressure, prefill engine/server, disaggregated '
         'prefill->decode (tier-1; filter with -m "not kvcache")')
+    config.addinivalue_line(
+        'markers',
+        'telemetry: tests of the fleet telemetry plane — scrape '
+        'endpoint, exposition parser round-trip, cross-host '
+        'aggregation/retire, SLO burn-rate engine, crash flight '
+        'recorder (tier-1; filter with -m "not telemetry")')

@@ -139,6 +139,31 @@ def test_rule_jit_on_warmup_path(tmp_path):
     assert any(x.rule == 'jit-on-warmup-path' for x in v)
 
 
+def test_rule_http_outside_telemetry(tmp_path):
+    """ISSUE 18 satellite: http.server stand-ups outside
+    observability/telemetry.py fork the scrape-endpoint surface; the
+    telemetry plane is the one sanctioned listener. The remote-cell
+    pickle protocol (raw sockets) stays out of scope."""
+    src = ('from http.server import ThreadingHTTPServer\n'
+           'import http.server\n')
+    p = tmp_path / 'mod.py'
+    p.write_text(src)
+    for rel, expect in [
+            (os.path.join('paddle_tpu', 'serving', 'server.py'), 2),
+            ('tools/fleet_top.py', 2),
+            (os.path.join('paddle_tpu', 'observability',
+                          'telemetry.py'), 0)]:
+        v, _ = lint_repo.lint_file(str(p), rel)
+        hits = [x for x in v if x.rule == 'http-outside-telemetry']
+        assert len(hits) == expect, (rel, hits)
+    # raw sockets (the multihost remote protocol) don't trip the rule
+    p.write_text('import socket\ns = socket.socket()\n'
+                 's.bind(("127.0.0.1", 0))\ns.listen(1)\n')
+    v, _ = lint_repo.lint_file(
+        str(p), os.path.join('paddle_tpu', 'multihost', 'remote.py'))
+    assert not [x for x in v if x.rule == 'http-outside-telemetry']
+
+
 def test_rule_kv_alloc_outside_pool(tmp_path):
     """ISSUE 17 satellite: raw numpy KV buffers in serving/ or fleet/
     dodge the PagePool's kv_bytes accounting; only the kvcache package

@@ -47,6 +47,15 @@ Two phases, both seeded and deterministic in shape:
    oracle-exact tokens, p99 held, ``obs_report --require kvcache``
    green, and one trace tree spanning the prefill->decode hop.
 
+6. **Telemetry plane** (OBSERVABILITY.md "Telemetry plane, SLOs &
+   flight recorder"): a fleet's scrape endpoint is discovered from
+   its ``PTPU_TELEMETRY_DIR`` port file and aggregated mid-load; a
+   replica kill must dump a postmortem bundle ``postmortem.py`` can
+   render; retiring the dead endpoint must drop its series from the
+   merged exposition; a shed storm must breach the shed-ratio SLO's
+   burn rate and recover once drained (gated via ``obs_report
+   --require telemetry`` and ``--require slo``).
+
 ``--smoke`` runs a short schedule of both phases, writes an
 observability journal and validates it via ``obs_report.py --require
 fleet`` AND ``--require tracing`` semantics — including that the
@@ -64,6 +73,7 @@ import argparse
 import collections
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import threading
@@ -860,6 +870,305 @@ def run_kvcache_phase(seed=3, n_sequences=96, n_prompts=12,
     }
 
 
+def run_telemetry_phase(replicas=2, n_requests=64, clients=3,
+                        max_batch=8, seed=9, shed_target=24,
+                        slo_windows=(2.0, 8.0)):
+    """Fleet telemetry-plane phase (OBSERVABILITY.md "Telemetry
+    plane, SLOs & flight recorder"): a live fleet is scraped, killed,
+    retired, and budget-accounted end to end.
+
+    - **serve + discover**: the process stands up its scrape endpoint
+      publishing a ``PTPU_TELEMETRY_DIR`` port file; a
+      :class:`TelemetryAggregator` must discover it from the directory
+      alone and scrape real ``serving_*`` series mid-load
+      (``fleet_qps`` goes positive). In-process replicas share one
+      scrape surface, so each is additionally registered as a
+      ``replica=<id>``-labelled endpoint — the same label-stamped
+      republish the multi-host launcher contract produces.
+    - **kill -> bundle**: one replica is killed mid-load with the
+      flight recorder's bundle directory configured; the
+      ``replica_kill`` trip must dump a postmortem bundle naming the
+      victim, and ``tools/postmortem.py`` must render it (exit 0).
+    - **retire**: retiring the victim's endpoint must remove every
+      series carrying its label from the merged exposition.
+    - **SLO burn**: a shed storm (servers paused, queue flooded past
+      admission) must drive the shed-ratio SLO's burn rate past
+      breach across every window, and draining the storm must recover
+      it — both transitions journalled for the ``obs_report
+      --require slo`` gate. The engine's ``slo_burn_rate`` gauge
+      rides the same scrape surface the aggregator merges.
+    """
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fleet import Router
+    from paddle_tpu.observability import flight, telemetry
+    from paddle_tpu.observability.slo import SLO, SLOEngine
+    from paddle_tpu.serving import ModelServer, ServingError
+
+    problems = []
+    rng = np.random.RandomState(seed)
+    inputs = [rng.randn(int(rng.randint(1, max_batch + 1)),
+                        IN_DIM).astype('float32')
+              for _ in range(n_requests)]
+
+    with tempfile.TemporaryDirectory(prefix='fleet_tel_') as workdir:
+        artifact = _build_artifact(workdir, seed=seed)
+        tel_dir = os.path.join(workdir, 'telemetry')
+        bundle_dir = os.path.join(workdir, 'flight')
+        prev_flight = flight.configure(bundle_dir)
+        flight.clear()
+        srv_tel = telemetry.serve_telemetry(port_dir=tel_dir,
+                                            name='serve')
+        engine = SLOEngine(
+            [SLO.ratio('shed_ratio',
+                       bad='serving_requests_shed_total',
+                       total='serving_requests_submitted_total',
+                       objective=0.98)],
+            windows=slo_windows)
+        agg = telemetry.TelemetryAggregator()
+        outcomes = [None] * n_requests
+        submitted = threading.Semaphore(0)
+        stop_scraping = threading.Event()
+        scrape_summaries = []
+        peak = {'qps': 0.0, 'burn': 0.0}
+
+        def factory(rid):
+            return ModelServer(place=fluid.CPUPlace(),
+                               max_batch_size=max_batch,
+                               max_queue_depth=max(64, n_requests),
+                               watchdog_poll=0.02)
+
+        try:
+            router = Router(factory, replicas=replicas,
+                            poll_interval=0.05)
+            with router:
+                router.load_model('m', artifact)
+
+                # discovery: the published port file alone is enough
+                stems = agg.add_dir(tel_dir)
+                if 'serve' not in stems:
+                    problems.append(
+                        'PTPU_TELEMETRY_DIR discovery found %r, not '
+                        'the published "serve" endpoint' % (stems,))
+                for rid in router.placement('m'):
+                    agg.add_endpoint('replica-%d' % rid, srv_tel.port,
+                                     replica=str(rid))
+                n_endpoints = len(agg.endpoints())
+
+                def client(cid):
+                    for i in range(cid, n_requests, clients):
+                        give_up = time.monotonic() + 30.0
+                        req = None
+                        while req is None:
+                            try:
+                                req = router.submit('m',
+                                                    {'x': inputs[i]})
+                            except ServingError:
+                                if time.monotonic() > give_up:
+                                    outcomes[i] = ('stuck', None)
+                                    break
+                                time.sleep(0.01)
+                        submitted.release()
+                        if req is None:
+                            continue
+                        try:
+                            req.result(timeout=60.0)
+                            outcomes[i] = ('ok', None)
+                        except ServingError as e:
+                            outcomes[i] = ('typed_error', e)
+                        except Exception as e:  # noqa: BLE001
+                            outcomes[i] = ('untyped_error', e)
+                        # pace the load so it spans several scrapes
+                        time.sleep(0.02)
+
+                def scraper():
+                    while not stop_scraping.is_set():
+                        s = agg.scrape_once(timeout=5.0)
+                        scrape_summaries.append(s)
+                        peak['qps'] = max(peak['qps'],
+                                          s['fleet_qps'])
+                        engine.tick()
+                        stop_scraping.wait(0.05)
+
+                threads = [threading.Thread(target=client, args=(c,),
+                                            daemon=True)
+                           for c in range(clients)]
+                for t in threads:
+                    t.start()
+                scr = threading.Thread(target=scraper, daemon=True)
+                scr.start()
+
+                # ---- kill mid-load: the trip must dump a bundle ----
+                for _ in range(n_requests // 2):
+                    submitted.acquire()
+                victim = min(router.placement('m'))
+                vsrv = router.replica(victim).server
+                vsrv.pause('m')
+                give_up = time.monotonic() + 10.0
+                while vsrv.queue_depth('m') == 0 and \
+                        time.monotonic() < give_up:
+                    time.sleep(0.002)
+                router.kill_replica(victim)
+                bundle_path = flight.last_bundle()
+                for t in threads:
+                    t.join(120.0)
+                stop_scraping.set()
+                scr.join(30.0)
+
+                if bundle_path is None:
+                    problems.append('replica kill tripped no '
+                                    'postmortem bundle')
+                else:
+                    try:
+                        bundle = flight.read_bundle(bundle_path)
+                    except (OSError, ValueError) as e:
+                        bundle = None
+                        problems.append('kill bundle unreadable: %r'
+                                        % (e,))
+                    if bundle is not None:
+                        if bundle['reason'] != 'replica_kill':
+                            problems.append(
+                                'kill bundle reason is %r, not '
+                                'replica_kill' % (bundle['reason'],))
+                        if bundle['context'].get('replica') != victim:
+                            problems.append(
+                                'kill bundle names replica %r, not '
+                                'the victim %d'
+                                % (bundle['context'].get('replica'),
+                                   victim))
+                    pm = subprocess.run(
+                        [sys.executable,
+                         os.path.join(
+                             os.path.dirname(os.path.abspath(
+                                 __file__)), 'postmortem.py'),
+                         bundle_path],
+                        capture_output=True, text=True)
+                    if pm.returncode != 0 or \
+                            'replica_kill' not in pm.stdout:
+                        problems.append(
+                            'postmortem.py could not render the kill '
+                            'bundle (rc %d): %s'
+                            % (pm.returncode,
+                               (pm.stderr or pm.stdout)[-200:]))
+
+                # ---- retire: the victim's series must vanish -------
+                agg.scrape_once(timeout=5.0)
+                removed = agg.retire('replica-%d' % victim)
+                if removed <= 0:
+                    problems.append('retiring the killed replica '
+                                    'endpoint removed no series')
+                agg.scrape_once(timeout=5.0)
+                # only the victim endpoint stamps replica=<victim>
+                # with no host label — the surviving host endpoint
+                # republishes the router's own per-replica gauges
+                # (e.g. fleet_replica_state{replica=...}) under
+                # host=serve, and those rightly survive the retire
+                leftover = [
+                    name for name, entry in
+                    agg.registry.snapshot().items()
+                    for s in entry['series']
+                    if s['labels'].get('replica') == str(victim) and
+                    'host' not in s['labels']]
+                if leftover:
+                    problems.append(
+                        'retired replica %d still has %d series in '
+                        'the merged exposition (e.g. %s)'
+                        % (victim, len(leftover), leftover[0]))
+
+                # ---- shed storm -> breach -> drain -> recovery -----
+                stormed = sorted(router.placement('m'))
+                for rid in stormed:
+                    router.replica(rid).server.pause('m')
+                backlog, sheds = [], 0
+                give_up = time.monotonic() + 30.0
+                while sheds < shed_target and \
+                        time.monotonic() < give_up:
+                    try:
+                        backlog.append(
+                            router.submit('m', {'x': inputs[0]}))
+                    except ServingError:
+                        sheds += 1
+                        r = engine.tick()['shed_ratio']
+                        peak['burn'] = max(peak['burn'],
+                                           r['burn_rate'])
+                if sheds < shed_target:
+                    problems.append(
+                        'shed storm produced only %d/%d sheds'
+                        % (sheds, shed_target))
+                breached = False
+                give_up = time.monotonic() + 10.0
+                while time.monotonic() < give_up:
+                    r = engine.tick()['shed_ratio']
+                    peak['burn'] = max(peak['burn'], r['burn_rate'])
+                    if r['breached']:
+                        breached = True
+                        break
+                    time.sleep(0.05)
+                if not breached:
+                    problems.append(
+                        'shed storm never drove the SLO burn rate '
+                        'past breach (peak %.2fx)' % peak['burn'])
+                for rid in stormed:
+                    router.replica(rid).server.resume('m')
+                for fut in backlog:
+                    try:
+                        fut.result(timeout=60.0)
+                    except ServingError:
+                        pass
+                t_rec = time.monotonic()
+                give_up = t_rec + max(slo_windows) * 3 + 5.0
+                recovered = False
+                while time.monotonic() < give_up:
+                    if not engine.tick()['shed_ratio']['breached']:
+                        recovered = True
+                        break
+                    time.sleep(0.1)
+                recover_s = time.monotonic() - t_rec
+                if breached and not recovered:
+                    problems.append(
+                        'SLO burn never recovered within %.0fs of the '
+                        'storm draining' % (give_up - t_rec))
+        finally:
+            stop_scraping.set()
+            srv_tel.close()
+            flight.configure(prev_flight)
+
+        # ---- invariants --------------------------------------------------
+        untyped = [repr(o[1]) for o in outcomes
+                   if o and o[0] == 'untyped_error']
+        dropped = sum(1 for o in outcomes
+                      if o is None or o[0] == 'stuck')
+        if untyped:
+            problems.append('untyped client errors: %s' % untyped[:3])
+        if dropped:
+            problems.append('%d request(s) dropped/stuck' % dropped)
+        if not any(s['scraped'] == s['endpoints'] and s['endpoints']
+                   for s in scrape_summaries):
+            problems.append('no mid-load scrape reached every '
+                            'endpoint')
+        if peak['qps'] <= 0.0:
+            problems.append('fleet_qps never went positive across '
+                            '%d mid-load scrapes'
+                            % len(scrape_summaries))
+
+    return {
+        'config': {'replicas': replicas, 'n_requests': n_requests,
+                   'clients': clients, 'seed': seed,
+                   'slo_windows': list(slo_windows),
+                   'shed_target': shed_target,
+                   'killed_replica': victim},
+        'endpoints': n_endpoints,
+        'scrapes': len(scrape_summaries),
+        'peak_fleet_qps': round(peak['qps'], 2),
+        'bundle': bundle_path,
+        'retired_series': removed,
+        'slo': {'sheds': sheds,
+                'peak_burn': round(peak['burn'], 2),
+                'breached': breached,
+                'recovered_after_s': round(recover_s, 2)},
+        'problems': problems,
+    }
+
+
 def check_disagg_trace(journal_path):
     """Tracing gate for the disaggregation phase: at least one
     ``kvcache/request`` root must reconstruct with BOTH legs under it
@@ -945,6 +1254,7 @@ def main(argv=None):
     ap.add_argument('--no-autoscale-phase', action='store_true')
     ap.add_argument('--no-coldstart-phase', action='store_true')
     ap.add_argument('--no-kvcache-phase', action='store_true')
+    ap.add_argument('--no-telemetry-phase', action='store_true')
     ap.add_argument('--smoke', action='store_true',
                     help='short seeded schedule; exit nonzero if any '
                          'fleet or decode invariant breaks')
@@ -1001,6 +1311,10 @@ def main(argv=None):
                 run_coldstart_phase()
             kvcache = None if args.no_kvcache_phase else \
                 run_kvcache_phase(seed=3, n_sequences=72, n_prompts=8)
+            telemetry = None if args.no_telemetry_phase else \
+                run_telemetry_phase(replicas=2, n_requests=64,
+                                    clients=3,
+                                    max_batch=args.max_batch)
         else:
             fleet = run_fleet_chaos(
                 replicas=args.replicas, n_requests=args.requests,
@@ -1019,6 +1333,11 @@ def main(argv=None):
                 run_coldstart_phase()
             kvcache = None if args.no_kvcache_phase else \
                 run_kvcache_phase(seed=3)
+            telemetry = None if args.no_telemetry_phase else \
+                run_telemetry_phase(replicas=2,
+                                    n_requests=args.requests,
+                                    clients=args.clients,
+                                    max_batch=args.max_batch)
     finally:
         if jctx is not None:
             observability.perf.enable_capture(_perf_prev)
@@ -1033,6 +1352,8 @@ def main(argv=None):
         problems += cold['problems']
     if kvcache is not None:
         problems += kvcache['problems']
+    if telemetry is not None:
+        problems += telemetry['problems']
     if journal_path:
         print('journal written to %s' % journal_path)
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1056,12 +1377,19 @@ def main(argv=None):
             # one reconstructable trace tree
             problems += check_journal(journal_path, require='kvcache')
             problems += check_disagg_trace(journal_path)
+        if telemetry is not None:
+            # the plane must have scraped under load, and the shed
+            # storm must have journalled both SLO transitions
+            problems += check_journal(journal_path,
+                                      require='telemetry')
+            problems += check_journal(journal_path, require='slo')
         if args.smoke and not args.no_kill:
             problems += check_requeue_trace(journal_path)
 
     results = {'fleet': fleet, 'decode': decode,
                'autoscale': autoscale, 'coldstart': cold,
-               'kvcache': kvcache, 'problems': problems}
+               'kvcache': kvcache, 'telemetry': telemetry,
+               'problems': problems}
     if args.json:
         with open(args.json, 'w') as f:
             json.dump(results, f, indent=2, sort_keys=True,
@@ -1107,6 +1435,16 @@ def main(argv=None):
                  kvcache['decode_paged_speedup'],
                  kvcache['sequences_resident_ratio'],
                  kd['ok'], kd['failed'], kd['p99_s'] * 1e3))
+    if telemetry is not None:
+        ts = telemetry['slo']
+        print('telemetry: %d endpoints, %d scrapes, peak %.1f req/s '
+              '| kill bundle %s | retired %d series | slo peak burn '
+              '%.1fx, recovered in %.1fs'
+              % (telemetry['endpoints'], telemetry['scrapes'],
+                 telemetry['peak_fleet_qps'],
+                 'rendered' if telemetry['bundle'] else 'MISSING',
+                 telemetry['retired_series'], ts['peak_burn'],
+                 ts['recovered_after_s']))
     if problems:
         print('FLEET INVARIANTS BROKEN:', file=sys.stderr)
         for p in problems:

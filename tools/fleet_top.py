@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Live fleet status from the telemetry plane — ``top`` for replicas.
+
+Feeds a :class:`paddle_tpu.observability.TelemetryAggregator` from
+explicit endpoints and/or a ``PTPU_TELEMETRY_DIR`` port-file directory,
+scrapes twice (rates are scrape-to-scrape deltas), and renders one
+table: per-endpoint liveness, request counters, shed and latency, plus
+the fleet rollup line (``fleet_qps`` / ``fleet_shed_rate`` /
+``fleet_worst_p99_seconds``).
+
+    python tools/fleet_top.py r0=18321 r1=18322        # one-shot
+    python tools/fleet_top.py --dir /tmp/hb/telemetry  # discovered
+    python tools/fleet_top.py --dir ... --watch        # refresh loop
+
+Endpoints are ``name=url`` or ``name=port`` pairs; ``--watch`` redraws
+every ``--interval`` seconds until interrupted. Exit is nonzero when
+no endpoint answered the final scrape — so a CI step can use a
+one-shot invocation as a liveness gate.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from paddle_tpu.observability.telemetry import TelemetryAggregator  # noqa: E402
+
+
+def build_aggregator(args):
+    agg = TelemetryAggregator()
+    for spec in args.endpoints:
+        name, sep, target = spec.partition('=')
+        if not sep or not name or not target:
+            raise SystemExit('endpoint must be name=url or name=port, '
+                             'got %r' % spec)
+        agg.add_endpoint(name, int(target) if target.isdigit()
+                         else target)
+    if args.dir:
+        agg.add_dir(args.dir)
+    if not agg.endpoints():
+        raise SystemExit('no endpoints: pass name=url pairs or --dir')
+    return agg
+
+
+def _series_value(snapshot, metric, want_labels, default=None):
+    """The value of ``metric`` whose labels are a superset of
+    ``want_labels``, summed across matching series (one endpoint can
+    republish several label sets, e.g. per-model counters)."""
+    entry = snapshot.get(metric)
+    if not entry:
+        return default
+    total, hit = 0.0, False
+    for s in entry['series']:
+        if all(s['labels'].get(k) == v for k, v in want_labels.items()):
+            total += s.get('value', 0.0)
+            hit = True
+    return total if hit else default
+
+
+def render(agg, health):
+    """The status table as a list of lines."""
+    snapshot = agg.registry.snapshot()
+    endpoints = agg.endpoints()
+    lines = ['%-14s %-3s %-9s %10s %10s %8s %9s'
+             % ('ENDPOINT', 'UP', 'STATUS', 'SUBMITTED', 'COMPLETED',
+                'SHED', 'QUEUE')]
+    for name, ep in sorted(endpoints.items()):
+        want = ep['labels']
+        doc = health.get(name)
+        status = (doc or {}).get('status', '-') if doc else 'down'
+        sub = _series_value(snapshot,
+                            'serving_requests_submitted_total', want)
+        done = _series_value(snapshot,
+                             'serving_requests_completed_total', want)
+        shed = _series_value(snapshot,
+                             'serving_requests_shed_total', want)
+        queue = _series_value(snapshot, 'serving_queue_depth', want)
+        lines.append(
+            '%-14s %-3s %-9s %10s %10s %8s %9s'
+            % (name[:14], {1: 'yes', 0: 'NO'}.get(ep['up'], '?'),
+               status[:9],
+               '-' if sub is None else '%d' % sub,
+               '-' if done is None else '%d' % done,
+               '-' if shed is None else '%d' % shed,
+               '-' if queue is None else '%g' % queue))
+
+    def roll(metric):
+        entry = snapshot.get(metric)
+        return entry['series'][0]['value'] if entry else 0.0
+
+    lines.append('')
+    lines.append(
+        'fleet: %.1f req/s | shed %.2f%% | worst p99 %.1fms%s | '
+        '%d/%d endpoints up'
+        % (roll('fleet_qps'), 100.0 * roll('fleet_shed_rate'),
+           1e3 * roll('fleet_worst_p99_seconds'),
+           (' (%s)' % agg.worst_endpoint) if agg.worst_endpoint
+           else '', int(roll('fleet_endpoints_up')), len(endpoints)))
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('endpoints', nargs='*', metavar='NAME=URL',
+                    help='scrape targets (URL or localhost port)')
+    ap.add_argument('--dir', default=None,
+                    help='PTPU_TELEMETRY_DIR port-file directory to '
+                         'discover endpoints from')
+    ap.add_argument('--watch', action='store_true',
+                    help='redraw until interrupted')
+    ap.add_argument('--interval', type=float, default=2.0,
+                    help='seconds between scrapes (default 2)')
+    ap.add_argument('--timeout', type=float, default=5.0,
+                    help='per-endpoint scrape timeout')
+    args = ap.parse_args(argv)
+
+    agg = build_aggregator(args)
+    summary = agg.scrape_once(timeout=args.timeout)
+    try:
+        while True:
+            time.sleep(max(0.1, args.interval))
+            if args.dir:
+                agg.add_dir(args.dir)   # late-published ports join in
+            summary = agg.scrape_once(timeout=args.timeout)
+            health = agg.scrape_health(timeout=args.timeout)
+            out = '\n'.join(render(agg, health))
+            if args.watch:
+                # clear + home, then the table: a cheap top(1) redraw
+                sys.stdout.write('\x1b[2J\x1b[H' + out + '\n')
+                sys.stdout.flush()
+            else:
+                print(out)
+                break
+    except KeyboardInterrupt:
+        pass
+    return 0 if summary['scraped'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

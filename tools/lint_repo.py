@@ -46,6 +46,13 @@ Rules (each encodes a convention the codebase actually relies on):
   ``Executor.run`` so the ``PTPU_AOT_CACHE`` cold-start store
   (SERVING.md "Self-driving fleet") can serve them; a bypassing jit
   silently turns millisecond warm starts back into recompiles.
+- ``http-outside-telemetry``: an ``http.server`` import (or an
+  ``HTTPServer``/``ThreadingHTTPServer`` stand-up) outside
+  ``paddle_tpu/observability/telemetry.py`` — the telemetry plane is
+  the ONE sanctioned HTTP surface (OBSERVABILITY.md "Telemetry
+  plane"), so exposition format, handler timeouts and port-file
+  publication cannot fork; the multihost remote protocol is a raw
+  loopback socket on purpose and stays out of this rule's scope.
 - ``kv-alloc-outside-pool``: a raw numpy buffer allocation
   (``np.zeros``/``empty``/``full``/``ones``) bound to a KV-named
   target in ``paddle_tpu/serving/`` or ``paddle_tpu/fleet/`` — KV
@@ -84,6 +91,14 @@ JIT_SANCTIONED = os.path.join('paddle_tpu', 'fleet', 'coldstart.py')
 KV_FORBIDDEN_PACKAGES = ('serving', 'fleet')
 KV_ALLOC_FNS = ('zeros', 'empty', 'full', 'ones', 'zeros_like',
                 'empty_like', 'full_like', 'ones_like')
+# the one sanctioned http.server stand-up: the telemetry plane owns
+# every scrape endpoint so exposition/handler behavior never forks.
+# (The remote-cell pickle protocol is a raw socket, not http — scoping
+# this rule to http.server keeps it out of scope by construction.)
+TELEMETRY_SANCTIONED = os.path.join('paddle_tpu', 'observability',
+                                    'telemetry.py')
+HTTP_SERVER_CLASSES = ('HTTPServer', 'ThreadingHTTPServer',
+                       'BaseHTTPRequestHandler')
 
 # rule:path:detail -> accepted occurrences. Add entries ONLY with a
 # review note; the lint test pins this set.
@@ -248,6 +263,24 @@ def lint_file(path, relpath):
     out = []
     metrics = {}
     for node in ast.walk(tree):
+        if relpath != TELEMETRY_SANCTIONED:
+            if isinstance(node, ast.Import) and any(
+                    a.name == 'http.server' or
+                    a.name.startswith('http.server.')
+                    for a in node.names):
+                out.append(Violation(
+                    'http-outside-telemetry', relpath, node.lineno,
+                    'import http.server: scrape endpoints live in '
+                    'observability/telemetry.py only (serve_telemetry)'
+                ))
+            elif isinstance(node, ast.ImportFrom) \
+                    and node.module == 'http.server':
+                out.append(Violation(
+                    'http-outside-telemetry', relpath, node.lineno,
+                    'from http.server import %s: scrape endpoints '
+                    'live in observability/telemetry.py only '
+                    '(serve_telemetry)'
+                    % ', '.join(a.name for a in node.names)))
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             out.append(Violation('bare-except', relpath, node.lineno,
                                  'bare except: catches SystemExit/'
@@ -388,6 +421,7 @@ def main(argv=None):
         print('rules: bare-except, lock-outside-with, unguarded-emit, '
               'span-not-ended, direct-cost-analysis, '
               'jit-on-warmup-path, kv-alloc-outside-pool, '
+              'http-outside-telemetry, '
               'dup-metric-name (across %s)'
               % '/'.join(METRIC_PACKAGES))
         return 0

@@ -38,8 +38,15 @@ coldstart`` for an AOT-warmed run — ``coldstart`` records must show
 both a store save and a warm hit; ``--require kvcache`` for a
 paged-KV / disaggregated-prefill run — ``kvcache`` records must show
 both page-pool allocs and at least one prefilled prompt (SERVING.md
-"Paged KV-cache & disaggregated prefill"); ``--require any`` for
-presence only).
+"Paged KV-cache & disaggregated prefill"); ``--require slo`` for a
+run under declared service-level objectives — ``slo`` records must
+show a burn-rate breach AND a recovery (OBSERVABILITY.md "SLO burn
+rates"); ``--require telemetry`` for a run scraped through the live
+telemetry plane — ``telemetry`` records must show an aggregator
+scrape (OBSERVABILITY.md "Telemetry plane"); ``--require any`` for
+presence only). Run ``--list-requires`` for the full machine-derived
+catalog — the argparse choices come straight from ``REQUIRED_EV``, so
+the list above can lag but the tool cannot.
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
 load run writes.
 """
@@ -94,7 +101,38 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # insists at least one prompt was actually prefilled
                # (action='prefill'), not just pages cycled
                'kvcache': 'kvcache',
+               # a run under declared SLOs must show the burn-rate
+               # engine both breaching and recovering (the gate checks
+               # the state transitions, not mere presence)
+               'slo': 'slo',
+               # a run on the live telemetry plane must show endpoint
+               # lifecycle + at least one aggregator scrape that saw a
+               # live endpoint
+               'telemetry': 'telemetry',
                'any': None}
+
+# one-line purpose per family, keyed like REQUIRED_EV — rendered by
+# --list-requires so the CLI self-documents without re-reading this file
+REQUIRE_DOC = {
+    'step': 'training journal holds step_end records',
+    'serving': 'serving soak holds serving_batch records',
+    'pipeline': 'step_end records carry feed_wait (pipelined trainer)',
+    'compiler': 'compile_pass records (compiler pass pipeline ran)',
+    'partition': 'partition records (Partitioner placed work)',
+    'resilience': 'preempt_save / reshard records',
+    'fleet': 'fleet / decode records (router or decode engine ran)',
+    'zero': 'zero / collective records (ZeRO-2 applied or measured)',
+    'multihost': 'multihost lifecycle; host losses inside the window',
+    'analysis': 'analysis records (static verifier ran)',
+    'tracing': 'completed span_end records',
+    'perf': 'perf_ledger records (cost/memory capture ran)',
+    'autoscale': 'autoscale records incl. an acted scale decision',
+    'coldstart': 'coldstart records incl. a store save and a warm hit',
+    'kvcache': 'kvcache records incl. page allocs and a prefill',
+    'slo': 'slo records incl. a burn-rate breach and a recovery',
+    'telemetry': 'telemetry records incl. an aggregator scrape',
+    'any': 'presence only (any well-formed journal passes)',
+}
 
 
 def load_journal(path):
@@ -816,6 +854,23 @@ def check_journal(path, require='step'):
             problems.append(
                 'kvcache journal shows no page alloc — the pool was '
                 'never exercised')
+    if require == 'slo':
+        states = {r.get('state') for r in records if r['ev'] == 'slo'}
+        if 'breach' not in states:
+            problems.append(
+                'slo journal shows no burn-rate breach — the error '
+                'budget was never pressured')
+        if 'recovered' not in states:
+            problems.append(
+                'slo journal shows no recovery — every breached '
+                'objective stayed breached to the end of the run')
+    if require == 'telemetry':
+        actions = {r.get('action') for r in records
+                   if r['ev'] == 'telemetry'}
+        if 'scrape' not in actions:
+            problems.append(
+                'telemetry journal shows no aggregator scrape — '
+                'endpoints may have served but nothing merged them')
     if require == 'multihost':
         # a host loss the monitor only noticed after its own heartbeat
         # window means detection is broken even if recovery worked
@@ -832,9 +887,23 @@ def check_journal(path, require='step'):
     return problems
 
 
+def list_requires():
+    """The --list-requires catalog: every --require family with the
+    journal events it insists on, straight from REQUIRED_EV."""
+    lines = []
+    for fam in sorted(REQUIRED_EV):
+        need = REQUIRED_EV[fam]
+        evs = ('-' if need is None else
+               ' | '.join(need if isinstance(need, tuple) else (need,)))
+        lines.append('%-11s %-24s %s'
+                     % (fam, evs, REQUIRE_DOC.get(fam, '')))
+    return '\n'.join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
-    ap.add_argument('journal', help='path to a RunJournal .jsonl file')
+    ap.add_argument('journal', nargs='?', default=None,
+                    help='path to a RunJournal .jsonl file')
     ap.add_argument('--top', type=int, default=10,
                     help='slowest spans to list')
     ap.add_argument('--json', default=None, metavar='PATH',
@@ -844,8 +913,18 @@ def main(argv=None):
                          'an empty/malformed/step-less journal')
     ap.add_argument('--require', default='step',
                     choices=sorted(REQUIRED_EV),
-                    help='record type --smoke insists on (default: step)')
+                    help='record family --smoke insists on (default: '
+                         'step; see --list-requires for the catalog)')
+    ap.add_argument('--list-requires', action='store_true',
+                    help='print every --require family with the '
+                         'journal events it gates on, then exit')
     args = ap.parse_args(argv)
+
+    if args.list_requires:
+        print(list_requires())
+        return 0
+    if args.journal is None:
+        ap.error('journal path required (or use --list-requires)')
 
     if args.smoke:
         problems = check_journal(args.journal, require=args.require)

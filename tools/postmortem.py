@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Render a flight-recorder postmortem bundle into a human report.
+
+The input is the atomic JSON bundle the crash flight recorder
+(``paddle_tpu.observability.flight``) dumps when something trips — a
+watchdog, a breaker opening, an anomaly guard, a replica kill, or
+SIGTERM. The report answers the incident question the run journal
+cannot: *what was this process doing right before it died* — the tail
+of the event ring, the spans still open at dump time, the last health
+and metrics snapshot.
+
+    python tools/postmortem.py /tmp/flight/postmortem-*.json
+    python tools/postmortem.py --latest /tmp/flight   # newest bundle
+    python tools/postmortem.py bundle.json --ring 50  # longer tail
+
+Exits nonzero when the bundle is missing, unparsable, or not a
+schema-matched flight bundle — so ``fleet_bench``'s kill gate can use
+a successful render as proof the dump path works end to end.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from paddle_tpu.observability import flight  # noqa: E402
+
+
+def find_latest(directory):
+    """Newest ``postmortem-*.json`` under ``directory``, or None."""
+    paths = glob.glob(os.path.join(directory, 'postmortem-*.json'))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+def render(bundle, ring_tail=20):
+    lines = [
+        '----------------->   Postmortem Bundle   <-----------------',
+        'reason:   %s  (pid %s, %s)'
+        % (bundle['reason'], bundle.get('pid'),
+           time.strftime('%Y-%m-%d %H:%M:%S',
+                         time.localtime(bundle.get('wall', 0)))),
+    ]
+    ctx = bundle.get('context') or {}
+    if ctx:
+        lines.append('context:  %s' % ' '.join(
+            '%s=%s' % kv for kv in sorted(ctx.items())))
+
+    spans = bundle.get('live_spans') or []
+    if spans:
+        wall = bundle.get('wall', 0.0)
+        lines.append('unclosed spans (%d — work that died in flight):'
+                     % len(spans))
+        for s in spans:
+            age = max(0.0, wall - s.get('since_wall', wall))
+            lines.append('  %-28s open %8.3fs  trace=%s span=%s'
+                         % (s.get('name', '?'), age,
+                            (s.get('trace') or '?')[:16],
+                            (s.get('span') or '?')[:16]))
+    else:
+        lines.append('unclosed spans: none')
+
+    health = bundle.get('health')
+    if health:
+        lines.append('health:   %s (%d provider(s))'
+                     % (health.get('status'),
+                        len(health.get('providers') or {})))
+        for name, doc in sorted((health.get('providers')
+                                 or {}).items()):
+            if isinstance(doc, dict):
+                detail = ' '.join(
+                    '%s=%s' % (k, doc[k]) for k in sorted(doc)
+                    if k != 'status' and not isinstance(
+                        doc[k], (dict, list)))[:100]
+                lines.append('  %-22s %-10s %s'
+                             % (name, doc.get('status', '?'), detail))
+
+    ring = bundle.get('ring') or []
+    tail = ring[-ring_tail:]
+    lines.append('event ring: %d event(s) captured, showing last %d:'
+                 % (len(ring), len(tail)))
+    for ev in tail:
+        detail = ' '.join(
+            '%s=%s' % (k, ev[k]) for k in sorted(ev)
+            if k not in ('ev', 'wall', 'run', 't'))[:120]
+        lines.append('  %s %-14s %s'
+                     % (time.strftime(
+                         '%H:%M:%S', time.localtime(ev.get('wall', 0))),
+                        ev.get('ev', '?'), detail))
+
+    ledgers = bundle.get('ledgers') or []
+    if ledgers:
+        lines.append('perf ledgers: %d program(s), top by bytes:'
+                     % len(ledgers))
+        for d in ledgers[:5]:
+            lines.append('  %-20s %12s bytes  %10s flops'
+                         % ((d.get('program') or
+                             str(d.get('fp'))[:12]),
+                            d.get('bytes_accessed', '-'),
+                            d.get('flops', '-')))
+
+    metrics = bundle.get('metrics')
+    if metrics:
+        lines.append('metrics snapshot: %d metric(s) (use --json for '
+                     'the full dump)' % len(metrics))
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    ap.add_argument('bundle', nargs='?', default=None,
+                    help='path to a postmortem-*.json bundle')
+    ap.add_argument('--latest', default=None, metavar='DIR',
+                    help='render the newest bundle under DIR instead')
+    ap.add_argument('--ring', type=int, default=20,
+                    help='ring-tail events to show (default 20)')
+    ap.add_argument('--json', action='store_true',
+                    help='dump the raw bundle as JSON instead')
+    args = ap.parse_args(argv)
+
+    path = args.bundle
+    if args.latest:
+        path = find_latest(args.latest)
+        if path is None:
+            print('no postmortem-*.json bundle under %s'
+                  % args.latest, file=sys.stderr)
+            return 1
+    if path is None:
+        ap.error('bundle path required (or --latest DIR)')
+    try:
+        bundle = flight.read_bundle(path)
+    except (OSError, ValueError) as e:
+        print('cannot read bundle %s: %s' % (path, e), file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(bundle, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    print('\n'.join(render(bundle, ring_tail=args.ring)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
