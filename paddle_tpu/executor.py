@@ -872,6 +872,15 @@ class Executor(object):
                                  sorted(feed.keys()), fetch_names,
                                  state_in_names, state_out_names,
                                  dynamic=dynamic, static_env=static_env)
+                # State donation is unsafe for compilations that get
+                # sealed to the AOT store: serialize_executable keeps
+                # the XLA-side input_output_alias but the round trip
+                # loses jax's dispatch-side donation bookkeeping, and a
+                # deserialized aliased executable scribbles over state
+                # buffers other bucket executables still hold (silent
+                # garbage, not an error). Donation-free sealing costs
+                # one state-buffer copy per dispatch on AOT-gated runs.
+                donate = () if aot_store is not None else (1,)
                 if profiling or dynamic:
                     # Per-op profiling and dynamic (beam-decode) programs
                     # run UN-jitted: the lowering executes op by op on the
@@ -900,7 +909,7 @@ class Executor(object):
                         jitted = part.partition(
                             fn, in_shardings=(feeds_s, state_s),
                             out_shardings=(fetch_s, out_state_s),
-                            donate_argnums=(1,))
+                            donate_argnums=donate)
                 elif guard:
                     # Debug mode: functionalize the per-op NaN/Inf checks.
                     # No donation — on a thrown error the scope must still
@@ -908,7 +917,7 @@ class Executor(object):
                     from jax.experimental import checkify
                     jitted = jax.jit(checkify.checkify(fn))
                 else:
-                    jitted = part.partition(fn, donate_argnums=(1,))
+                    jitted = part.partition(fn, donate_argnums=donate)
                 jitted = self._apply_tuning(key, jitted)
                 self._cache[key] = jitted
             elif entry is not None:

@@ -47,7 +47,8 @@ from .router import (Router, RoutedRequest, PlacementBudget,  # noqa
                      ACTIVE, QUARANTINED, DEPLOYING, RESTARTING,
                      DEAD, STATE_CODES)
 from .supervisor import ReplicaSupervisor  # noqa
-from .autoscaler import Autoscaler  # noqa
+from .autoscaler import Autoscaler, ReplicaBackend  # noqa
+from .remote_backend import RemoteBackend  # noqa
 from .decode import (DecodeEngine, DecodeRequest,  # noqa
                      recurrent_fc_cell, attention_history_cell)
 from . import coldstart  # noqa
@@ -56,7 +57,7 @@ __all__ = [
     'FleetError', 'NoHealthyReplica', 'PlacementInfeasible',
     'ReplicaRetired', 'RequeueExhausted',
     'Router', 'RoutedRequest', 'PlacementBudget', 'ReplicaSupervisor',
-    'Autoscaler', 'coldstart',
+    'Autoscaler', 'ReplicaBackend', 'RemoteBackend', 'coldstart',
     'ACTIVE', 'QUARANTINED', 'DEPLOYING', 'RESTARTING', 'DEAD',
     'STATE_CODES',
     'DecodeEngine', 'DecodeRequest', 'recurrent_fc_cell',

@@ -43,9 +43,34 @@ import time
 from .. import observability as _obs
 from .router import ACTIVE
 
-__all__ = ['Autoscaler', 'Signals']
+__all__ = ['Autoscaler', 'ReplicaBackend', 'Signals']
 
 logger = logging.getLogger('paddle_tpu.fleet')
+
+
+class ReplicaBackend(object):
+    """Scale-up provisioning policy: which backend the next replica
+    comes from (RESILIENCE.md "Cross-host elasticity").
+
+    The default shape is fill-local-then-go-remote: in-process
+    replicas while the fleet is below ``local_max`` (cheap, share the
+    host), remote cell processes beyond it (cross the host boundary
+    through ``Router.add_replica(backend='remote')``, which needs the
+    router built with a ``fleet.RemoteBackend``). ``local_max=None``
+    never goes remote — the pre-elastic behavior. Pass an instance as
+    ``Autoscaler(replica_backend=...)``; any object with a
+    ``choose(signals) -> backend`` method (or a bare callable) works
+    in its place."""
+
+    def __init__(self, local_max=None, remote='remote'):
+        self.local_max = None if local_max is None else int(local_max)
+        self.remote = remote
+
+    def choose(self, signals):
+        if self.local_max is not None and \
+                signals.replicas >= self.local_max:
+            return self.remote
+        return None
 
 
 class Signals(object):
@@ -105,7 +130,8 @@ class Autoscaler(object):
                  high_queue=4.0, low_queue=0.5, high_shed_rate=0.05,
                  p99_slo_s=None, sustain=3, up_cooldown=5.0,
                  down_cooldown=10.0, interval=0.5, p99_probe=None,
-                 slo_probe=None, clock=time.monotonic):
+                 slo_probe=None, replica_backend=None,
+                 clock=time.monotonic):
         floor = max(1, router.replication or 1)
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError('need 1 <= min_replicas <= max_replicas')
@@ -122,6 +148,10 @@ class Autoscaler(object):
         self.interval = interval
         self.p99_probe = p99_probe
         self.slo_probe = slo_probe
+        # provisioning policy: choose(signals) -> backend for the next
+        # scale-up (None = router factory, 'remote' = cell process via
+        # the router's RemoteBackend); see :class:`ReplicaBackend`
+        self.replica_backend = replica_backend
         self.clock = clock
         self._stop = threading.Event()
         self._thread = None
@@ -312,7 +342,12 @@ class Autoscaler(object):
         if now < self._next_up:
             return self._hold(sig, 'up', 'up-cooldown %.1fs remaining'
                               % (self._next_up - now))
-        rid = self.router.add_replica()
+        backend = None
+        if self.replica_backend is not None:
+            backend = self.replica_backend.choose(sig) \
+                if hasattr(self.replica_backend, 'choose') \
+                else self.replica_backend(sig)
+        rid = self.router.add_replica(backend=backend)
         self._over = self._under = 0
         self._next_up = now + self.up_cooldown
         # a fresh replica needs at least one cooldown of signal before
@@ -322,8 +357,11 @@ class Autoscaler(object):
         self.scale_ups += 1
         self._m_ups.inc()
         self._g_replicas.set(sig.replicas + 1)
+        label = backend if isinstance(backend, str) else (
+            'inprocess' if backend is None
+            else getattr(backend, '__name__', 'custom'))
         _obs.emit('autoscale', action='scale_up', replica=rid,
-                  reason=why, **sig.as_dict())
+                  backend=label, reason=why, **sig.as_dict())
         logger.info('autoscaler: scale-up -> replica %d (%s)', rid,
                     why)
         return 'scale_up'

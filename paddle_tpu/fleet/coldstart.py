@@ -50,12 +50,17 @@ import time
 from .. import observability as _obs
 
 __all__ = ['AOT_CACHE_ENV', 'AotStore', 'cache_dir', 'cache_scope',
-           'enabled', 'default_store', 'key_hash', 'token']
+           'enabled', 'default_store', 'export_env', 'key_hash',
+           'token']
 
 logger = logging.getLogger('paddle_tpu.fleet')
 
 AOT_CACHE_ENV = 'PTPU_AOT_CACHE'
-_SCHEMA = 1
+# schema 2: entries are sealed WITHOUT state donation — a schema-1
+# executable carries input_output_alias metadata whose jax-side
+# dispatch bookkeeping does not survive the serialize round trip, and
+# deserializing one corrupts state buffers shared across shape buckets
+_SCHEMA = 2
 _SUFFIX = '.aotx'
 
 _lock = threading.Lock()
@@ -73,6 +78,19 @@ def cache_dir():
 
 def enabled():
     return cache_dir() is not None
+
+
+def export_env(env):
+    """Spawned-replica env contract (RESILIENCE.md "Cross-host
+    elasticity"): copy the ACTIVE cache dir — including a
+    process-local :func:`cache_scope` override the child could never
+    observe — into ``env`` as ``PTPU_AOT_CACHE``, so a remote cell's
+    ``warmup()`` deserializes from the same store the parent sealed.
+    No-op when the gate is closed. Returns ``env``."""
+    d = cache_dir()
+    if d:
+        env[AOT_CACHE_ENV] = os.path.abspath(d)
+    return env
 
 
 @contextlib.contextmanager

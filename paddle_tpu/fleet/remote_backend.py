@@ -1,0 +1,180 @@
+"""Remote replica backend: the fleet's actuator across the host
+boundary (RESILIENCE.md "Cross-host elasticity").
+
+``Router.add_replica(backend='remote')`` delegates here:
+:meth:`RemoteBackend.build` provisions a replica as a cell PROCESS via
+:func:`multihost.spawn_cell` — its own "host" that can be killed,
+partitioned or wedged independently of the router — wired into two
+fleet contracts at spawn time:
+
+- **liveness**: the cell heartbeats into the backend's shared dir
+  (``PTPU_HB_DIR`` env contract, first beat before the cell even
+  constructs its server); :meth:`probe` runs a
+  :class:`~paddle_tpu.multihost.heartbeat.HostMonitor` scan each
+  supervisor poll and declares a stale/missing cell DEAD in the router
+  — unroutable *before* its next RPC fails — tripping the flight
+  recorder and journaling the ``fleet host_lost`` event the
+  ``obs_report --require remote_elastic`` gate checks;
+- **cold start**: the parent's active AOT cache dir (env or
+  ``coldstart.cache_scope``) is exported into the child, so the
+  placement replay's ``warmup()`` deserializes sealed executables
+  instead of recompiling.
+
+Heartbeat window math: a cell beats every ``interval_of(window/10)``
+seconds and is stale once its file age exceeds ``window``; with the
+supervisor polling every ``poll_interval``, worst-case detection
+latency after a silent death is ``window + beat_interval +
+poll_interval`` — the journaled ``detect_s`` (file age at detection)
+is therefore bounded by that, never by an RPC deadline.
+
+Telemetry: ``fleet_remote_replicas`` gauge (cells currently mapped),
+plus the ``remote_spawn_seconds`` histogram and
+``remote_rpc_retries_total`` counter maintained by
+``multihost.remote``.
+"""
+import os
+import threading
+import time
+
+from .. import observability as _obs
+from ..multihost.heartbeat import HostMonitor, remove_heartbeat
+from ..multihost.remote import spawn_cell
+from .router import DEAD
+
+__all__ = ['RemoteBackend']
+
+
+class RemoteBackend(object):
+    """Provisioner + liveness prober for remote replicas.
+
+    One instance per Router (pass it as ``Router(...,
+    remote_backend=...)``). ``build(rid)`` spawns a cell and maps the
+    replica id to a monotonically assigned host id; ``probe(router)``
+    scans the heartbeat dir and takes stale/missing cells out of the
+    routable set; ``forget(rid)`` releases a mapping the fleet
+    scaled in."""
+
+    def __init__(self, heartbeat_dir, window=5.0, devices=1,
+                 kind='serve', spawn_timeout=180.0, startup_grace=60.0,
+                 idle_timeout=None, env=None):
+        self.heartbeat_dir = str(heartbeat_dir)
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        self.window = float(window)
+        self.devices = devices
+        self.kind = kind
+        self.spawn_timeout = spawn_timeout
+        # bounds how long a just-spawned cell may run before its first
+        # beat counts as a loss (interpreter + jax import are slow)
+        self.startup_grace = float(startup_grace)
+        self.idle_timeout = idle_timeout
+        self.env = dict(env or {})
+        self._lock = threading.Lock()
+        self._next_host = 0
+        self._hosts = {}   # rid -> {'host', 'cell', 'since'}
+        self._monitor = HostMonitor(self.heartbeat_dir, window=window)
+        self._g_remote = _obs.default_registry().gauge(
+            'fleet_remote_replicas',
+            'replicas currently backed by remote cell processes')
+
+    # ---- provisioning ----------------------------------------------------
+    def build(self, rid):
+        """Spawn a cell process for replica ``rid`` and register it
+        with the liveness prober. Called by the Router for
+        ``add_replica(backend='remote')`` AND by ``restart_replica``
+        when the supervisor rebuilds a dead remote replica — a rebuilt
+        replica gets a fresh host id, so its dead predecessor's file
+        can never shadow the new cell's beats."""
+        with self._lock:
+            host = self._next_host
+            self._next_host += 1
+            prev = self._hosts.pop(rid, None)
+        if prev is not None:
+            # rebuilding over a lost cell: retire the old host's file
+            # so the monitor stops reporting the corpse as stale
+            remove_heartbeat(self.heartbeat_dir, prev['host'])
+        beat = max(0.05, self.window / 10.0)
+        cell = spawn_cell(
+            name='replica-%d' % rid, devices=self.devices,
+            env=dict(self.env), startup_timeout=self.spawn_timeout,
+            kind=self.kind, heartbeat_dir=self.heartbeat_dir,
+            host_id=host, heartbeat_interval=beat,
+            idle_timeout=self.idle_timeout)
+        with self._lock:
+            self._hosts[rid] = {'host': host, 'cell': cell,
+                                'since': time.monotonic()}
+            n = len(self._hosts)
+        self._g_remote.set(n)
+        return cell
+
+    def forget(self, rid):
+        """Release a replica's liveness mapping + heartbeat file (the
+        fleet retired it, or :meth:`probe` declared it lost)."""
+        with self._lock:
+            info = self._hosts.pop(rid, None)
+            n = len(self._hosts)
+        if info is not None:
+            remove_heartbeat(self.heartbeat_dir, info['host'])
+            self._g_remote.set(n)
+        return info
+
+    # ---- liveness --------------------------------------------------------
+    def probe(self, router):
+        """One liveness pass (the supervisor drives this through
+        ``router.probe_liveness()`` every poll). A mapped cell whose
+        heartbeat is stale — or still missing past the startup grace —
+        is declared DEAD in the router under the host-loss protocol:
+        flight recorder first (freeze the postmortem), then the state
+        flip that makes it unroutable, then the ``fleet host_lost``
+        journal event with the detection latency. A cell whose PROCESS
+        is already a corpse (SIGKILL, OOM, crash) is declared lost on
+        the spot — the probe runs before the supervisor's restart
+        branch, so the host-loss protocol fires even when the kernel
+        closed the socket faster than the heartbeat could go stale.
+        Returns the replica ids declared lost."""
+        with self._lock:
+            tracked = dict(self._hosts)
+        if not tracked:
+            return []
+        scan = self._monitor.scan()
+        bad = set(scan['stale'])
+        now = time.monotonic()
+        lost = []
+        for rid, info in sorted(tracked.items()):
+            with router._lock:
+                rep = router._replicas.get(rid)
+                current = rep is not None and rep.server is info['cell']
+            if not current:
+                # retired, or already rebuilt into a different cell:
+                # this mapping is a leftover, not a loss
+                self.forget(rid)
+                continue
+            proc = getattr(info['cell'], 'proc', None)
+            rc = proc.poll() if proc is not None else None
+            missing = info['host'] not in scan['ages']
+            if rc is None:
+                # process still running (possibly partitioned): the
+                # heartbeat window is the only judge of its liveness
+                if missing and now - info['since'] < self.startup_grace:
+                    continue
+                if not missing and info['host'] not in bad:
+                    continue
+            age = scan['ages'].get(info['host'])
+            detect_s = age if age is not None else now - info['since']
+            if rc is not None:
+                reason = 'process_exited:rc=%s' % rc
+                detect_s = age if age is not None else 0.0
+            elif age is None:
+                reason = 'heartbeat_missing'
+            else:
+                reason = 'heartbeat_stale:%.2fs' % age
+            # freeze the postmortem BEFORE the DEAD flip clears queues
+            _obs.flight.trip('remote_host_lost', replica=rid,
+                             host=info['host'], reason=reason)
+            router._set_state(rep, DEAD, reason='remote %s' % reason)
+            _obs.emit('fleet', action='host_lost', replica=rid,
+                      host=info['host'], reason=reason,
+                      detect_s=round(detect_s, 6),
+                      window_s=self.window)
+            self.forget(rid)
+            lost.append(rid)
+        return lost

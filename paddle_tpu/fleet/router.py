@@ -149,9 +149,9 @@ class PlacementBudget(object):
 
 class _Replica(object):
     __slots__ = ('id', 'server', 'state', 'generation', 'restarts',
-                 'unhealthy_polls', 'role')
+                 'unhealthy_polls', 'role', 'backend')
 
-    def __init__(self, rid, server):
+    def __init__(self, rid, server, backend='inprocess'):
         self.id = rid
         self.server = server
         self.state = ACTIVE
@@ -163,6 +163,10 @@ class _Replica(object):
         # kind='prefill') — role-tagged placements only ring over
         # replicas whose cells match
         self.role = getattr(server, 'role', 'serve')
+        # provisioning backend: 'inprocess' (router factory) or
+        # 'remote' (fleet.RemoteBackend cell process) — restart
+        # rebuilds through the SAME backend
+        self.backend = backend
 
 
 class RoutedRequest(object):
@@ -322,13 +326,18 @@ class Router(object):
     def __init__(self, factory, replicas=2, replication=None,
                  supervise=True, poll_interval=0.2, max_requeues=None,
                  requeue_wait=5.0, warmup_on_load=True,
-                 wedge_restart_after=20, placement_budget=None):
+                 wedge_restart_after=20, placement_budget=None,
+                 remote_backend=None):
         if replicas < 1:
             raise ValueError('replicas must be >= 1')
         if replication is not None and \
                 not 1 <= replication <= replicas:
             raise ValueError('replication must be in [1, replicas]')
         self.factory = factory
+        # fleet.RemoteBackend (or None): provisions replicas as remote
+        # cell processes for add_replica(backend='remote') and probes
+        # their heartbeats each supervisor poll (probe_liveness)
+        self.remote_backend = remote_backend
         self.replication = replication
         self.poll_interval = poll_interval
         self.max_requeues = max_requeues if max_requeues is not None \
@@ -705,6 +714,17 @@ class Router(object):
             self._set_state(rep, ACTIVE, reason='healthy again')
         return ACTIVE
 
+    def probe_liveness(self):
+        """One heartbeat pass over remote replicas (no-op without a
+        remote backend). The supervisor calls this every poll, so a
+        cell whose host stopped beating is marked DEAD — unroutable —
+        BEFORE any request has to fail an RPC against it; the
+        supervisor then rebuilds it through the backend. Returns the
+        replica ids declared lost this pass."""
+        if self.remote_backend is None:
+            return []
+        return self.remote_backend.probe(self)
+
     def restart_replica(self, rid):
         """Rebuild a dead replica from the factory and replay every
         model placed on it (the supervisor's repair path; also a
@@ -735,7 +755,10 @@ class Router(object):
                 old_server.close(timeout=1.0)
             except Exception:  # noqa: BLE001 — already-broken server
                 pass
-            server = self.factory(rid)
+            # rebuild through the SAME backend that provisioned the
+            # replica: a dead remote cell comes back as a fresh
+            # process on a fresh "host", not as an in-process stand-in
+            server = self._build_server(rid, rep.backend)
             for name, rec in sorted(placements.items()):
                 self._load_into(server, name, rec)
             with self._lock:
@@ -746,6 +769,9 @@ class Router(object):
             self._set_state(rep, ACTIVE, reason='restarted')
             _obs.emit('fleet', action='restart', replica=rid,
                       models=sorted(placements),
+                      backend=rep.backend if
+                      isinstance(rep.backend, str)
+                      else getattr(rep.backend, '__name__', 'custom'),
                       dur_s=round(time.monotonic() - t0, 6))
             return rep
         except Exception as e:
@@ -774,28 +800,63 @@ class Router(object):
         return rep
 
     # ---- elastic fleet (autoscaler actuators) ----------------------------
-    def add_replica(self):
-        """Scale-out: build a fresh replica from the factory (a never
-        reused id), rebalance every placement ring over the grown
-        fleet and replay model loads onto the newcomer. With the AOT
+    def _build_server(self, rid, backend):
+        """Provision a replica cell through the named backend:
+        ``None``/``'inprocess'`` is the router factory, ``'remote'``
+        goes through :attr:`remote_backend` (a spawned cell process on
+        its own "host"), a callable is used directly (tests)."""
+        if backend in (None, 'inprocess'):
+            return self.factory(rid)
+        if backend == 'remote':
+            if self.remote_backend is None:
+                raise ValueError(
+                    "add_replica(backend='remote') needs a Router "
+                    'built with remote_backend=fleet.RemoteBackend('
+                    '...)')
+            return self.remote_backend.build(rid)
+        if callable(backend):
+            return backend(rid)
+        raise ValueError('unknown replica backend %r' % (backend,))
+
+    def add_replica(self, backend=None):
+        """Scale-out: build a fresh replica (a never reused id),
+        rebalance every placement ring over the grown fleet and replay
+        model loads onto the newcomer. ``backend='remote'`` provisions
+        the replica as a cell process on another "host" via
+        :attr:`remote_backend` — crossing the host boundary with the
+        same actuator the autoscaler already drives. With the AOT
         cold-start cache enabled (fleet/coldstart.py) the replay's
-        warmup deserializes executables instead of recompiling, so the
-        new replica serves within milliseconds of the factory
-        returning. Returns the new replica id."""
+        warmup deserializes executables instead of recompiling — for a
+        remote cell the cache dir is exported into the child env, so
+        even the cross-host cold start is I/O-bound. Returns the new
+        replica id."""
         with self._lock:
             if self._closed:
                 raise ServerClosed('router is shut down')
             rid = self._next_rid
             self._next_rid += 1
         t0 = time.monotonic()
-        server = self.factory(rid)     # slow: outside the lock
+        server = self._build_server(rid, backend)  # slow: no lock held
+        # normalize: None means the factory; a callable is kept as-is
+        # so restart_replica can rebuild through it
+        stored = 'inprocess' if backend in (None, 'inprocess') \
+            else backend
+        kind = stored if isinstance(stored, str) \
+            else getattr(stored, '__name__', 'custom')
         with self._lock:
-            self._replicas[rid] = _Replica(rid, server)
+            self._replicas[rid] = _Replica(rid, server, backend=stored)
         self._publish_state(rid, ACTIVE)
         self._rebalance(reason='scale-out replica %d' % rid)
+        dur_s = round(time.monotonic() - t0, 6)
+        if kind == 'remote':
+            # the remote-elastic journal contract (obs_report
+            # --require remote_elastic): the fleet grew across a host
+            # boundary, and how long the spawn+replay took
+            _obs.emit('fleet', action='spawn_remote', replica=rid,
+                      pid=getattr(server, 'pid', None), dur_s=dur_s)
         _obs.emit('fleet', action='scale_up', replica=rid,
-                  replicas=sorted(self._replicas),
-                  dur_s=round(time.monotonic() - t0, 6))
+                  replicas=sorted(self._replicas), backend=kind,
+                  dur_s=dur_s)
         return rid
 
     def retire_replica(self, rid, timeout=5.0):
@@ -837,6 +898,10 @@ class Router(object):
             rep.server.close(timeout=timeout)
         except Exception:  # noqa: BLE001 — survivors keep serving
             logger.exception('closing retired replica %d failed', rid)
+        if rep.backend == 'remote' and self.remote_backend is not None:
+            # drop the liveness mapping + heartbeat file NOW: a
+            # scaled-in cell must never be reported as a lost host
+            self.remote_backend.forget(rid)
         reg = _obs.default_registry()
         reg.remove('fleet_replica_state', replica=str(rid))
         reg.remove('router_routed_total', replica=str(rid))

@@ -72,6 +72,15 @@ class ReplicaSupervisor(object):
         """One pass over the fleet; returns the per-replica states it
         observed (tests drive this directly for determinism)."""
         router = self.router
+        # heartbeat liveness FIRST: a remote cell whose host went
+        # silent is flipped DEAD here — before any health() RPC could
+        # hang on it — and then rebuilt through its backend by the
+        # DEAD branch below like any other dead replica
+        try:
+            router.probe_liveness()
+        except Exception:  # noqa: BLE001 — a broken prober must not
+            # stop the repair loop from polling the fleet
+            logger.exception('remote liveness probe failed')
         with router._lock:
             reps = list(router._replicas.values())
         states = {}

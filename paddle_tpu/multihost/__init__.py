@@ -32,15 +32,17 @@ from .bootstrap import (initialize, barrier, broadcast_int,  # noqa
                         agreement_check)
 from .heartbeat import (HeartbeatWriter, HostMonitor,  # noqa: F401
                         start_heartbeat, stop_heartbeat,
-                        heartbeat_path)
+                        heartbeat_path, remove_heartbeat)
 from .launcher import launch, free_port, LaunchResult  # noqa: F401
+from .remote import RemoteCell, spawn_cell, serve  # noqa: F401
 from .events import mh_emit, JOURNAL_ENV  # noqa: F401
 
 __all__ = [
     'MultihostError', 'BootstrapTimeout', 'HostMismatch', 'HostLost',
     'initialize', 'barrier', 'broadcast_int', 'agreement_check',
     'HeartbeatWriter', 'HostMonitor', 'start_heartbeat',
-    'stop_heartbeat', 'heartbeat_path',
+    'stop_heartbeat', 'heartbeat_path', 'remove_heartbeat',
     'launch', 'free_port', 'LaunchResult',
+    'RemoteCell', 'spawn_cell', 'serve',
     'mh_emit', 'JOURNAL_ENV',
 ]

@@ -20,8 +20,9 @@ import time
 
 from .. import observability as _obs
 
-__all__ = ['DEFAULT_INTERVAL', 'heartbeat_path', 'HeartbeatWriter',
-           'HostMonitor', 'start_heartbeat', 'stop_heartbeat']
+__all__ = ['DEFAULT_INTERVAL', 'heartbeat_path', 'remove_heartbeat',
+           'HeartbeatWriter', 'HostMonitor', 'start_heartbeat',
+           'stop_heartbeat']
 
 DEFAULT_INTERVAL = 0.5
 _HB_RE = re.compile(r'^host_(\d+)\.hb$')
@@ -29,6 +30,17 @@ _HB_RE = re.compile(r'^host_(\d+)\.hb$')
 
 def heartbeat_path(dirname, host_id):
     return os.path.join(dirname, 'host_%03d.hb' % int(host_id))
+
+
+def remove_heartbeat(dirname, host_id):
+    """Retire a host's heartbeat file — a lost or scaled-in cell must
+    leave the directory, or every future scan keeps reporting it stale
+    (and its age gauge frozen). Returns whether a file was removed."""
+    try:
+        os.remove(heartbeat_path(dirname, host_id))
+        return True
+    except OSError:
+        return False
 
 
 class HeartbeatWriter(object):

@@ -34,33 +34,78 @@ import threading
 import time
 
 from .. import observability as _obs
+from ..resilience.faultinject import (FaultInjected, SITE_REMOTE_RECV,
+                                      SITE_REMOTE_SEND,
+                                      SITE_REMOTE_SPAWN, maybe_fault)
+from ..resilience.retry import RetryError, retry_call
 from ..serving.errors import (DeadlineExceeded, ServerClosed,
                               ServingError)
+from .events import mh_emit
+from .heartbeat import start_heartbeat, stop_heartbeat
 
-__all__ = ['RemoteCell', 'RemoteRequest', 'spawn_cell', 'serve']
+__all__ = ['RemoteCell', 'RemoteRequest', 'spawn_cell', 'serve',
+           'DEFAULT_IDLE_TIMEOUT']
 
 _LEN = struct.Struct('>I')
 
+# client-side reader wake-up bound (seconds): how long a recv may idle
+# before the reader checks the peer process is still alive. Overridden
+# per cell via spawn_cell(idle_timeout=) or PTPU_REMOTE_IDLE_TIMEOUT.
+DEFAULT_IDLE_TIMEOUT = 5.0
 
-def _send_msg(sock, obj, lock):
+
+def _idle_timeout(value=None):
+    if value is not None:
+        return float(value)
+    return float(os.environ.get('PTPU_REMOTE_IDLE_TIMEOUT',
+                                DEFAULT_IDLE_TIMEOUT))
+
+
+def _send_msg(sock, obj, lock, fault_site=None):
+    if fault_site is not None:
+        # before serialization and the wire: an injected send fault
+        # never emits bytes, so the framing stays intact (retryable)
+        maybe_fault(fault_site)
     blob = pickle.dumps(obj, protocol=4)
     with lock:
         sock.sendall(_LEN.pack(len(blob)) + blob)
 
 
-def _recv_exact(sock, n):
+def _recv_exact(sock, n, started=False):
+    """Read exactly ``n`` bytes. A socket timeout is only benign while
+    NOTHING of the frame has arrived and the caller says no frame is in
+    progress (``started=False``) — then it propagates as an idle tick
+    for the caller's liveness check. A timeout (or EOF) after partial
+    bytes means the peer died mid-frame: the stream can never re-sync,
+    so it raises a typed torn-frame ConnectionError."""
     buf = b''
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if started or buf:
+                raise ConnectionError(
+                    'torn frame: peer went quiet after %d of %d '
+                    'byte(s)' % (len(buf), n))
+            raise
         if not chunk:
+            if started or buf:
+                raise ConnectionError(
+                    'torn frame: connection closed after %d of %d '
+                    'byte(s)' % (len(buf), n))
             raise ConnectionError('remote cell connection closed')
         buf += chunk
     return buf
 
 
-def _recv_msg(sock):
-    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+def _recv_msg(sock, fault_site=None):
+    if fault_site is not None:
+        maybe_fault(fault_site)
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    # the length prefix arrived: from here on the frame is in progress
+    # and any stall/EOF is torn, never an idle tick
+    return pickle.loads(_recv_exact(sock, n, started=True))
 
 
 # ---- worker side ---------------------------------------------------------
@@ -94,13 +139,28 @@ def serve(port_file, place=None, kind='serve'):
     if jpath:
         jnl = _obs.RunJournal(jpath)
         _obs.set_journal(jnl)
+    # fleet liveness contract: a cell spawned with a heartbeat dir
+    # (PTPU_HB_DIR / PTPU_PROC_ID / PTPU_HB_INTERVAL) beats into it
+    # from the very top — BEFORE the slow cell construction below — so
+    # the prober sees the host live as early as possible
+    start_heartbeat()
     tel = _obs.install_env_telemetry(name='cell-%d' % os.getpid())
     if kind == 'prefill':
         from ..kvcache.prefill import PrefillServer
         srv = PrefillServer(place=place)
     elif kind == 'serve':
         from ..serving import ModelServer
-        srv = ModelServer(place=place)
+        # batch envelope contract: a cell standing in for a local
+        # replica must accept the same request sizes the router's
+        # local servers do, so the spawner exports the envelope into
+        # the child env (RemoteBackend(env=...)) instead of the cell
+        # guessing ModelServer defaults
+        kw = {}
+        if os.environ.get('PTPU_CELL_MAX_BATCH'):
+            kw['max_batch_size'] = int(os.environ['PTPU_CELL_MAX_BATCH'])
+        if os.environ.get('PTPU_CELL_MAX_QUEUE'):
+            kw['max_queue_depth'] = int(os.environ['PTPU_CELL_MAX_QUEUE'])
+        srv = ModelServer(place=place, **kw)
     else:
         raise ValueError("cell kind must be 'serve' or 'prefill', "
                          'got %r' % (kind,))
@@ -179,6 +239,7 @@ def serve(port_file, place=None, kind='serve'):
         except Exception:  # noqa: BLE001 — already closed
             pass
         conn.close()
+        stop_heartbeat()
         if tel is not None:
             tel.close()
         if jnl is not None:
@@ -248,7 +309,23 @@ class RemoteCell(object):
     def _read_loop(self):
         try:
             while True:
-                msg = _recv_msg(self._sock)
+                try:
+                    msg = _recv_msg(self._sock,
+                                    fault_site=SITE_REMOTE_RECV)
+                except socket.timeout:
+                    # bounded idle tick (socket.timeout subclasses
+                    # OSError, so it MUST be caught before the fatal
+                    # clause below): nothing arrived inside the idle
+                    # window — fine for a living idle peer, fatal for
+                    # one whose process is gone with the socket
+                    # half-open
+                    if self.proc is not None \
+                            and self.proc.poll() is not None:
+                        raise ConnectionError(
+                            'peer process exited rc=%s with the '
+                            'socket half-open'
+                            % self.proc.returncode)
+                    continue
                 with self._lock:
                     req = self._pending.pop(msg['id'], None)
                 if req is not None:
@@ -276,7 +353,17 @@ class RemoteCell(object):
             self._pending[mid] = req
         try:
             _send_msg(self._sock, {'id': mid, 'op': op, 'args': args,
-                                   'kwargs': kwargs}, self._send_lock)
+                                   'kwargs': kwargs}, self._send_lock,
+                      fault_site=SITE_REMOTE_SEND)
+        except FaultInjected:
+            # an injected send fault fires before any bytes hit the
+            # wire (see _send_msg), so the connection is still framed
+            # and healthy: drop the orphaned pending slot and let the
+            # caller (or _call_idempotent's retry) decide — FaultInjected
+            # is an IOError, so this clause must precede OSError below
+            with self._lock:
+                self._pending.pop(mid, None)
+            raise
         except (OSError, ConnectionError) as e:
             err = ServerClosed('remote cell %r unreachable: %r'
                                % (self.name, e))
@@ -288,6 +375,35 @@ class RemoteCell(object):
         timeout = kwargs.pop('_timeout', 120.0)
         return self._post(op, args, kwargs).result(timeout=timeout)
 
+    def _call_idempotent(self, op, *args, **kwargs):
+        """Read-only control ops (health, load_score, ...) retried
+        with bounded backoff on transient transport faults.
+
+        Only faults that provably never touched the wire are safely
+        retryable on this protocol — anything that emitted partial
+        bytes desyncs the length-prefixed framing and is terminal
+        (ServerClosed via ``_fail_all``). In practice that means the
+        ``remote/send`` injected faults plus pre-send errors; the
+        retry is what keeps a control probe alive through a blip the
+        fault plan (or a flaky loopback) models."""
+        timeout = kwargs.pop('_timeout', 10.0)
+        retries = _obs.default_registry().counter(
+            'remote_rpc_retries_total',
+            'idempotent remote-cell control ops retried after a '
+            'transient transport fault')
+
+        def _attempt():
+            return self._post(op, args, kwargs).result(timeout=timeout)
+
+        try:
+            return retry_call(_attempt, max_attempts=3, backoff=0.05,
+                              jitter=0.0, retry_on=(FaultInjected,),
+                              on_retry=lambda a, e: retries.inc())
+        except RetryError as e:
+            raise ServerClosed(
+                'remote cell %r control op %r kept faulting: %r'
+                % (self.name, op, e.last_error)) from e
+
     # ---- the cell surface the Router drives ----------------------------
     def submit(self, name, feeds, deadline=None, **kwargs):
         return self._post('submit', (name, feeds),
@@ -297,18 +413,23 @@ class RemoteCell(object):
         return self.submit(name, feeds,
                            deadline=deadline).result(timeout=timeout)
 
+    def ping(self):
+        """Round-trip liveness probe; returns the worker's pid."""
+        return self._call_idempotent('ping', _timeout=10.0)
+
     def health(self):
-        return self._call('health', _timeout=10.0)
+        return self._call_idempotent('health', _timeout=10.0)
 
     def telemetry_port(self):
         """The worker's scrape-endpoint port, or None when the cell
         was spawned without ``PTPU_TELEMETRY`` — feed it to
         :meth:`TelemetryAggregator.add_endpoint` for fleet rollups."""
-        return self._call('telemetry_port', _timeout=10.0)
+        return self._call_idempotent('telemetry_port', _timeout=10.0)
 
     def load_score(self, model_name=None):
         try:
-            return self._call('load_score', model_name, _timeout=10.0)
+            return self._call_idempotent('load_score', model_name,
+                                         _timeout=10.0)
         except ServerClosed:
             return float('inf')  # unroutable, not an exception path
 
@@ -347,16 +468,17 @@ class RemoteCell(object):
         return self._call('resume', model_name, _timeout=10.0)
 
     def queue_depth(self, model_name):
-        return self._call('queue_depth', model_name, _timeout=10.0)
+        return self._call_idempotent('queue_depth', model_name,
+                                     _timeout=10.0)
 
     def models(self):
-        return self._call('models', _timeout=10.0)
+        return self._call_idempotent('models', _timeout=10.0)
 
     def close(self, timeout=30.0):
         try:
             self._call('close', timeout=timeout,
                        _timeout=max(1.0, timeout) + 5.0)
-        except (ServerClosed, DeadlineExceeded):
+        except (ServerClosed, DeadlineExceeded, FaultInjected):
             pass  # already gone — close converges either way
         try:
             self.proc.wait(timeout=max(1.0, timeout))
@@ -369,6 +491,9 @@ class RemoteCell(object):
             self._sock.close()
         except OSError:
             pass
+        # the reader wakes within one idle window (sock.close makes
+        # its recv raise) — join so close() leaves zero stuck threads
+        self._reader.join(timeout=_idle_timeout() + 5.0)
 
     def kill(self):
         """Chaos hook: SIGKILL the whole cell process — the remote
@@ -377,14 +502,41 @@ class RemoteCell(object):
         self.proc.wait()
 
 
+def _reap(proc):
+    """Kill + wait: a ``kill()`` without the ``wait()`` leaves a
+    zombie the parent carries until exit."""
+    try:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10.0)
+    except (OSError, subprocess.TimeoutExpired):
+        pass  # already reaped elsewhere, or unkillable — give up
+
+
 def spawn_cell(name='remote-cell', devices=1, env=None,
-               startup_timeout=180.0, kind='serve'):
+               startup_timeout=180.0, kind='serve',
+               heartbeat_dir=None, host_id=None,
+               heartbeat_interval=None, idle_timeout=None):
     """Start a cell worker process and connect to it. The child forces
     the CPU backend with ``devices`` host devices (same recipe as the
     test workers); the parent blocks until the port file appears.
     ``kind='prefill'`` runs a prefill cell (prompt ingestion) instead
     of a ModelServer — the returned proxy carries ``role='prefill'``
-    so the Router pins prefill placements to it."""
+    so the Router pins prefill placements to it.
+
+    Elastic-fleet contracts (RESILIENCE.md "Cross-host elasticity"):
+    ``heartbeat_dir``/``host_id``/``heartbeat_interval`` export the
+    PTPU_HB_* env so the worker beats into the fleet heartbeat dir;
+    the parent's active AOT cache dir (env OR ``coldstart.cache_scope``
+    — the scope is a process-local override the child can't otherwise
+    see) is exported as ``PTPU_AOT_CACHE`` so the remote ``warmup()``
+    deserializes sealed executables instead of recompiling; the client
+    socket gets a bounded ``idle_timeout`` (default
+    PTPU_REMOTE_IDLE_TIMEOUT / 5s) so the reader can never block
+    forever on a partitioned peer. Every failed spawn reaps the child
+    (kill + wait) and journals a ``spawn_failed`` multihost event."""
+    maybe_fault(SITE_REMOTE_SPAWN)
+    t0 = time.monotonic()
     workdir = tempfile.mkdtemp(prefix='ptpu_cell_')
     port_file = os.path.join(workdir, 'port')
     child_env = dict(os.environ)
@@ -398,6 +550,14 @@ def spawn_cell(name='remote-cell', devices=1, env=None,
     if not journal_path and _obs.journal_active():
         journal_path = os.path.join(workdir, 'journal.jsonl')
         child_env[_obs.JOURNAL_ENV] = journal_path
+    if heartbeat_dir is not None:
+        child_env['PTPU_HB_DIR'] = str(heartbeat_dir)
+        child_env['PTPU_PROC_ID'] = str(int(host_id or 0))
+        if heartbeat_interval is not None:
+            child_env['PTPU_HB_INTERVAL'] = str(heartbeat_interval)
+    from ..fleet import coldstart as _coldstart  # lazy: fleet is heavy
+    aot_dir = _coldstart.cache_dir()
+    _coldstart.export_env(child_env)
     flags = child_env.get('XLA_FLAGS', '')
     if 'xla_force_host_platform_device_count' not in flags:
         child_env['XLA_FLAGS'] = (
@@ -413,25 +573,45 @@ def spawn_cell(name='remote-cell', devices=1, env=None,
         [sys.executable, '-m', 'paddle_tpu.multihost.remote',
          '--port-file', port_file, '--cell-kind', kind],
         env=child_env)
-    deadline = time.monotonic() + startup_timeout
-    while not os.path.exists(port_file):
-        if proc.poll() is not None:
-            raise ServerClosed(
-                'remote cell %r exited rc=%s before publishing its '
-                'port' % (name, proc.returncode))
-        if time.monotonic() > deadline:
-            proc.kill()
-            raise ServerClosed(
-                'remote cell %r did not come up within %.0fs'
-                % (name, startup_timeout))
-        time.sleep(0.05)
-    with open(port_file) as f:
-        port = int(f.read().strip())
-    sock = socket.create_connection(('127.0.0.1', port), timeout=30.0)
-    sock.settimeout(None)
+    try:
+        deadline = time.monotonic() + startup_timeout
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise ServerClosed(
+                    'remote cell %r exited rc=%s before publishing '
+                    'its port' % (name, proc.returncode))
+            if time.monotonic() > deadline:
+                raise ServerClosed(
+                    'remote cell %r did not come up within %.0fs'
+                    % (name, startup_timeout))
+            time.sleep(0.05)
+        with open(port_file) as f:
+            port = int(f.read().strip())
+        sock = socket.create_connection(('127.0.0.1', port),
+                                        timeout=30.0)
+    except BaseException as e:
+        # EVERY failed spawn reaps the child: the old code left a
+        # zombie on startup timeout and leaked the process entirely
+        # when create_connection failed after the port file appeared
+        _reap(proc)
+        mh_emit('spawn_failed', name=name, kind=kind, pid=proc.pid,
+                reason=repr(e),
+                dur_s=round(time.monotonic() - t0, 6))
+        raise
+    # bounded idle timeout: the reader wakes at least this often to
+    # verify the peer process is alive instead of blocking forever
+    sock.settimeout(_idle_timeout(idle_timeout))
     cell = RemoteCell(proc, sock, name=name)
     cell.role = kind
     cell.journal_path = journal_path
+    dur_s = time.monotonic() - t0
+    _obs.default_registry().histogram(
+        'remote_spawn_seconds',
+        'wall seconds from spawn_cell() to a connected remote cell'
+    ).observe(dur_s)
+    mh_emit('spawn', name=name, kind=kind, pid=proc.pid,
+            host_id=host_id, aot_warm=bool(aot_dir),
+            dur_s=round(dur_s, 6))
     return cell
 
 

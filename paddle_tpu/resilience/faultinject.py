@@ -42,6 +42,12 @@ SITE_TRAINER_STEP = 'trainer.step'        # top of each train-loop step
 SITE_SERVING_RUN = 'serving/run_batch'    # inside the per-attempt run
 SITE_SERVING_LOAD = 'serving/load_model'  # model load / hot swap
 SITE_SERVING_PAD = 'serving/pad'          # bucket padding stage
+# remote-cell RPC sites (RESILIENCE.md "Cross-host elasticity"):
+# delay= models a slow/partitioned link, error= a dropped frame or
+# reset, and an error at send never touches the wire (retryable)
+SITE_REMOTE_SEND = 'remote/send'          # client frame send
+SITE_REMOTE_RECV = 'remote/recv'          # client reader pull
+SITE_REMOTE_SPAWN = 'remote/spawn'        # spawn_cell provisioning
 
 
 class FaultInjected(IOError):

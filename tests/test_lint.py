@@ -164,6 +164,34 @@ def test_rule_http_outside_telemetry(tmp_path):
     assert not [x for x in v if x.rule == 'http-outside-telemetry']
 
 
+def test_rule_blocking_socket_recv(tmp_path):
+    """ISSUE 19 satellite: a timeout-less socket read outside
+    multihost/remote.py's guarded frame reader can hang a fleet thread
+    forever on a partitioned peer; settimeout(None) re-arms blocking
+    mode anywhere."""
+    src = 'chunk = sock.recv(4096)\n'
+    p = tmp_path / 'mod.py'
+    p.write_text(src)
+    for rel, expect in [
+            (os.path.join('paddle_tpu', 'serving', 'server.py'), 1),
+            ('tools/fleet_top.py', 1),
+            (os.path.join('paddle_tpu', 'multihost', 'remote.py'), 0)]:
+        v, _ = lint_repo.lint_file(str(p), rel)
+        hits = [x for x in v if x.rule == 'blocking-socket-recv']
+        assert len(hits) == expect, (rel, hits)
+    # settimeout(None) is flagged even inside the sanctioned reader;
+    # zero-arg .recv() (pipes/queues) is out of scope by construction
+    p.write_text('sock.settimeout(None)\nok = channel.recv()\n')
+    v, _ = lint_repo.lint_file(
+        str(p), os.path.join('paddle_tpu', 'multihost', 'remote.py'))
+    hits = [x for x in v if x.rule == 'blocking-socket-recv']
+    assert len(hits) == 1 and 'settimeout' in hits[0].detail
+    # a deadline-armed settimeout anywhere is fine
+    p.write_text('sock.settimeout(5.0)\n')
+    v, _ = lint_repo.lint_file(str(p), 'tools/x.py')
+    assert not [x for x in v if x.rule == 'blocking-socket-recv']
+
+
 def test_rule_kv_alloc_outside_pool(tmp_path):
     """ISSUE 17 satellite: raw numpy KV buffers in serving/ or fleet/
     dodge the PagePool's kv_bytes accounting; only the kvcache package

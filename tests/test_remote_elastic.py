@@ -1,0 +1,387 @@
+"""Cross-host elastic fleet: partition-tolerant RPC fault matrix,
+spawn-leak fixes and the heartbeat-driven remote prober (ISSUE 19,
+RESILIENCE.md "Cross-host elasticity").
+
+Acceptance pins:
+- every RPC fault mode — idle partition, torn length prefix, mid-frame
+  reset, injected drop/delay at ``remote/send|recv|spawn`` — resolves
+  to a TYPED error (ServerClosed family / DeadlineExceeded) with zero
+  stuck threads;
+- idempotent control ops retry injected send faults with bounded
+  backoff (``remote_rpc_retries_total``) without poisoning the
+  connection;
+- ``spawn_cell`` reaps its child on EVERY failed startup path (no
+  zombie on timeout, no leaked process on a failed connect);
+- a remote cell whose host stops beating is declared DEAD by the
+  prober — unroutable — while its socket is still open and before any
+  RPC against it fails, and the supervisor rebuilds it through the
+  SAME backend.
+"""
+import os
+import pickle
+import signal
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as _obs
+from paddle_tpu.fleet import (ACTIVE, DEAD, RemoteBackend,
+                              ReplicaBackend, Router)
+from paddle_tpu.fleet.autoscaler import Signals
+from paddle_tpu.multihost import remote
+from paddle_tpu.multihost.heartbeat import HostMonitor, heartbeat_path
+from paddle_tpu.multihost.remote import RemoteCell, spawn_cell
+from paddle_tpu.resilience import faultinject as fi
+from paddle_tpu.serving import ModelServer
+from paddle_tpu.serving.errors import DeadlineExceeded, ServerClosed
+
+pytestmark = pytest.mark.multihost
+
+IN_DIM, OUT_DIM = 6, 3
+
+
+class FakeProc(object):
+    """Stands in for the worker Popen on socketpair-backed cells."""
+
+    pid = 4242
+
+    def __init__(self):
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def wait(self, timeout=None):
+        return self.returncode if self.returncode is not None else 0
+
+    def kill(self):
+        self.returncode = -9
+
+
+def _pair(idle=0.2):
+    a, b = socket.socketpair()
+    a.settimeout(idle)
+    return a, b
+
+
+def _cell(idle=0.2):
+    a, b = _pair(idle=idle)
+    return RemoteCell(FakeProc(), a, name='fake'), b
+
+
+def _responder(peer, n=1):
+    """Answer ``n`` requests on the server end of a socketpair."""
+    lock = threading.Lock()
+
+    def run():
+        for _ in range(n):
+            try:
+                msg = remote._recv_msg(peer)
+            except (ConnectionError, OSError):
+                return
+            remote._send_msg(peer, {'id': msg['id'], 'ok': True,
+                                    'value': os.getpid()}, lock)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _assert_reader_dead(cell, timeout=5.0):
+    cell._reader.join(timeout)
+    assert not cell._reader.is_alive(), 'reader thread stuck'
+
+
+# ---- RPC fault matrix (socketpair, no processes) -------------------------
+class TestRpcFaultMatrix:
+    def test_idle_timeout_is_not_fatal_for_a_living_peer(self):
+        cell, peer = _cell(idle=0.05)
+        try:
+            time.sleep(0.3)     # several idle ticks elapse
+            assert cell._dead is None
+            _responder(peer)
+            assert cell.ping() == os.getpid()
+        finally:
+            peer.close()
+            _assert_reader_dead(cell)
+
+    def test_half_open_peer_detected_on_idle_tick(self):
+        # the partition case the old settimeout(None) reader could
+        # never see: the process dies but the socket stays open
+        cell, peer = _cell(idle=0.05)
+        req = cell._post('health', (), {})
+        cell.proc.returncode = -9       # process gone, socket open
+        with pytest.raises(ServerClosed) as ei:
+            req.result(timeout=5.0)
+        assert 'half-open' in str(ei.value)
+        _assert_reader_dead(cell)
+        peer.close()
+
+    def test_torn_length_prefix_is_typed(self):
+        cell, peer = _cell(idle=0.05)
+        req = cell._post('health', (), {})
+        peer.sendall(b'\x00\x00')       # 2 of 4 header bytes, stall
+        with pytest.raises(ServerClosed) as ei:
+            req.result(timeout=5.0)
+        assert 'torn frame' in str(ei.value)
+        _assert_reader_dead(cell)
+        peer.close()
+
+    def test_mid_frame_reset_is_typed(self):
+        cell, peer = _cell(idle=0.05)
+        req = cell._post('health', (), {})
+        remote._recv_msg(peer)          # drain the request so close()
+        # below is a clean EOF mid-reply, not an RST for unread data
+        blob = pickle.dumps({'id': 1, 'ok': True, 'value': 0},
+                            protocol=4)
+        peer.sendall(remote._LEN.pack(len(blob)) + blob[:5])
+        peer.close()                    # connection dies mid-frame
+        with pytest.raises(ServerClosed) as ei:
+            req.result(timeout=5.0)
+        assert 'torn frame' in str(ei.value)
+        _assert_reader_dead(cell)
+
+    def test_recv_fault_injection_drops_frame_typed(self):
+        cell, peer = _cell(idle=0.05)
+        req = cell._post('submit', ('m', {}), {})  # in flight first:
+        # the reader is parked in recv when the plan lands, and picks
+        # the fault up on its next idle tick
+        with fi.fault_plan() as plan:
+            plan.inject(fi.SITE_REMOTE_RECV,
+                        error=ConnectionResetError, times=1)
+            with pytest.raises(ServerClosed):
+                req.result(timeout=5.0)
+            assert plan.faults[fi.SITE_REMOTE_RECV] >= 1
+        _assert_reader_dead(cell)
+        peer.close()
+
+    def test_recv_delay_past_deadline_is_typed(self):
+        with fi.fault_plan() as plan:
+            plan.inject(fi.SITE_REMOTE_RECV, error=None, delay=0.5,
+                        every=1)
+            cell, peer = _cell(idle=0.05)
+            _responder(peer)
+            req = cell._post('health', (), {})
+            with pytest.raises(DeadlineExceeded):
+                req.result(timeout=0.1)
+        peer.close()
+        cell._sock.close()
+        _assert_reader_dead(cell)
+
+    def test_send_fault_retried_on_idempotent_op(self):
+        reg = _obs.default_registry()
+        before = reg.counter('remote_rpc_retries_total').value
+        with fi.fault_plan() as plan:
+            plan.inject(fi.SITE_REMOTE_SEND, times=1)
+            cell, peer = _cell(idle=0.05)
+            _responder(peer)
+            assert cell.ping() == os.getpid()   # retried through
+            assert plan.faults[fi.SITE_REMOTE_SEND] == 1
+        after = reg.counter('remote_rpc_retries_total').value
+        assert after >= before + 1
+        assert cell._dead is None   # the connection was never poisoned
+        peer.close()
+        cell._sock.close()
+        _assert_reader_dead(cell)
+
+    def test_send_fault_exhaustion_is_typed_and_survivable(self):
+        with fi.fault_plan() as plan:
+            plan.inject(fi.SITE_REMOTE_SEND, times=10)
+            cell, peer = _cell(idle=0.05)
+            with pytest.raises(ServerClosed) as ei:
+                cell.ping()
+            assert 'kept faulting' in str(ei.value)
+            assert cell._dead is None
+        # plan gone: the same cell serves the next op — exhaustion
+        # typed the CALL, it never killed the connection
+        _responder(peer)
+        assert cell.ping() == os.getpid()
+        assert not cell._pending    # no orphaned slots from the faults
+        peer.close()
+        cell._sock.close()
+        _assert_reader_dead(cell)
+
+    def test_mutating_op_does_not_retry_send_faults(self):
+        with fi.fault_plan() as plan:
+            plan.inject(fi.SITE_REMOTE_SEND, times=1)
+            cell, peer = _cell(idle=0.05)
+            with pytest.raises(fi.FaultInjected):
+                cell.submit('m', {})
+            assert plan.faults[fi.SITE_REMOTE_SEND] == 1
+        peer.close()
+        cell._sock.close()
+        _assert_reader_dead(cell)
+
+
+# ---- spawn_cell leak fixes + spawn faults --------------------------------
+class TestSpawnLifecycle:
+    def test_spawn_fault_injection_is_typed(self):
+        with fi.fault_plan() as plan:
+            plan.inject(fi.SITE_REMOTE_SPAWN, times=1)
+            with pytest.raises(fi.FaultInjected):
+                spawn_cell(name='faulted')
+            assert plan.faults[fi.SITE_REMOTE_SPAWN] == 1
+
+    def test_startup_timeout_reaps_child(self, monkeypatch):
+        procs = []
+        real_popen = remote.subprocess.Popen
+
+        def fake_popen(cmd, **kw):
+            # a child that never publishes its port
+            p = real_popen([sys.executable, '-c',
+                            'import time; time.sleep(60)'])
+            procs.append(p)
+            return p
+
+        monkeypatch.setattr(remote.subprocess, 'Popen', fake_popen)
+        with pytest.raises(ServerClosed):
+            spawn_cell(name='stuck', startup_timeout=0.3)
+        assert len(procs) == 1
+        # the fix: kill AND wait — returncode set means reaped, the
+        # old code left a zombie here
+        assert procs[0].returncode is not None
+
+    def test_failed_connect_reaps_child(self, monkeypatch):
+        procs = []
+        real_popen = remote.subprocess.Popen
+
+        def fake_popen(cmd, **kw):
+            # a child that publishes an unconnectable port, then hangs:
+            # the old code leaked it alive forever
+            port_file = cmd[cmd.index('--port-file') + 1]
+            code = ("import os,sys\n"
+                    "pf = %r\n"
+                    "open(pf + '.tmp', 'w').write('1\\n')\n"
+                    "os.rename(pf + '.tmp', pf)\n"
+                    "import time; time.sleep(60)\n" % port_file)
+            p = real_popen([sys.executable, '-c', code])
+            procs.append(p)
+            return p
+
+        monkeypatch.setattr(remote.subprocess, 'Popen', fake_popen)
+        with pytest.raises(OSError):
+            spawn_cell(name='unconnectable', startup_timeout=30.0)
+        assert len(procs) == 1
+        assert procs[0].returncode is not None
+
+
+# ---- policy unit ---------------------------------------------------------
+def test_replica_backend_policy():
+    pol = ReplicaBackend(local_max=2)
+    sig = Signals()
+    sig.replicas = 1
+    assert pol.choose(sig) is None
+    sig.replicas = 2
+    assert pol.choose(sig) == 'remote'
+    assert ReplicaBackend(local_max=None).choose(sig) is None
+
+
+# ---- real-process integration -------------------------------------------
+def _save_artifact(tmp_path, name='m0', seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[IN_DIM],
+                                  dtype='float32')
+            h = fluid.layers.fc(input=x, size=8, act='relu')
+            y = fluid.layers.fc(input=h, size=OUT_DIM, act=None)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / name)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ['x'], [y], exe,
+                                      main_program=main)
+    return d
+
+
+@pytest.mark.slow
+def test_remote_backend_elastic_lifecycle(tmp_path):
+    """One spawn-heavy end-to-end pass: remote scale-out with
+    heartbeats, SIGSTOP partition detected by the prober BEFORE any
+    RPC fails, supervisor rebuild through the backend, scale-in."""
+    artifact = _save_artifact(tmp_path)
+    hb_dir = str(tmp_path / 'hb')
+    backend = RemoteBackend(hb_dir, window=1.0, startup_grace=120.0)
+
+    def factory(rid):
+        srv = ModelServer(place=fluid.CPUPlace(), max_batch_size=4)
+        return srv
+
+    router = Router(factory, replicas=1, supervise=False,
+                    warmup_on_load=False, remote_backend=backend)
+    try:
+        router.load_model('m', artifact)
+        rid = router.add_replica(backend='remote')
+        rep = router._replicas[rid]
+        assert rep.backend == 'remote'
+        cell = rep.server
+        # heartbeat arrived and the prober counts the cell healthy
+        host = backend._hosts[rid]['host']
+        assert os.path.exists(heartbeat_path(hb_dir, host))
+        assert router.probe_liveness() == []
+        # the placement replay reached the remote cell
+        assert 'm' in cell.models()
+        x = np.random.RandomState(0).rand(2, IN_DIM).astype('float32')
+        out, = router.infer('m', {'x': x}, timeout=30.0)
+        out = np.asarray(out)
+        assert out.shape == (2, OUT_DIM)
+
+        # PARTITION, not crash: SIGSTOP stops the beats while the
+        # process and socket stay up — only the prober can see this
+        os.kill(cell.pid, signal.SIGSTOP)
+        try:
+            deadline = time.monotonic() + 10.0
+            lost = []
+            while not lost and time.monotonic() < deadline:
+                lost = router.probe_liveness()
+                time.sleep(0.05)
+            assert lost == [rid]
+            assert rep.state == DEAD      # unroutable: no RPC failed
+            assert rid not in backend._hosts
+            assert not os.path.exists(heartbeat_path(hb_dir, host))
+        finally:
+            os.kill(cell.pid, signal.SIGCONT)
+        cell.kill()
+
+        # supervisor repair path: rebuild through the SAME backend —
+        # a fresh process on a fresh host id
+        router.restart_replica(rid)
+        rep2 = router._replicas[rid]
+        assert rep2.state == ACTIVE
+        cell2 = rep2.server
+        assert cell2 is not cell and cell2.pid != cell.pid
+        assert backend._hosts[rid]['host'] != host
+        assert 'm' in cell2.models()
+        out2, = router.infer('m', {'x': x}, timeout=30.0)
+        np.testing.assert_array_equal(out, np.asarray(out2))
+
+        # scale-in releases the mapping + heartbeat file
+        host2 = backend._hosts[rid]['host']
+        router.retire_replica(rid)
+        assert rid not in backend._hosts
+        assert not os.path.exists(heartbeat_path(hb_dir, host2))
+    finally:
+        router.close()
+
+
+def test_monitor_gauge_and_window_math(tmp_path):
+    # pure-file check of the prober's staleness source: a beat file
+    # aged past the window classifies stale with age ~ detection bound
+    hb_dir = str(tmp_path / 'hb')
+    os.makedirs(hb_dir)
+    path = heartbeat_path(hb_dir, 0)
+    with open(path, 'w') as f:
+        f.write('beat\n')
+    past = time.time() - 3.0
+    os.utime(path, (past, past))
+    mon = HostMonitor(hb_dir, window=1.0)
+    scan = mon.scan()
+    assert scan['stale'] == [0]
+    assert scan['ages'][0] == pytest.approx(3.0, abs=1.0)

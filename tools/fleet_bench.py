@@ -56,6 +56,17 @@ Two phases, both seeded and deterministic in shape:
    burn rate and recover once drained (gated via ``obs_report
    --require telemetry`` and ``--require slo``).
 
+7. **Cross-host elastic fleet** (RESILIENCE.md "Cross-host
+   elasticity"): a traffic ramp makes the autoscaler grow the fleet
+   across the host boundary — a remote cell process spawned through
+   ``RemoteBackend``, AOT-warmed from the parent's sealed store and
+   heartbeating into the fleet dir; the remote "host" is SIGKILLed
+   mid-load and must be detected inside the heartbeat window by the
+   liveness probe (not an RPC deadline), every in-flight future typed
+   or requeued bit-identically, p99 held, the supervisor rebuilding
+   it through the same backend, and idle returning the fleet to the
+   local floor (gated via ``obs_report --require remote_elastic``).
+
 ``--smoke`` runs a short schedule of both phases, writes an
 observability journal and validates it via ``obs_report.py --require
 fleet`` AND ``--require tracing`` semantics — including that the
@@ -658,6 +669,350 @@ def run_coldstart_phase(min_speedup=1.5, seed=11):
     }
 
 
+def _read_coldstart(journal_path):
+    """(hits, saves, deserialize_wall_s) from a cell's own journal —
+    each spawned cell writes its OWN file (trace_report merges them),
+    so the parent checks the child's AOT behavior post-hoc here."""
+    hits = saves = 0
+    wall = 0.0
+    try:
+        with open(journal_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) or \
+                        rec.get('ev') != 'coldstart':
+                    continue
+                if rec.get('action') == 'hit':
+                    hits += 1
+                    wall += rec.get('dur_s', 0.0)
+                elif rec.get('action') == 'save':
+                    saves += 1
+    except OSError:
+        pass
+    return hits, saves, wall
+
+
+def run_remote_elastic_phase(clients=3, seed=13, slo_p99=10.0,
+                             hb_window=2.0, scale_window_s=120.0,
+                             detect_slack_s=3.0,
+                             recovery_window_s=150.0,
+                             idle_window_s=40.0):
+    """Cross-host elastic fleet phase (RESILIENCE.md "Cross-host
+    elasticity"): one local replica under supervisor + autoscaler with
+    a fill-local-then-go-remote :class:`ReplicaBackend`; a traffic
+    ramp forces the next replica across the host boundary — a cell
+    PROCESS provisioned through :class:`RemoteBackend`, heartbeating
+    into the fleet dir, its warmup replay AOT-warmed from the parent's
+    sealed store. Then the remote "host" is SIGKILLed mid-load.
+    Gates:
+
+    - the remote replica comes up ACTIVE inside ``scale_window_s``
+      and its warmup HIT the AOT store (child journal), with the
+      deserialize wall measurably under the parent's cold compile;
+    - the loss is detected inside the ``hb_window`` heartbeat window
+      (+``detect_slack_s`` for one beat + one supervisor poll) by the
+      liveness probe — the replica is unroutable without waiting on
+      an RPC deadline;
+    - every in-flight request resolves typed or transparently
+      requeued, every result bit-identical to the fault-free
+      reference, p99 inside ``slo_p99`` through spawn + kill +
+      rebuild;
+    - the supervisor rebuilds the replica through the same remote
+      backend (fresh pid, fresh host id, AOT-warm again) and it
+      serves bit-identical outputs;
+    - idle traffic returns the fleet to the 1-replica local floor
+      inside ``idle_window_s`` (the autoscaler retires the remote).
+
+    The journal side of the same story is gated by ``obs_report
+    --require remote_elastic`` (spawn_remote + in-window host_lost +
+    requeue + retire).
+    """
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fleet import (Autoscaler, RemoteBackend,
+                                  ReplicaBackend, Router, coldstart)
+    from paddle_tpu.serving import ModelServer, ServingError
+
+    problems = []
+    rng = np.random.RandomState(seed)
+    re_in, re_batch = 512, 128
+    pool = [rng.randn(re_batch, re_in).astype('float32')
+            for _ in range(24)]
+
+    with tempfile.TemporaryDirectory(prefix='fleet_rem_') as workdir:
+        artifact = _build_artifact(workdir, seed=seed, in_dim=re_in,
+                                   hidden=1024, out_dim=OUT_DIM,
+                                   depth=2)
+        reference = _reference_fn(artifact)
+        expected = [reference(x) for x in pool]
+        store_dir = os.path.join(workdir, 'aot')
+        hb_dir = os.path.join(workdir, 'hb')
+
+        def factory(rid):
+            return ModelServer(place=fluid.CPUPlace(),
+                               max_batch_size=re_batch,
+                               max_queue_depth=256,
+                               watchdog_poll=0.05)
+
+        with coldstart.cache_scope(store_dir):
+            # cold baseline: compile + seal the store in the parent —
+            # the remote spawn below must beat this wall by
+            # deserializing instead of recompiling
+            t0 = time.monotonic()
+            with ModelServer(place=fluid.CPUPlace(),
+                             max_batch_size=re_batch) as srv:
+                srv.load_model('m', artifact)
+                srv.warmup('m')
+            cold_wall = time.monotonic() - t0
+
+            backend = RemoteBackend(hb_dir, window=hb_window,
+                                    startup_grace=120.0,
+                                    spawn_timeout=150.0,
+                                    # the cell must accept the same
+                                    # request envelope as the local
+                                    # replicas it stands in for
+                                    env={'PTPU_CELL_MAX_BATCH':
+                                         str(re_batch),
+                                         'PTPU_CELL_MAX_QUEUE': '256'})
+            router = Router(factory, replicas=1, poll_interval=0.05,
+                            remote_backend=backend)
+            scaler = Autoscaler(
+                router, min_replicas=1, max_replicas=2,
+                high_queue=1.5, low_queue=0.25, sustain=2,
+                up_cooldown=0.5, down_cooldown=1.0, interval=0.05,
+                replica_backend=ReplicaBackend(local_max=1))
+
+            results = []
+            res_lock = threading.Lock()
+            stop_load = threading.Event()
+            t_start = time.monotonic()
+
+            with router:
+                router.load_model('m', artifact)
+                scaler.start()
+                try:
+                    def client(cid):
+                        pending = collections.deque()
+
+                        def reap(down_to):
+                            while len(pending) > down_to:
+                                i, req, t0 = pending.popleft()
+                                try:
+                                    out, = req.result(timeout=120.0)
+                                    rec = ('ok', i, np.asarray(out),
+                                           time.monotonic() - t0)
+                                except ServingError as e:
+                                    rec = ('typed_error', i, e,
+                                           time.monotonic() - t0)
+                                except Exception as e:  # noqa: BLE001
+                                    rec = ('untyped_error', i, e,
+                                           time.monotonic() - t0)
+                                with res_lock:
+                                    results.append(rec)
+
+                        k = cid
+                        while not stop_load.is_set():
+                            i = k % len(pool)
+                            k += clients
+                            try:
+                                req = router.submit('m',
+                                                    {'x': pool[i]})
+                            except ServingError:
+                                time.sleep(0.01)
+                                continue
+                            pending.append((i, req, time.monotonic()))
+                            reap(8)
+                        reap(0)
+
+                    threads = [threading.Thread(target=client,
+                                                args=(c,), daemon=True)
+                               for c in range(clients)]
+                    for t in threads:
+                        t.start()
+
+                    # gate 1: the ramp crosses the host boundary —
+                    # an ACTIVE replica with backend='remote' inside
+                    # the window
+                    def remote_rep():
+                        with router._lock:
+                            for rep in router._replicas.values():
+                                if rep.backend == 'remote':
+                                    return rep
+                        return None
+
+                    give_up = time.monotonic() + scale_window_s
+                    rep = None
+                    while time.monotonic() < give_up:
+                        rep = remote_rep()
+                        if rep is not None and rep.state == 'active':
+                            break
+                        time.sleep(0.05)
+                    scaled_up_s = time.monotonic() - t_start
+                    spawned = rep is not None and rep.state == 'active'
+                    detected_s = rebuilt_s = None
+                    victim_journal = rebuilt_journal = None
+                    if not spawned:
+                        problems.append(
+                            'the autoscaler never grew the fleet '
+                            'across the host boundary within %.0fs '
+                            'of sustained ramp' % scale_window_s)
+                    else:
+                        rid, victim = rep.id, rep.server
+                        victim_journal = victim.journal_path
+                        time.sleep(1.0)   # get load in flight on it
+
+                        # chaos: SIGKILL the remote "host" mid-load
+                        victim.kill()
+                        t_kill = time.monotonic()
+
+                        # gate 2: the liveness probe makes it
+                        # unroutable inside the heartbeat window
+                        give_up = t_kill + hb_window + detect_slack_s
+                        while time.monotonic() < give_up:
+                            with router._lock:
+                                r2 = router._replicas.get(rid)
+                                gone = (r2 is None
+                                        or r2.server is not victim
+                                        or r2.state != 'active')
+                            if gone:
+                                detected_s = \
+                                    time.monotonic() - t_kill
+                                break
+                            time.sleep(0.005)
+                        if detected_s is None:
+                            problems.append(
+                                'SIGKILLed remote host still routable '
+                                '%.1fs later — outside its %.1fs '
+                                'heartbeat window'
+                                % (hb_window + detect_slack_s,
+                                   hb_window))
+
+                        # gate 3: the supervisor rebuilds it through
+                        # the same backend (fresh pid, AOT-warm)
+                        give_up = t_kill + recovery_window_s
+                        while time.monotonic() < give_up:
+                            with router._lock:
+                                r2 = router._replicas.get(rid)
+                                back = (r2 is not None
+                                        and r2.server is not victim
+                                        and r2.state == 'active')
+                            if back:
+                                rebuilt_s = time.monotonic() - t_kill
+                                rebuilt_journal = \
+                                    r2.server.journal_path
+                                break
+                            time.sleep(0.05)
+                        if rebuilt_s is None:
+                            problems.append(
+                                'the supervisor never rebuilt the '
+                                'lost remote replica within %.0fs'
+                                % recovery_window_s)
+                        else:
+                            time.sleep(1.0)  # serve through the
+                            # rebuilt cell so bit-identity covers it
+
+                    stop_load.set()
+                    for t in threads:
+                        t.join(180.0)
+
+                    # gate 4: idle -> back to the local floor (the
+                    # autoscaler retires the remote replica)
+                    give_up = time.monotonic() + idle_window_s
+                    while time.monotonic() < give_up and \
+                            len(router.stats()['replicas']) > 1:
+                        time.sleep(0.1)
+                    final = router.stats()['replicas']
+                    if len(final) > 1:
+                        problems.append(
+                            'fleet never scaled back to the 1-replica '
+                            'local floor within %.0fs idle (still %d)'
+                            % (idle_window_s, len(final)))
+                    elif remote_rep() is not None:
+                        problems.append(
+                            'the scale-in retired the LOCAL replica '
+                            'and kept the remote one — the floor '
+                            'must be local')
+                finally:
+                    scaler.stop()
+
+        # ---- invariants --------------------------------------------------
+        ok = sum(1 for r in results if r[0] == 'ok')
+        typed = [repr(r[2]) for r in results if r[0] == 'typed_error']
+        untyped = [repr(r[2]) for r in results
+                   if r[0] == 'untyped_error']
+        stuck = sum(1 for t in threads if t.is_alive())
+        if not ok:
+            problems.append('no request ever completed')
+        if typed:
+            problems.append(
+                '%d request(s) failed typed despite requeue + '
+                'supervisor: %s' % (len(typed), typed[:3]))
+        if untyped:
+            problems.append('untyped client errors: %s' % untyped[:3])
+        if stuck:
+            problems.append('%d client thread(s) stuck past the '
+                            'join bound' % stuck)
+        mismatches = sum(
+            1 for r in results if r[0] == 'ok'
+            and not np.array_equal(r[2], expected[r[1]]))
+        if mismatches:
+            problems.append(
+                '%d result(s) differ from the fault-free reference '
+                'across remote scale-out + host kill + rebuild'
+                % mismatches)
+        lats = [r[3] for r in results]
+        p50, p99 = _percentile(lats, 0.50), _percentile(lats, 0.99)
+        if p99 > slo_p99:
+            problems.append('p99 latency %.3fs exceeds the %.2fs SLO '
+                            'through spawn + kill + rebuild'
+                            % (p99, slo_p99))
+
+        # ---- AOT-warm gates (each cell journals to its OWN file) ---------
+        aot = {'cold_compile_ms': round(cold_wall * 1e3, 1),
+               'hits': 0, 'saves': 0, 'warm_wall_ms': None}
+        if victim_journal:
+            hits, saves, warm_wall = _read_coldstart(victim_journal)
+            aot.update(hits=hits, saves=saves,
+                       warm_wall_ms=round(warm_wall * 1e3, 1))
+            if not hits:
+                problems.append(
+                    'the remote replica warmup never hit the sealed '
+                    'AOT store — the cross-host cold start '
+                    'recompiled from scratch')
+            elif warm_wall >= cold_wall:
+                problems.append(
+                    'AOT-warm remote startup deserialize %.0fms is '
+                    'not measurably faster than the %.0fms cold '
+                    'compile' % (warm_wall * 1e3, cold_wall * 1e3))
+            if rebuilt_journal:
+                rhits, _, _ = _read_coldstart(rebuilt_journal)
+                if not rhits:
+                    problems.append(
+                        'the REBUILT remote replica never hit the '
+                        'AOT store — the supervisor repair path '
+                        'lost the cache export')
+
+    return {
+        'config': {'clients': clients, 'seed': seed,
+                   'slo_p99': slo_p99, 'hb_window_s': hb_window},
+        'outcomes': {'ok': ok, 'typed_errors': len(typed),
+                     'untyped_errors': len(untyped), 'stuck': stuck,
+                     'scaled_up_after_s': round(scaled_up_s, 2),
+                     'detected_after_s':
+                         round(detected_s, 3)
+                         if detected_s is not None else None,
+                     'rebuilt_after_s':
+                         round(rebuilt_s, 2)
+                         if rebuilt_s is not None else None,
+                     'final_replicas': len(final)},
+        'aot': aot,
+        'latency': {'p50_s': round(p50, 4), 'p99_s': round(p99, 4)},
+        'problems': problems,
+    }
+
+
 def run_kvcache_phase(seed=3, n_sequences=96, n_prompts=12,
                       min_speedup=1.0, min_resident_ratio=2.9,
                       slo_p99=30.0):
@@ -1255,6 +1610,9 @@ def main(argv=None):
     ap.add_argument('--no-coldstart-phase', action='store_true')
     ap.add_argument('--no-kvcache-phase', action='store_true')
     ap.add_argument('--no-telemetry-phase', action='store_true')
+    ap.add_argument('--no-remote-phase', action='store_true',
+                    help='skip the cross-host elastic phase (spawns '
+                         'real cell processes)')
     ap.add_argument('--smoke', action='store_true',
                     help='short seeded schedule; exit nonzero if any '
                          'fleet or decode invariant breaks')
@@ -1315,6 +1673,9 @@ def main(argv=None):
                 run_telemetry_phase(replicas=2, n_requests=64,
                                     clients=3,
                                     max_batch=args.max_batch)
+            remote = None if args.no_remote_phase else \
+                run_remote_elastic_phase(clients=args.clients,
+                                         seed=args.seed)
         else:
             fleet = run_fleet_chaos(
                 replicas=args.replicas, n_requests=args.requests,
@@ -1338,6 +1699,10 @@ def main(argv=None):
                                     n_requests=args.requests,
                                     clients=args.clients,
                                     max_batch=args.max_batch)
+            remote = None if args.no_remote_phase else \
+                run_remote_elastic_phase(clients=args.clients,
+                                         seed=args.seed,
+                                         slo_p99=max(10.0, args.slo))
     finally:
         if jctx is not None:
             observability.perf.enable_capture(_perf_prev)
@@ -1354,6 +1719,8 @@ def main(argv=None):
         problems += kvcache['problems']
     if telemetry is not None:
         problems += telemetry['problems']
+    if remote is not None:
+        problems += remote['problems']
     if journal_path:
         print('journal written to %s' % journal_path)
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1383,13 +1750,19 @@ def main(argv=None):
             problems += check_journal(journal_path,
                                       require='telemetry')
             problems += check_journal(journal_path, require='slo')
+        if remote is not None:
+            # the whole cross-host lifecycle must have journalled:
+            # spawn_remote, an in-window host_lost, a requeue and the
+            # scale-in retire
+            problems += check_journal(journal_path,
+                                      require='remote_elastic')
         if args.smoke and not args.no_kill:
             problems += check_requeue_trace(journal_path)
 
     results = {'fleet': fleet, 'decode': decode,
                'autoscale': autoscale, 'coldstart': cold,
                'kvcache': kvcache, 'telemetry': telemetry,
-               'problems': problems}
+               'remote': remote, 'problems': problems}
     if args.json:
         with open(args.json, 'w') as f:
             json.dump(results, f, indent=2, sort_keys=True,
@@ -1445,6 +1818,17 @@ def main(argv=None):
                  'rendered' if telemetry['bundle'] else 'MISSING',
                  telemetry['retired_series'], ts['peak_burn'],
                  ts['recovered_after_s']))
+    if remote is not None:
+        ro, ra = remote['outcomes'], remote['aot']
+        print('remote: %d ok through spawn+kill+rebuild | scaled out '
+              'in %.1fs, loss detected in %ss, rebuilt in %ss, final '
+              'fleet %d | AOT warm %s hits (deser %sms vs cold '
+              '%.0fms) | p99 %.0fms'
+              % (ro['ok'], ro['scaled_up_after_s'],
+                 ro['detected_after_s'], ro['rebuilt_after_s'],
+                 ro['final_replicas'], ra['hits'],
+                 ra['warm_wall_ms'], ra['cold_compile_ms'],
+                 remote['latency']['p99_s'] * 1e3))
     if problems:
         print('FLEET INVARIANTS BROKEN:', file=sys.stderr)
         for p in problems:

@@ -53,6 +53,15 @@ Rules (each encodes a convention the codebase actually relies on):
   plane"), so exposition format, handler timeouts and port-file
   publication cannot fork; the multihost remote protocol is a raw
   loopback socket on purpose and stays out of this rule's scope.
+- ``blocking-socket-recv``: a ``.settimeout(None)`` call (re-arming a
+  socket into blocking mode), or a ``sock.recv(n)``-style read outside
+  ``paddle_tpu/multihost/remote.py``'s guarded frame reader — the
+  remote RPC plane is partition-tolerant only because every socket
+  read sits under a deadline with torn-frame detection
+  (RESILIENCE.md "Cross-host elasticity"); a timeout-less recv loop
+  anywhere else can hang a fleet thread forever on a silent peer.
+  Zero-argument ``.recv()`` (pipes/queues) is out of scope by
+  construction.
 - ``kv-alloc-outside-pool``: a raw numpy buffer allocation
   (``np.zeros``/``empty``/``full``/``ones``) bound to a KV-named
   target in ``paddle_tpu/serving/`` or ``paddle_tpu/fleet/`` — KV
@@ -97,6 +106,11 @@ KV_ALLOC_FNS = ('zeros', 'empty', 'full', 'ones', 'zeros_like',
 # this rule to http.server keeps it out of scope by construction.)
 TELEMETRY_SANCTIONED = os.path.join('paddle_tpu', 'observability',
                                     'telemetry.py')
+# the one sanctioned byte-level socket reader: remote.py's _recv_exact
+# runs every recv under the connection deadline with torn-frame
+# accounting — a raw sized recv anywhere else is a thread that can
+# block forever on a partitioned peer
+RECV_SANCTIONED = os.path.join('paddle_tpu', 'multihost', 'remote.py')
 HTTP_SERVER_CLASSES = ('HTTPServer', 'ThreadingHTTPServer',
                        'BaseHTTPRequestHandler')
 
@@ -301,6 +315,24 @@ def lint_file(path, relpath):
                     'unguarded-emit', relpath, node.lineno,
                     '%s.emit() with no journal_active()/None guard '
                     '(use observability.emit)' % recv))
+            if node.func.attr == 'settimeout' and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value is None:
+                out.append(Violation(
+                    'blocking-socket-recv', relpath, node.lineno,
+                    '%s.settimeout(None) re-arms a blocking socket: '
+                    'every fleet socket read keeps a deadline so a '
+                    'partitioned peer times out typed instead of '
+                    'hanging the thread' % recv))
+            if node.func.attr == 'recv' and node.args \
+                    and relpath != RECV_SANCTIONED:
+                out.append(Violation(
+                    'blocking-socket-recv', relpath, node.lineno,
+                    '%s.recv(...) outside multihost/remote.py\'s '
+                    'guarded reader: sized socket reads go through '
+                    'the deadline-bounded RPC frame reader '
+                    '(_recv_exact) or they can block forever on a '
+                    'silent peer' % recv))
             if node.func.attr == 'cost_analysis' \
                     and relpath != os.path.join('paddle_tpu',
                                                 'observability',
@@ -421,7 +453,7 @@ def main(argv=None):
         print('rules: bare-except, lock-outside-with, unguarded-emit, '
               'span-not-ended, direct-cost-analysis, '
               'jit-on-warmup-path, kv-alloc-outside-pool, '
-              'http-outside-telemetry, '
+              'http-outside-telemetry, blocking-socket-recv, '
               'dup-metric-name (across %s)'
               % '/'.join(METRIC_PACKAGES))
         return 0

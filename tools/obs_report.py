@@ -43,8 +43,12 @@ run under declared service-level objectives — ``slo`` records must
 show a burn-rate breach AND a recovery (OBSERVABILITY.md "SLO burn
 rates"); ``--require telemetry`` for a run scraped through the live
 telemetry plane — ``telemetry`` records must show an aggregator
-scrape (OBSERVABILITY.md "Telemetry plane"); ``--require any`` for
-presence only). Run ``--list-requires`` for the full machine-derived
+scrape (OBSERVABILITY.md "Telemetry plane"); ``--require
+remote_elastic`` for a cross-host elastic run — ``fleet`` records
+must cover the whole remote replica lifecycle: a ``spawn_remote``,
+a ``host_lost`` detected inside its heartbeat window, an in-flight
+``requeue`` and a scale-in ``retire`` (RESILIENCE.md "Cross-host
+elasticity"); ``--require any`` for presence only). Run ``--list-requires`` for the full machine-derived
 catalog — the argparse choices come straight from ``REQUIRED_EV``, so
 the list above can lag but the tool cannot.
 ``tools/serve_bench.py --smoke`` runs this gate over the journal its
@@ -109,6 +113,12 @@ REQUIRED_EV = {'step': 'step_end', 'serving': 'serving_batch',
                # lifecycle + at least one aggregator scrape that saw a
                # live endpoint
                'telemetry': 'telemetry',
+               # a cross-host elastic run must show the full remote
+               # replica lifecycle (RESILIENCE.md "Cross-host
+               # elasticity"): a remote spawn, a heartbeat-detected
+               # host loss inside its window, the in-flight requeue,
+               # and the scale-in retire back to the floor
+               'remote_elastic': 'fleet',
                'any': None}
 
 # one-line purpose per family, keyed like REQUIRED_EV — rendered by
@@ -131,6 +141,8 @@ REQUIRE_DOC = {
     'kvcache': 'kvcache records incl. page allocs and a prefill',
     'slo': 'slo records incl. a burn-rate breach and a recovery',
     'telemetry': 'telemetry records incl. an aggregator scrape',
+    'remote_elastic': 'fleet spawn_remote + in-window host_lost + '
+                      'requeue + retire',
     'any': 'presence only (any well-formed journal passes)',
 }
 
@@ -871,6 +883,37 @@ def check_journal(path, require='step'):
             problems.append(
                 'telemetry journal shows no aggregator scrape — '
                 'endpoints may have served but nothing merged them')
+    if require == 'remote_elastic':
+        actions = {r.get('action') for r in records
+                   if r['ev'] == 'fleet'}
+        for action, why in (
+                ('spawn_remote', 'no remote replica was ever '
+                                 'provisioned'),
+                ('host_lost', 'no heartbeat-detected host loss — the '
+                              'chaos kill never registered'),
+                ('requeue', 'no in-flight request was requeued off '
+                            'the lost host'),
+                ('retire', 'the fleet never scaled back in')):
+            if action not in actions:
+                problems.append(
+                    'remote_elastic journal shows no fleet %s '
+                    'record — %s' % (action, why))
+        # detection must come from the heartbeat monitor, not from an
+        # eventual RPC failure: the journalled detect_s is the file
+        # age at detection, which lags a silent death by at most one
+        # beat interval + one supervisor poll — 2x window + 1s is the
+        # generous ceiling that still catches RPC-deadline detection
+        for r in records:
+            if (r['ev'] == 'fleet' and r.get('action') == 'host_lost'
+                    and 'detect_s' in r and 'window_s' in r
+                    and float(r['detect_s'])
+                    > 2.0 * float(r['window_s']) + 1.0):
+                problems.append(
+                    'remote host %s loss detected after %.2fs — '
+                    'outside its %.2fs heartbeat window (+slack); '
+                    'detection leaned on an RPC failure, not the '
+                    'monitor' % (r.get('host'), float(r['detect_s']),
+                                 float(r['window_s'])))
     if require == 'multihost':
         # a host loss the monitor only noticed after its own heartbeat
         # window means detection is broken even if recovery worked
