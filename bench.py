@@ -185,6 +185,74 @@ def bench_se_resnext(on_tpu):
     return res
 
 
+def bench_conv_fuse(on_tpu):
+    """ISSUE 20: fused-vs-unfused conv-stack legs. The fused leg runs
+    the default pipeline (conv_epilogue_fuse on); the unfused leg pins
+    the tuned-schedule ``conv_epilogue='off'`` knob — the override
+    every other engagement hook yields to — so the identical program
+    compiles with every fused_conv replaying its unfused sub-ops. On
+    TPU both legs are ledgered and the bandwidth gate insists the
+    fused step reads/writes STRICTLY fewer HBM bytes: that byte cut is
+    the whole point of the epilogue fusion (PERF.md "Conv bandwidth").
+    On CPU the fused op replays exactly (same XLA graph both legs), so
+    only the plumbing is exercised and no gate applies."""
+    from paddle_tpu.compiler import tuning as _ctuning
+    from paddle_tpu import observability as _obs
+    out = {}
+    fb_counter = _obs.default_registry().counter(
+        'conv_fuse_fallbacks_total',
+        'fused conv ops replayed unfused (unsupported shape/dtype)')
+    for name, batch in (('resnet', 128 if on_tpu else 4),
+                        ('se_resnext', 128 if on_tpu else 2)):
+        warmup, steps = (3, 15) if on_tpu else (1, 2)
+        row = {'batch_size': batch}
+        fb0 = fb_counter.value
+        fused_ips, _ = _bench_image_model(name, batch, warmup, steps,
+                                          on_tpu)
+        row['fallbacks'] = int(fb_counter.value - fb0)
+        with _ctuning.apply_entry({'conv_epilogue': 'off'}):
+            unfused_ips, _ = _bench_image_model(name, batch, warmup,
+                                                steps, on_tpu)
+        row['fused_images_per_sec'] = round(fused_ips, 2)
+        row['unfused_images_per_sec'] = round(unfused_ips, 2)
+        row['conv_fuse_speedup'] = round(fused_ips / unfused_ips, 3)
+        log('%s conv fuse: %.1f fused vs %.1f unfused img/s '
+            '(speedup %.3fx, %d fallback(s))'
+            % (name, fused_ips, unfused_ips, row['conv_fuse_speedup'],
+               row['fallbacks']))
+        if on_tpu:
+            fused_led = _image_model_ledger(name, batch, fused_ips)
+            with _ctuning.apply_entry({'conv_epilogue': 'off'}):
+                unfused_led = _image_model_ledger(name, batch,
+                                                  unfused_ips)
+            row['fused_bytes_accessed'] = fused_led['bytes_accessed']
+            row['unfused_bytes_accessed'] = \
+                unfused_led['bytes_accessed']
+            row['bytes_saved'] = (unfused_led['bytes_accessed']
+                                  - fused_led['bytes_accessed'])
+            row['fused_bandwidth_bound_ms'] = \
+                fused_led['bandwidth_bound_ms']
+            row['unfused_bandwidth_bound_ms'] = \
+                unfused_led['bandwidth_bound_ms']
+            log('%s conv fuse ledger: %.2f -> %.2f GB accessed '
+                '(bandwidth bound %.1f -> %.1f ms)'
+                % (name, unfused_led['bytes_accessed'] / 1e9,
+                   fused_led['bytes_accessed'] / 1e9,
+                   unfused_led['bandwidth_bound_ms'],
+                   fused_led['bandwidth_bound_ms']))
+            # the gate: fusing must strictly cut HBM traffic, or the
+            # epilogue path is decorative (a fallback storm shows up
+            # here as equal byte counts plus a nonzero fallback row)
+            assert (fused_led['bytes_accessed']
+                    < unfused_led['bytes_accessed']), (
+                '%s fused leg accessed %d bytes >= unfused %d — the '
+                'conv epilogue fusion saved no bandwidth'
+                % (name, fused_led['bytes_accessed'],
+                   unfused_led['bytes_accessed']))
+        out[name] = row
+    return out
+
+
 def bench_machine_translation(on_tpu):
     """Attention seq2seq (BASELINE transpiler-DP config) words/sec
     through the fluid path (target words, reference convention)."""
@@ -1758,6 +1826,7 @@ def main():
         log('transformer bench failed: %s' % record['transformer_error'])
 
     for key, fn in (('se_resnext', bench_se_resnext),
+                    ('conv_fuse', bench_conv_fuse),
                     ('machine_translation', bench_machine_translation),
                     ('flash_attention', bench_flash_attention),
                     ('sparse_embedding', bench_sparse_embedding),
@@ -1852,6 +1921,12 @@ def _headline(record):
                                           'images_per_sec'),
         'machine_translation_words_per_sec': _dig(
             record, 'machine_translation', 'words_per_sec'),
+        'conv_fuse_speedup': _dig(record, 'conv_fuse', 'resnet',
+                                  'conv_fuse_speedup'),
+        'conv_fuse_bytes_saved': _dig(record, 'conv_fuse', 'resnet',
+                                      'bytes_saved'),
+        'se_resnext_conv_fuse_speedup': _dig(
+            record, 'conv_fuse', 'se_resnext', 'conv_fuse_speedup'),
         'flash_best_speedup': max(
             (row['speedup'] for row in record.get(
                 'flash_attention', {}).values()

@@ -600,7 +600,7 @@ def _filled_like(op, env, emit):
                          op.attrs.get('dtype') or 'float32')}
 
 
-@register_shape('fused_elementwise')
+@register_shape('fused_elementwise', 'fused_conv')
 def _fused(op, env, emit):
     """Replay the captured sub-ops through their own rules so the fused
     kernel stays as transparent to inference as to execution."""

@@ -1,4 +1,5 @@
-"""Pallas TPU kernels for the hot paths: flash attention + fused LSTM cell.
+"""Pallas TPU kernels for the hot paths: flash attention, fused LSTM
+cell, and fused conv epilogues.
 
 Parity intent: the reference accelerates attention/LSTM with cuDNN and
 hand-written CUDA (paddle/fluid/operators/{lstm_op,math/lstm_compute}.*,
@@ -15,6 +16,7 @@ equivalents are written in Pallas:
 Both carry a pure-jnp fallback (identical math) used off-TPU and for
 odd shapes; tests run the Pallas path with ``interpret=True`` on CPU.
 """
+import contextlib
 import functools
 import math
 
@@ -684,6 +686,381 @@ def _lstm_cell_bwd(interpret, res, g):
 
 
 _lstm_cell.defvjp(_lstm_cell_fwd, _lstm_cell_bwd)
+
+
+# ---- fused conv + epilogue ------------------------------------------------------
+#
+# One kernel for conv (or depthwise conv) plus its trailing elementwise
+# epilogue — folded-BN affine, activation, residual add, SE channel
+# scale — applied in-register on the conv output tile before the single
+# HBM store. The unfused lowering writes the conv output, re-reads it
+# for BN, re-reads again for the activation/residual: on a
+# bandwidth-bound program (resnet50's ledger: 54.8 ms bandwidth-bound
+# vs 14.6 ms compute-bound) those extra round trips are the bill.
+#
+# Layout: NHWC internally (channels on the TPU lanes); the fused_conv
+# op kernel (compiler/passes.py) transposes at the boundary. Block/tile
+# sizes resolve through compiler/tuning.py::conv_schedule() — never
+# hardcoded here (tools/lint_repo.py ``hardcoded-schedule``).
+#
+# Grid: (N, H-blocks, outchannel-blocks). 1x1 convs tile H cleanly
+# (input rows partition as [bh*stride] blocks); KxK convs take the
+# whole padded image per step — overlapping input windows cannot be
+# expressed by a BlockSpec partition — with a static python loop over
+# the (kh, kw) taps. Strided taps use a reshape-and-take trick instead
+# of strided slicing (Mosaic-safe); the input is padded with slack rows
+# so every tap's reshape fits.
+
+# Epilogue stage vocabulary. Math mirrors ops/math_ops.py kernels
+# one-for-one (the replay fallback runs those exact kernels; the fused
+# path must agree within the 1e-5 policy).
+_EPI_ACTS = {
+    'sigmoid': jax.nn.sigmoid,
+    'logsigmoid': jax.nn.log_sigmoid,
+    'exp': jnp.exp,
+    'relu': jax.nn.relu,
+    'tanh': jnp.tanh,
+    'tanh_shrink': lambda x: x - jnp.tanh(x),
+    'sqrt': jnp.sqrt,
+    'abs': jnp.abs,
+    'square': jnp.square,
+    'ceil': jnp.ceil,
+    'floor': jnp.floor,
+    'round': jnp.round,
+    'reciprocal': lambda x: 1.0 / x,
+    'log': jnp.log,
+    'softplus': jax.nn.softplus,
+    'softsign': jax.nn.soft_sign,
+}
+
+_EPI_ACTS_P = {
+    'brelu': lambda x, t_min, t_max: jnp.clip(x, t_min, t_max),
+    'leaky_relu': lambda x, alpha: jax.nn.leaky_relu(x, alpha),
+    'elu': lambda x, alpha: jax.nn.elu(x, alpha),
+    'relu6': lambda x, t: jnp.clip(x, 0, t),
+    'soft_relu': lambda x, t: jnp.log1p(jnp.exp(jnp.clip(x, -t, t))),
+    'hard_shrink': lambda x, t: jnp.where(jnp.abs(x) > t, x, 0.0),
+    'softshrink': lambda x, lam: jnp.where(
+        x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0)),
+    'pow': lambda x, f: jnp.power(x, f),
+    'stanh': lambda x, a, b: b * jnp.tanh(a * x),
+    'thresholded_relu': lambda x, t: jnp.where(x > t, x, 0.0),
+    'hard_sigmoid': lambda x, s, o: jnp.clip(s * x + o, 0.0, 1.0),
+    'swish': lambda x, beta: x * jax.nn.sigmoid(beta * x),
+    'clip': lambda x, lo, hi: jnp.clip(x, lo, hi),
+}
+
+_EPI_BIN = {
+    'elementwise_add': jnp.add,
+    'elementwise_sub': jnp.subtract,
+    'elementwise_mul': jnp.multiply,
+    'elementwise_div': jnp.divide,
+    'elementwise_max': jnp.maximum,
+    'elementwise_min': jnp.minimum,
+    'elementwise_pow': jnp.power,
+}
+
+
+def _apply_stage(y, st, fetch_aux):
+    """One epilogue stage on a f32 value. ``fetch_aux(idx)`` returns the
+    idx-th aux operand broadcast-shaped for ``y`` — the ONE copy of the
+    stage math shared by the Pallas kernel (3D tiles) and the jnp
+    reference (4D arrays), so they cannot diverge."""
+    kind = st[0]
+    if kind == 'affine':
+        return y * fetch_aux(st[1]) + fetch_aux(st[2])
+    if kind == 'act':
+        return _EPI_ACTS[st[1]](y)
+    if kind == 'act_p':
+        return _EPI_ACTS_P[st[1]](y, *st[2])
+    if kind == 'scale':
+        s0, b0, after = st[1], st[2], st[3]
+        return y * s0 + b0 if after else (y + b0) * s0
+    if kind == 'postmul':     # elementwise kernels' trailing scale attr
+        return y * st[1]
+    if kind == 'bin':
+        opname, idx, swap = st[1], st[2], st[3]
+        b = fetch_aux(idx)
+        fn = _EPI_BIN[opname]
+        return fn(b, y) if swap else fn(y, b)
+    raise ValueError('unknown epilogue stage %r' % (st,))
+
+
+def _fconv_kernel(*refs, kh, kw, sh, sw, bh, wo, depthwise, stages,
+                  aux_kinds, emit_stats):
+    """One (n, h-block, outchannel-block) grid step: conv taps
+    accumulate f32, stats partials (train BN) and epilogue stages apply
+    in-register, one store."""
+    n_aux = len(aux_kinds)
+    x_ref, w_ref = refs[0], refs[1]
+    aux_refs = refs[2:2 + n_aux]
+    out_ref = refs[2 + n_aux]
+    xb = x_ref[0]                      # [row_span, Wtot, C]
+    acc = None
+    for i in range(kh):
+        for j in range(kw):
+            t = xb[i:i + bh * sh, j:j + wo * sw, :]
+            if sh > 1:   # reshape-and-take: rows i, i+sh, ... (no
+                t = t.reshape(bh, sh, t.shape[1], t.shape[2])[:, 0]
+            if sw > 1:   # strided slices — Mosaic-safe)
+                t = t.reshape(t.shape[0], wo, sw, t.shape[-1])[:, :, 0]
+            if depthwise:
+                tap = t.astype(jnp.float32) * \
+                    w_ref[i, j].astype(jnp.float32)[None, None, :]
+            else:
+                # dot at INPUT precision (bf16 -> full-rate MXU), f32
+                # accumulation — same contract as the flash kernels
+                tap = jax.lax.dot_general(
+                    t.reshape(bh * wo, t.shape[-1]), w_ref[i, j],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            acc = tap if acc is None else acc + tap
+    y = acc if depthwise else acc.reshape(bh, wo, -1)   # [bh, wo, bc]
+    if emit_stats:
+        # per-(n, h-block, c-block) first/second-moment partials of the
+        # CONV output (train-mode BN statistics), each grid step owning
+        # its slab slot exclusively (no output revisiting)
+        psum_ref = refs[2 + n_aux + 1]
+        psumsq_ref = refs[2 + n_aux + 2]
+        psum_ref[0, 0] = jnp.sum(y, axis=(0, 1))
+        psumsq_ref[0, 0] = jnp.sum(y * y, axis=(0, 1))
+
+    def fetch_aux(idx):
+        kind2 = aux_kinds[idx]
+        o = aux_refs[idx]
+        if kind2 == 't':
+            return o[0].astype(jnp.float32)          # [bh, wo, bc]
+        if kind2 == 's':
+            return o[0, 0].astype(jnp.float32)       # scalar
+        return o[0].astype(jnp.float32)[None, None, :]   # 'c' / 'nc'
+
+    for st in stages:
+        y = _apply_stage(y, st, fetch_aux)
+    out_ref[0] = y.astype(out_ref.dtype)
+
+
+def _fconv_pallas(x, w, aux, meta):
+    """Raw fused-conv pallas_call on padded NHWC operands."""
+    (kh, kw, sh, sw, bh, nh, wo, bc, noc, depthwise, stages, aux_kinds,
+     emit_stats, interpret, out_dtype) = meta
+    N = x.shape[0]
+    ho = nh * bh
+    cout = noc * bc
+    row_span = bh * sh if kh == 1 else x.shape[1]
+    wtot = x.shape[2]
+    if depthwise:
+        in_specs = [
+            pl.BlockSpec((1, row_span, wtot, bc),
+                         lambda n, h, oc: (n, h, 0, oc)),
+            pl.BlockSpec((kh, kw, bc), lambda n, h, oc: (0, 0, oc)),
+        ]
+    else:
+        cin = x.shape[3]
+        in_specs = [
+            pl.BlockSpec((1, row_span, wtot, cin),
+                         lambda n, h, oc: (n, h, 0, 0)),
+            pl.BlockSpec((kh, kw, cin, bc),
+                         lambda n, h, oc: (0, 0, 0, oc)),
+        ]
+    for kind in aux_kinds:
+        if kind == 't':
+            in_specs.append(pl.BlockSpec(
+                (1, bh, wo, bc), lambda n, h, oc: (n, h, 0, oc)))
+        elif kind == 'nc':
+            in_specs.append(pl.BlockSpec(
+                (1, bc), lambda n, h, oc: (n, oc)))
+        elif kind == 's':
+            in_specs.append(pl.BlockSpec(
+                (1, 1), lambda n, h, oc: (0, 0)))
+        else:   # 'c'
+            in_specs.append(pl.BlockSpec(
+                (1, bc), lambda n, h, oc: (0, oc)))
+    out_specs = [pl.BlockSpec((1, bh, wo, bc),
+                              lambda n, h, oc: (n, h, 0, oc))]
+    out_shape = [jax.ShapeDtypeStruct((N, ho, wo, cout), out_dtype)]
+    if emit_stats:
+        out_specs += [pl.BlockSpec((1, 1, bc),
+                                   lambda n, h, oc: (n, h, oc))] * 2
+        out_shape += [jax.ShapeDtypeStruct((N, nh, cout),
+                                           jnp.float32)] * 2
+    got = pl.pallas_call(
+        functools.partial(_fconv_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
+                          bh=bh, wo=wo, depthwise=depthwise,
+                          stages=stages, aux_kinds=aux_kinds,
+                          emit_stats=emit_stats),
+        grid=(N, nh, noc),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=bool(interpret),
+    )(x, w, *aux)
+    return tuple(got) if emit_stats else got[0]
+
+
+def _fconv_reference(x, w, aux, meta):
+    """Identical-math XLA composition on the same padded NHWC operands
+    — the custom_vjp backward differentiates THIS, so gradients flow
+    through conv, stats and every epilogue stage."""
+    (kh, kw, sh, sw, bh, nh, wo, _bc, _noc, depthwise, stages,
+     aux_kinds, emit_stats, _interpret, out_dtype) = meta
+    ho = nh * bh
+    if depthwise:
+        wr = w[:, :, None, :]
+        conv = jax.lax.conv_general_dilated(
+            x, wr, (sh, sw), 'VALID',
+            feature_group_count=x.shape[-1],
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+            preferred_element_type=jnp.float32)
+    else:
+        conv = jax.lax.conv_general_dilated(
+            x, w, (sh, sw), 'VALID',
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+            preferred_element_type=jnp.float32)
+    # the padded input carries slack rows/cols (reshape-trick fit);
+    # VALID over it yields extra positions — slice to the true output
+    y = conv[:, :ho, :wo, :]
+    outs = []
+    if emit_stats:
+        N, c = y.shape[0], y.shape[-1]
+        grouped = y.reshape(N, nh, bh, wo, c)
+        outs = [jnp.sum(grouped, axis=(2, 3)),
+                jnp.sum(grouped * grouped, axis=(2, 3))]
+
+    def fetch_aux(idx):
+        kind2 = aux_kinds[idx]
+        o = aux[idx].astype(jnp.float32)
+        if kind2 == 't':
+            return o                                # [N, Ho, Wo, C]
+        if kind2 == 'nc':
+            return o[:, None, None, :]              # [N, C]
+        if kind2 == 's':
+            return o.reshape(())                    # scalar
+        return o.reshape(1, 1, 1, -1)               # 'c': [1, C]
+
+    for st in stages:
+        y = _apply_stage(y, st, fetch_aux)
+    y = y.astype(out_dtype)
+    return (y,) + tuple(outs) if emit_stats else y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fconv(x, w, aux, meta):
+    return _fconv_pallas(x, w, aux, meta)
+
+
+def _fconv_fwd(x, w, aux, meta):
+    return _fconv_pallas(x, w, aux, meta), (x, w, aux)
+
+
+def _fconv_bwd(meta, res, g):
+    x, w, aux = res
+    _, vjp = jax.vjp(
+        lambda x_, w_, a_: _fconv_reference(x_, w_, a_, meta),
+        x, w, aux)
+    return vjp(g)
+
+
+_fconv.defvjp(_fconv_fwd, _fconv_bwd)
+
+
+# Engagement override for tests/benchmarks: None -> policy (Pallas on
+# TPU, replay elsewhere); 'interpret' -> Pallas interpreter (CPU
+# parity tests); True/'tpu' -> force-engage; False -> force-replay.
+_FCONV_FORCE = [None]
+
+
+@contextlib.contextmanager
+def force_conv_epilogue(mode='interpret'):
+    prev = _FCONV_FORCE[0]
+    _FCONV_FORCE[0] = mode
+    try:
+        yield
+    finally:
+        _FCONV_FORCE[0] = prev
+
+
+def conv_epilogue_mode():
+    """The live engagement decision: False (exact replay), 'tpu', or
+    'interpret'. The tuned schedule's ``epilogue: off`` wins over
+    everything — it IS the measured decision."""
+    from ..compiler import tuning as _ctuning
+    if _ctuning.conv_schedule().get('epilogue') == 'off':
+        return False
+    f = _FCONV_FORCE[0]
+    if f is not None:
+        if not _HAS_PALLAS:
+            return False
+        return 'tpu' if f is True else f
+    return 'tpu' if (_HAS_PALLAS and _on_tpu()) else False
+
+
+def _pick_div(n, target, quantum=1):
+    """Largest divisor of ``n`` that is <= target and a multiple of
+    ``quantum``; None when no such divisor exists."""
+    best = None
+    for d in range(1, n + 1):
+        if n % d == 0 and d <= target and d % quantum == 0:
+            best = d
+    return best
+
+
+_FCONV_MAX_VMEM = 12 * 1024 * 1024
+
+
+def fused_conv_epilogue(x, w, aux, aux_kinds, strides, paddings,
+                        depthwise, stages, emit_stats=False,
+                        interpret=False):
+    """Fused conv + epilogue on NHWC operands. Returns ``(result,
+    None)`` when the Pallas path engages, or ``(None, reason)`` when
+    this shape/dtype/schedule is unsupported (the caller counts the
+    fallback and replays the exact unfused lowering instead — never
+    silently, never wrong).
+
+    x: [N, H, W, Cin]; w: [KH, KW, Cin, Cout] (depthwise: [KH, KW,
+    C]); aux: per-stage operands already shaped 'c' [1, C] / 'nc'
+    [N, C] / 't' [N, Ho, Wo, Cout] / 's' [1, 1]. With ``emit_stats``
+    the result is ``(y, psum [N, NH, Cout], psumsq)`` — f32 partial
+    moments of the conv output for train-mode BN.
+    """
+    from ..compiler import tuning as _ctuning
+    if x.ndim != 4:
+        return None, 'rank'
+    if x.dtype not in (jnp.float32, jnp.bfloat16):
+        return None, 'dtype'
+    sh, sw = strides
+    ph, pw = paddings
+    kh, kw = (int(w.shape[0]), int(w.shape[1]))
+    cout = int(w.shape[-1])
+    N, H, W = int(x.shape[0]), int(x.shape[1]), int(x.shape[2])
+    ho = (H + 2 * ph - kh) // sh + 1
+    wo = (W + 2 * pw - kw) // sw + 1
+    if ho <= 0 or wo <= 0:
+        return None, 'degenerate'
+    sched = _ctuning.conv_schedule()
+    quantum = int(sched['vector_width']) if not interpret else 1
+    bc = _pick_div(cout, int(sched['block_c']), quantum)
+    if bc is None:
+        return None, 'channel-align'
+    bh = _pick_div(ho, int(sched['block_h'])) if kh == 1 else ho
+    nh = ho // bh
+    noc = cout // bc
+    # pad with the reshape-trick slack so every (kh, kw) tap fits
+    htot = ho * sh if kh == 1 else kh - 1 + ho * sh
+    wtot = kw - 1 + wo * sw
+    row_span = bh * sh if kh == 1 else htot
+    cin_blk = bc if depthwise else int(x.shape[3])
+    est = 4 * (row_span * wtot * cin_blk + kh * kw * cin_blk * bc
+               + 3 * bh * wo * bc)
+    for k, a in zip(aux_kinds, aux):
+        est += 4 * (bh * wo * bc if k == 't' else int(a.shape[-1]))
+    if est > _FCONV_MAX_VMEM:
+        return None, 'vmem'
+    xp = jnp.pad(x, ((0, 0), (ph, htot - H - ph),
+                     (pw, wtot - W - pw), (0, 0)))
+    meta = (kh, kw, sh, sw, bh, nh, wo, bc, noc, bool(depthwise),
+            tuple(stages), tuple(aux_kinds), bool(emit_stats),
+            bool(interpret), str(x.dtype))
+    return _fconv(xp, w, tuple(aux), meta), None
 
 
 def fused_lstm_cell(xg, r_prev, c_prev, w, interpret=None):

@@ -367,7 +367,8 @@ class ModelServer(object):
         return out, n
 
     # ---- warmup ----------------------------------------------------------
-    def warmup(self, model_name=None, upto=None, timeout=300.0):
+    def warmup(self, model_name=None, upto=None, timeout=300.0,
+               autotune=False):
         """Pre-compile every shape bucket (one synthetic request per
         bucket through the public path) so live traffic never pays a
         compile. Returns ``{model: [bucket sizes warmed]}``; models
@@ -377,11 +378,20 @@ class ModelServer(object):
         (COMPILER.md) is preloaded, so every warmup compile — and every
         later live compile — runs under the autotuned per-shape configs
         instead of re-deriving defaults: fast cold-start is the whole
-        point of paying the tuning search offline."""
+        point of paying the tuning search offline.
+
+        ``autotune=True`` additionally runs the measured schedule
+        search (:class:`~..compiler.tuning.Autotuner.tune_if_missing`)
+        for every model × bucket *before* that bucket's warmup compile
+        — only buckets with no cached entry for this device kind pay a
+        search, so the second warmup of a process (or any process that
+        preloaded a populated on-disk cache) does zero searches."""
         from ..compiler import tuning as _ctuning
         from ..observability import perf as _perf
         t0 = time.monotonic()
         tuned = _ctuning.default_cache().preload()
+        tuner = _ctuning.Autotuner() if autotune else None
+        searches = 0
         names = [model_name] if model_name is not None else self.models()
         warmed = {}
         # perf observatory: when this process is already observing
@@ -403,6 +413,11 @@ class ModelServer(object):
                     feed = model.synthetic_feed(bucket)
                     if feed is None:
                         break
+                    if tuner is not None:
+                        _, searched = tuner.tune_if_missing(
+                            model.program, feed, model.fetch_vars,
+                            scope=model.scope, name=name)
+                        searches += int(searched)
                     pending.append(
                         self.submit(name, feed, _warmup=True))
                     warmed[name].append(bucket)
@@ -413,6 +428,7 @@ class ModelServer(object):
                   models=len(warmed),
                   buckets=sum(len(v) for v in warmed.values()),
                   tuning_entries=tuned,
+                  autotune_searches=searches,
                   perf_ledgers=len(_perf.book()) - _n_ledgers0,
                   dur_s=round(time.monotonic() - t0, 6))
         return warmed

@@ -1,0 +1,418 @@
+"""conv_epilogue_fuse + the Pallas fused-conv epilogue kernel.
+
+Pins the ISSUE 20 acceptance contract (COMPILER.md "Conv epilogue
+fusion", PERF.md "Conv bandwidth"):
+
+- fused-vs-unfused parity <= 1e-5 on every covered shape: conv+BN+ReLU,
+  residual elementwise_add, depthwise conv, the SE-block excitation
+  scale — with the Pallas kernel actually engaged (interpret mode on
+  CPU), not just the exact replay;
+- train-mode gradient parity through ``append_backward`` (the fused op
+  differentiates via its custom_vjp against the jnp reference);
+- pass idempotence: run(run(p)) == run(p);
+- unsupported shapes (grouped non-depthwise convs) fall back COUNTED
+  (``conv_fuse_fallbacks_total`` + a ``conv_fuse_fallback`` journal
+  event naming the reason) and stay bit-exact — never silent, never
+  wrong;
+- the schedule autotuner poisons a crashed candidate and keeps
+  sweeping (seeded via faultinject ``SITE_TUNING_MEASURE``);
+- winners persist per device-kind and a second search is a cache hit
+  (``tune_if_missing``; ``ModelServer.warmup(autotune=True)`` does
+  zero searches the second time).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.compiler as compiler
+from paddle_tpu import observability as obs
+from paddle_tpu.compiler import tuning as ctuning
+from paddle_tpu.compiler.passes import FUSED_CONV_OP
+from paddle_tpu.ops import pallas_kernels as pk
+from paddle_tpu.resilience import faultinject as fi
+
+pytestmark = pytest.mark.compiler
+
+TOL = 1e-5
+
+
+@pytest.fixture(autouse=True)
+def _compiler_defaults():
+    """Default config + throwaway tuning cache (never the developer's
+    ~/.cache file), same contract as test_compiler."""
+    prev_cache = ctuning.set_default_cache(
+        ctuning.TuningCache(path='/nonexistent/paddle-tpu-test-tuning'))
+    compiler.set_enabled(True)
+    compiler.set_default_passes(None)
+    yield
+    compiler.set_enabled(True)
+    compiler.set_default_passes(None)
+    ctuning.set_default_cache(prev_cache)
+
+
+def _op_types(program):
+    return [op.type for op in program.global_block().ops]
+
+
+def _counter(name):
+    return obs.default_registry().counter(name)
+
+
+def _randomize_bn_stats(program, scope, rng):
+    """Non-trivial BN stats/affine so folding errors can't hide behind
+    identity parameters."""
+    for op in program.global_block().ops:
+        if op.type != 'batch_norm':
+            continue
+        c = scope.raw(op.inputs['Scale'][0]).shape[0]
+        scope.set_var(op.inputs['Mean'][0],
+                      rng.randn(c).astype('float32') * 0.3)
+        scope.set_var(op.inputs['Variance'][0],
+                      (rng.rand(c) + 0.5).astype('float32'))
+        scope.set_var(op.inputs['Scale'][0],
+                      (rng.rand(c) + 0.5).astype('float32'))
+        scope.set_var(op.inputs['Bias'][0],
+                      rng.randn(c).astype('float32') * 0.1)
+
+
+def _parity_legs(build, feed, fetch_names, expect_fused=True):
+    """Run the raw (compiler disabled) and fused (Pallas interpret)
+    legs of one program in ONE scope with ONE startup run.
+
+    The engagement hook is not part of the executor's jit cache key,
+    so the force context must wrap the FIRST default-passes compile;
+    the raw leg compiles under a different cache token
+    (``compiler.disabled()``), so leg order is free. Returns
+    (raw_outs, fused_outs, fused_delta, fallback_delta)."""
+    main, startup, _ = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(7)
+    fused_c, fall_c = (_counter('conv_fuse_ops_fused_total'),
+                       _counter('conv_fuse_fallbacks_total'))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        _randomize_bn_stats(main, scope, rng)
+        with compiler.disabled():
+            raw = exe.run(main, feed=dict(feed), fetch_list=fetch_names)
+        f0, b0 = fused_c.value, fall_c.value
+        with pk.force_conv_epilogue('interpret'):
+            fused = exe.run(main, feed=dict(feed),
+                            fetch_list=fetch_names)
+    if expect_fused:
+        assert fused_c.value > f0, 'conv_epilogue_fuse fused nothing'
+    return ([np.asarray(v) for v in raw],
+            [np.asarray(v) for v in fused],
+            fused_c.value - f0, fall_c.value - b0)
+
+
+# ---- covered-shape exactness ----------------------------------------------
+
+def _build_conv_bn_relu():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[3, 8, 8],
+                                  dtype='float32')
+            c = fluid.layers.conv2d(input=x, num_filters=4, filter_size=3,
+                                    padding=1, bias_attr=False)
+            b = fluid.layers.batch_norm(input=c, is_test=True)
+            out = fluid.layers.relu(b)
+    return main, startup, out
+
+
+def test_conv_bn_relu_pallas_parity():
+    feed = {'x': np.random.RandomState(0).randn(
+        2, 3, 8, 8).astype('float32')}
+    main, _, out = _build_conv_bn_relu()
+    raw, fused, _, falls = _parity_legs(_build_conv_bn_relu, feed,
+                                        [out.name])
+    assert falls == 0, 'Pallas lowering rejected a supported shape'
+    err = np.max(np.abs(raw[0] - fused[0]))
+    assert err <= TOL, 'fused conv+BN+ReLU drifted %g > %g' % (err, TOL)
+    # the optimized program really carries a fused_conv op
+    optimized, _ = compiler.optimize(main, fetch_names=[out.name])
+    assert FUSED_CONV_OP in _op_types(optimized)
+    assert 'batch_norm' not in _op_types(optimized)
+
+
+def test_residual_add_parity():
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name='x', shape=[4, 8, 8],
+                                      dtype='float32')
+                c = fluid.layers.conv2d(input=x, num_filters=4,
+                                        filter_size=3, padding=1,
+                                        bias_attr=False)
+                b = fluid.layers.batch_norm(input=c, is_test=True)
+                s = fluid.layers.elementwise_add(b, x)   # residual tensor
+                out = fluid.layers.relu(s)
+        return main, startup, out
+
+    feed = {'x': np.random.RandomState(1).randn(
+        2, 4, 8, 8).astype('float32')}
+    _, _, out = build()
+    raw, fused, _, falls = _parity_legs(build, feed, [out.name])
+    assert falls == 0
+    err = np.max(np.abs(raw[0] - fused[0]))
+    assert err <= TOL, 'fused residual-add drifted %g' % err
+
+
+def test_depthwise_conv_parity():
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name='x', shape=[4, 8, 8],
+                                      dtype='float32')
+                c = fluid.layers.conv2d(input=x, num_filters=4,
+                                        filter_size=3, padding=1,
+                                        groups=4, bias_attr=False)
+                b = fluid.layers.batch_norm(input=c, is_test=True)
+                out = fluid.layers.relu(b)
+        return main, startup, out
+
+    feed = {'x': np.random.RandomState(2).randn(
+        2, 4, 8, 8).astype('float32')}
+    _, _, out = build()
+    raw, fused, _, falls = _parity_legs(build, feed, [out.name])
+    assert falls == 0, 'depthwise path fell back instead of engaging'
+    err = np.max(np.abs(raw[0] - fused[0]))
+    assert err <= TOL, 'fused depthwise drifted %g' % err
+
+
+def test_se_block_excitation_parity():
+    """The se_resnext pattern: a [N, C] excitation scales the conv
+    output per channel (elementwise_mul axis=0 -> 'nc' aux)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name='x', shape=[3, 8, 8],
+                                      dtype='float32')
+                se = fluid.layers.data(name='se', shape=[4],
+                                       dtype='float32')
+                c = fluid.layers.conv2d(input=x, num_filters=4,
+                                        filter_size=3, padding=1,
+                                        bias_attr=False)
+                b = fluid.layers.batch_norm(input=c, is_test=True)
+                s = fluid.layers.elementwise_mul(b, se, axis=0)
+                out = fluid.layers.relu(s)
+        return main, startup, out
+
+    rng = np.random.RandomState(3)
+    feed = {'x': rng.randn(2, 3, 8, 8).astype('float32'),
+            'se': (rng.rand(2, 4) + 0.25).astype('float32')}
+    _, _, out = build()
+    raw, fused, _, falls = _parity_legs(build, feed, [out.name])
+    assert falls == 0
+    err = np.max(np.abs(raw[0] - fused[0]))
+    assert err <= TOL, 'fused SE excitation drifted %g' % err
+
+
+# ---- train mode -----------------------------------------------------------
+
+def test_train_mode_bn_loss_and_grad_parity():
+    """Train-mode BN rides the fused op (moment partials emitted by
+    the kernel) and gradients flow through the custom_vjp: loss AND
+    conv-weight grads match the unfused program via append_backward."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 17
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name='x', shape=[3, 8, 8],
+                                      dtype='float32')
+                c = fluid.layers.conv2d(input=x, num_filters=4,
+                                        filter_size=3, padding=1,
+                                        bias_attr=False)
+                b = fluid.layers.batch_norm(input=c)    # train mode
+                r = fluid.layers.relu(b)
+                loss = fluid.layers.mean(r)
+                grads = fluid.backward.append_backward(loss)
+        return main, startup, (loss, grads)
+
+    main, _, (loss, grads) = build()
+    gnames = [g.name for _, g in grads]
+    feed = {'x': np.random.RandomState(4).randn(
+        2, 3, 8, 8).astype('float32')}
+    raw, fused, _, falls = _parity_legs(
+        build, feed, [loss.name] + gnames)
+    assert falls == 0
+    for name, rv, fv in zip(['loss'] + gnames, raw, fused):
+        err = np.max(np.abs(rv - fv))
+        assert err <= TOL, '%s drifted %g in train mode' % (name, err)
+
+
+# ---- idempotence ----------------------------------------------------------
+
+def test_conv_epilogue_fuse_idempotent():
+    main, _, out = _build_conv_bn_relu()
+    once, _ = compiler.optimize(main, fetch_names=[out.name])
+    twice, _ = compiler.optimize(once, fetch_names=[out.name])
+    assert _op_types(once) == _op_types(twice)
+    assert _op_types(once).count(FUSED_CONV_OP) == 1
+
+
+# ---- fallback accounting --------------------------------------------------
+
+def test_grouped_conv_falls_back_counted_and_exact(tmp_path):
+    """A grouped non-depthwise conv is fused by the pass but rejected
+    by the lowering: the replay must be bit-exact AND visible — one
+    counter tick plus a journal event naming reason='groups'."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 19
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name='x', shape=[4, 8, 8],
+                                      dtype='float32')
+                c = fluid.layers.conv2d(input=x, num_filters=8,
+                                        filter_size=3, padding=1,
+                                        groups=2, bias_attr=False)
+                b = fluid.layers.batch_norm(input=c, is_test=True)
+                out = fluid.layers.relu(b)
+        return main, startup, out
+
+    feed = {'x': np.random.RandomState(5).randn(
+        2, 4, 8, 8).astype('float32')}
+    _, _, out = build()
+    journal = str(tmp_path / 'fallback.jsonl')
+    with obs.journal(journal):
+        raw, fused, _, falls = _parity_legs(build, feed, [out.name])
+    assert falls == 1, 'expected exactly one counted fallback'
+    assert np.array_equal(raw[0], fused[0]), \
+        'fallback replay must be bit-exact'
+    records, malformed = obs.read_journal(journal)
+    assert malformed == 0
+    events = [r for r in records if r['ev'] == 'conv_fuse_fallback']
+    assert len(events) == 1
+    assert events[0]['reason'] == 'groups'
+    assert 'conv2d' in events[0]['types']
+
+
+# ---- autotuner robustness -------------------------------------------------
+
+def _tiny_conv_program():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 23
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[2, 4, 4],
+                                  dtype='float32')
+            c = fluid.layers.conv2d(input=x, num_filters=2, filter_size=3,
+                                    padding=1, bias_attr=False)
+            out = fluid.layers.relu(c)
+    feed = {'x': np.random.RandomState(6).randn(
+        1, 2, 4, 4).astype('float32')}
+    return main, startup, out, feed
+
+
+@pytest.mark.faultinject
+def test_autotuner_poisons_crashed_candidate_and_continues(tmp_path):
+    main, startup, out, feed = _tiny_conv_program()
+    cache = ctuning.TuningCache(path=str(tmp_path / 't.json'))
+    tuner = ctuning.Autotuner(cache=cache, warmup=0, steps=1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        journal = str(tmp_path / 'tune.jsonl')
+        with obs.journal(journal):
+            with fi.fault_plan() as plan:
+                plan.inject(fi.SITE_TUNING_MEASURE, at=[1])
+                best, report = tuner.tune(main, feed, [out.name],
+                                          scope=scope)
+    poisoned = [tok for tok, v in report.items()
+                if isinstance(v, str) and v.startswith('poisoned')]
+    assert len(poisoned) == 1, report
+    assert 'FaultInjected' in report[poisoned[0]]
+    # the sweep continued: every other candidate has a real timing,
+    # a winner was still picked and cached
+    assert all(isinstance(v, (int, float)) for tok, v in report.items()
+               if tok not in poisoned)
+    assert best and len(cache) == 1
+    # journalled: begin + one candidate_poisoned + end
+    records, _ = obs.read_journal(journal)
+    phases = [r.get('phase') for r in records if r['ev'] == 'autotune']
+    assert 'begin' in phases and 'end' in phases
+    assert phases.count('candidate_poisoned') == 1
+    ends = [r for r in records if r['ev'] == 'autotune'
+            and r.get('phase') == 'end']
+    assert ends[0]['poisoned'] == 1
+    assert ends[0]['candidates'] == len(report)
+
+
+# ---- persistence & warmup -------------------------------------------------
+
+def test_winner_persists_per_device_kind(tmp_path):
+    path = str(tmp_path / 'tuning.json')
+    cache = ctuning.TuningCache(path=path)
+    cache.put('fp', 'sig', ctuning.backend(),
+              {'conv_block_h': 16}, measured_ms=1.0)
+    # a fresh process (new cache object, same disk file) sees the
+    # winner — but only under the device kind that measured it
+    fresh = ctuning.TuningCache(path=path)
+    fresh.preload()
+    assert fresh.lookup('fp', 'sig', ctuning.backend()) == \
+        {'conv_block_h': 16}
+    assert fresh.lookup('fp', 'sig', 'tpu-v5e') is None
+
+
+def test_tune_if_missing_searches_once(tmp_path):
+    main, startup, out, feed = _tiny_conv_program()
+    cache = ctuning.TuningCache(path=str(tmp_path / 't.json'))
+    tuner = ctuning.Autotuner(cache=cache, warmup=0, steps=1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        e1, searched1 = tuner.tune_if_missing(main, feed, [out.name],
+                                              scope=scope)
+        e2, searched2 = tuner.tune_if_missing(main, feed, [out.name],
+                                              scope=scope)
+    assert searched1 is True
+    assert searched2 is False       # second search is a cache hit
+    assert e2 == e1
+
+
+@pytest.mark.serving
+def test_warmup_autotune_second_pass_zero_searches(tmp_path):
+    """The acceptance pin: ``warmup(autotune=True)`` searches every
+    model x bucket once, persists the winners, and a second warmup —
+    same process or one that preloaded the on-disk cache — does ZERO
+    searches."""
+    prev = ctuning.set_default_cache(
+        ctuning.TuningCache(path=str(tmp_path / 'tuning.json')))
+    try:
+        main, startup, out, feed = _tiny_conv_program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+        journal = str(tmp_path / 'warm.jsonl')
+        with obs.journal(journal):
+            srv = fluid.ModelServer(max_batch_size=2)
+            try:
+                srv.register_model('m', main, ['x'], [out], scope)
+                warmed = srv.warmup(autotune=True)
+                assert warmed['m']
+                warmed2 = srv.warmup(autotune=True)
+                assert warmed2['m']
+            finally:
+                srv.close()
+        records, _ = obs.read_journal(journal)
+        warms = [r for r in records if r['ev'] == 'serving_warmup']
+        assert len(warms) == 2
+        assert warms[0]['autotune_searches'] == len(warmed['m'])
+        assert warms[1]['autotune_searches'] == 0
+        # and the searches really ran through the Autotuner (journal
+        # carries the completed sweeps -> obs_report's autotune gate)
+        ends = [r for r in records if r['ev'] == 'autotune'
+                and r.get('phase') == 'end']
+        assert len(ends) == len(warmed['m'])
+    finally:
+        ctuning.set_default_cache(prev)

@@ -141,6 +141,22 @@ def _smoke_programs():
             y = fluid.layers.fc(input=h, size=8, act=None)
     feed = {'x': rng.randn(32, 64).astype('float32')}
     specs.append(('fc_infer', main, startup, feed, [y]))
+
+    # 3) conv+BN+ReLU inference step: the conv_epilogue_fuse path —
+    # the ledger rows the fused-conv bandwidth gate diffs (bytes
+    # accessed must stay put on CPU where the fused op replays exactly)
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            im = fluid.layers.data(name='im', shape=[8, 16, 16],
+                                   dtype='float32')
+            c = fluid.layers.conv2d(input=im, num_filters=16,
+                                    filter_size=3, padding=1)
+            b = fluid.layers.batch_norm(input=c, is_test=True)
+            r = fluid.layers.relu(b)
+    feed = {'im': rng.randn(4, 8, 16, 16).astype('float32')}
+    specs.append(('conv_fuse_infer', main, startup, feed, [r]))
     return specs
 
 
